@@ -1,0 +1,31 @@
+"""gsky_tpu — a TPU-native distributed geospatial data server.
+
+A from-scratch rebuild of the capabilities of GSKY (NCI's distributed,
+scalable geospatial data server): OGC WMS / WCS / WPS / DAP4 service over
+large archives of GeoTIFF / NetCDF raster data, with the per-pixel raster
+compute (reprojection/warping, temporal mosaicing, band math, colour
+scaling, polygon drill statistics) executed on TPU via JAX/XLA/Pallas.
+
+Package layout
+--------------
+- ``gsky_tpu.geo``       coordinate reference systems, affine transforms and
+                         geometry — all projection math is jax-traceable so
+                         coordinate transforms fuse into device kernels.
+- ``gsky_tpu.ops``       the TPU compute kernels: warp (reprojection
+                         resampling), temporal mosaic, colour scaling,
+                         palettes, band-expression compiler, drill
+                         reductions.
+- ``gsky_tpu.io``        raster IO: GeoTIFF codec, NetCDF (h5py + classic),
+                         PNG, DAP4 encoding.  Native C++ fast paths.
+- ``gsky_tpu.index``     the metadata index (MAS equivalent): sqlite store,
+                         masapi-compatible HTTP API, crawler.
+- ``gsky_tpu.pipeline``  request pipelines: tile (WMS/WCS), drill (WPS),
+                         extent, feature info.
+- ``gsky_tpu.server``    the OWS HTTP front-end, config system, templates,
+                         metrics.
+- ``gsky_tpu.worker``    the RPC compute worker boundary: gRPC service,
+                         batching TPU executor, process supervision.
+- ``gsky_tpu.parallel``  device-mesh sharding for multi-chip rendering.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+from .crs import CRS, EPSG4326, EPSG3857, parse_crs
+from .transform import GeoTransform, BBox
+from . import geometry
+
+__all__ = [
+    "CRS",
+    "EPSG4326",
+    "EPSG3857",
+    "parse_crs",
+    "GeoTransform",
+    "BBox",
+    "geometry",
+]

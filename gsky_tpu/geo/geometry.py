@@ -1,0 +1,476 @@
+"""Geometry: WKT / GeoJSON parsing, predicates, area, rasterization.
+
+Replaces the reference's OGR/geos usage: polygon area for the WPS request
+limit (`utils/wps.go:245`), geometry normalisation for metrics
+(`metrics/metrics.go:156-210`), MAS's Douglas-Peucker simplification
+(`mas/api/mas.sql:424-432`), and the drill mask burn
+(`worker/gdalprocess/drill.go:275-327` — GDALRasterizeGeometries with
+ALL_TOUCHED=TRUE), all with no native geometry library.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .transform import BBox
+
+Ring = np.ndarray  # (N, 2) float64, closed (first == last) not required
+
+
+@dataclass
+class Geometry:
+    """Point / LineString / Polygon / MultiPolygon.
+
+    ``polys`` is a list of polygons; each polygon is a list of rings
+    (first exterior, rest holes); each ring an (N,2) array of x,y.
+    Points/lines are stored in ``points``.
+    """
+
+    kind: str  # Point | MultiPoint | LineString | Polygon | MultiPolygon
+    polys: List[List[Ring]] = field(default_factory=list)
+    points: Optional[np.ndarray] = None  # (N,2) for point/line kinds
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def point(cls, x: float, y: float) -> "Geometry":
+        return cls("Point", points=np.array([[x, y]], dtype=np.float64))
+
+    @classmethod
+    def polygon(cls, rings: Sequence[Sequence[Tuple[float, float]]]) -> "Geometry":
+        return cls("Polygon", polys=[[np.asarray(r, dtype=np.float64) for r in rings]])
+
+    @classmethod
+    def bbox_polygon(cls, b: BBox) -> "Geometry":
+        return cls.polygon([[(b.xmin, b.ymin), (b.xmax, b.ymin),
+                             (b.xmax, b.ymax), (b.xmin, b.ymax),
+                             (b.xmin, b.ymin)]])
+
+    # -- basics -------------------------------------------------------------
+
+    def bbox(self) -> BBox:
+        arrs = []
+        if self.points is not None:
+            arrs.append(self.points)
+        for poly in self.polys:
+            arrs.extend(poly)
+        pts = np.concatenate(arrs, axis=0)
+        return BBox(float(pts[:, 0].min()), float(pts[:, 1].min()),
+                    float(pts[:, 0].max()), float(pts[:, 1].max()))
+
+    def transform(self, fn) -> "Geometry":
+        """Apply fn(x_array, y_array) -> (x, y) to every vertex."""
+        def t(a):
+            x, y = fn(a[:, 0], a[:, 1])
+            return np.stack([np.asarray(x), np.asarray(y)], axis=1)
+        return Geometry(
+            self.kind,
+            polys=[[t(r) for r in poly] for poly in self.polys],
+            points=t(self.points) if self.points is not None else None,
+        )
+
+    def area(self) -> float:
+        """Planar area (units of the coordinate system squared)."""
+        total = 0.0
+        for poly in self.polys:
+            for i, ring in enumerate(poly):
+                a = abs(_shoelace(ring))
+                total += a if i == 0 else -a
+        return total
+
+    # -- predicates ---------------------------------------------------------
+
+    def contains_point(self, x: float, y: float) -> bool:
+        for poly in self.polys:
+            if _point_in_ring(poly[0], x, y):
+                if not any(_point_in_ring(h, x, y) for h in poly[1:]):
+                    return True
+        return False
+
+    def intersects_bbox(self, b: BBox) -> bool:
+        """Accurate polygon/bbox intersection test (used by the MAS index in
+        place of PostGIS ST_Intersects for bbox queries)."""
+        if not self.bbox().intersects(b):
+            return False
+        if self.kind in ("Point", "MultiPoint"):
+            return any(b.xmin <= p[0] <= b.xmax and b.ymin <= p[1] <= b.ymax
+                       for p in self.points)
+        if self.kind == "LineString":
+            return _segments_cross_bbox(self.points, b)
+        # any bbox corner (or its centre) inside the polygon?
+        if _bbox_corner_hits(self, b):
+            return True
+        if self.contains_point((b.xmin + b.xmax) / 2, (b.ymin + b.ymax) / 2):
+            return True
+        for poly in self.polys:
+            for ring in poly:  # exterior AND holes: a hole boundary crossing
+                # the bbox means polygon material enters it too
+                inside = ((ring[:, 0] >= b.xmin) & (ring[:, 0] <= b.xmax)
+                          & (ring[:, 1] >= b.ymin) & (ring[:, 1] <= b.ymax))
+                if inside.any() and ring is poly[0]:
+                    return True
+                if _segments_cross_bbox(ring, b):
+                    # an edge passes through the bbox; for holes this still
+                    # implies polygon material in the bbox (hole boundary is
+                    # adjacent to material)
+                    return True
+        return False
+
+    # -- simplification (Douglas-Peucker, cf. mas.sql:424-432) --------------
+
+    def simplify(self, tol: float) -> "Geometry":
+        def simp(r):
+            s = _douglas_peucker(r, tol)
+            return s if len(s) >= 4 else r
+        return Geometry(self.kind,
+                        polys=[[simp(r) for r in poly] for poly in self.polys],
+                        points=self.points)
+
+    def segmentize(self, max_len: float) -> "Geometry":
+        """Insert vertices so no segment exceeds max_len (PostGIS
+        ST_Segmentize, used before lossy reprojection in mas.sql)."""
+        def seg(r):
+            out = [r[0]]
+            for i in range(1, len(r)):
+                p0, p1 = r[i - 1], r[i]
+                d = math.hypot(p1[0] - p0[0], p1[1] - p0[1])
+                n = max(1, int(math.ceil(d / max_len)))
+                for k in range(1, n + 1):
+                    out.append(p0 + (p1 - p0) * (k / n))
+            return np.asarray(out)
+        return Geometry(self.kind,
+                        polys=[[seg(r) for r in poly] for poly in self.polys],
+                        points=self.points)
+
+    # -- serialisation ------------------------------------------------------
+
+    def to_wkt(self, ndigits: int = 8) -> str:
+        def fmt(v):
+            s = f"{v:.{ndigits}f}".rstrip("0").rstrip(".")
+            return s if s not in ("-0", "") else "0"
+
+        def ring_wkt(r):
+            pts = list(r)
+            if len(pts) and (pts[0][0] != pts[-1][0] or pts[0][1] != pts[-1][1]):
+                pts.append(pts[0])
+            return "(" + ",".join(f"{fmt(p[0])} {fmt(p[1])}" for p in pts) + ")"
+
+        if self.kind == "Point":
+            p = self.points[0]
+            return f"POINT({fmt(p[0])} {fmt(p[1])})"
+        if self.kind in ("LineString", "MultiPoint"):
+            body = ",".join(f"{fmt(p[0])} {fmt(p[1])}" for p in self.points)
+            return f"{self.kind.upper()}({body})"
+        if self.kind == "Polygon":
+            return "POLYGON(" + ",".join(ring_wkt(r) for r in self.polys[0]) + ")"
+        if self.kind == "MultiPolygon":
+            return "MULTIPOLYGON(" + ",".join(
+                "(" + ",".join(ring_wkt(r) for r in poly) + ")"
+                for poly in self.polys) + ")"
+        raise ValueError(self.kind)
+
+    def to_geojson(self) -> dict:
+        def ring(r):
+            pts = [[float(p[0]), float(p[1])] for p in r]
+            if pts and pts[0] != pts[-1]:
+                pts.append(pts[0])
+            return pts
+        if self.kind == "Point":
+            return {"type": "Point",
+                    "coordinates": [float(self.points[0][0]), float(self.points[0][1])]}
+        if self.kind in ("LineString", "MultiPoint"):
+            return {"type": self.kind,
+                    "coordinates": [[float(p[0]), float(p[1])] for p in self.points]}
+        if self.kind == "Polygon":
+            return {"type": "Polygon",
+                    "coordinates": [ring(r) for r in self.polys[0]]}
+        if self.kind == "MultiPolygon":
+            return {"type": "MultiPolygon",
+                    "coordinates": [[ring(r) for r in poly] for poly in self.polys]}
+        raise ValueError(self.kind)
+
+
+# ---------------------------------------------------------------------------
+# internal helpers
+# ---------------------------------------------------------------------------
+
+def _shoelace(ring: Ring) -> float:
+    x, y = ring[:, 0], ring[:, 1]
+    return 0.5 * float(np.sum(x * np.roll(y, -1) - np.roll(x, -1) * y))
+
+
+def _point_in_ring(ring: Ring, px: float, py: float) -> bool:
+    x, y = ring[:, 0], ring[:, 1]
+    x2, y2 = np.roll(x, -1), np.roll(y, -1)
+    cond = (y > py) != (y2 > py)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        xint = x + (py - y) * (x2 - x) / (y2 - y)
+    crossings = np.count_nonzero(cond & (px < xint))
+    return bool(crossings % 2)
+
+
+def _bbox_corner_hits(g: "Geometry", b: BBox) -> bool:
+    return any(g.contains_point(cx, cy) for cx, cy in
+               ((b.xmin, b.ymin), (b.xmax, b.ymin), (b.xmax, b.ymax), (b.xmin, b.ymax)))
+
+
+def _segments_cross_bbox(pts: np.ndarray, b: BBox) -> bool:
+    # Cohen–Sutherland-ish: a segment crosses the bbox iff its clipped
+    # parametric interval is non-empty.
+    p0 = pts[:-1]
+    p1 = pts[1:]
+    d = p1 - p0
+    t0 = np.zeros(len(p0))
+    t1 = np.ones(len(p0))
+    ok = np.ones(len(p0), dtype=bool)
+    for axis, lo, hi in ((0, b.xmin, b.xmax), (1, b.ymin, b.ymax)):
+        dv = d[:, axis]
+        pv = p0[:, axis]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            tl = (lo - pv) / dv
+            th = (hi - pv) / dv
+        tlo = np.where(dv >= 0, tl, th)
+        thi = np.where(dv >= 0, th, tl)
+        par = dv == 0
+        inside_par = (pv >= lo) & (pv <= hi)
+        t0 = np.where(par, t0, np.maximum(t0, tlo))
+        t1 = np.where(par, t1, np.minimum(t1, thi))
+        ok &= np.where(par, inside_par, True)
+    return bool(np.any(ok & (t0 <= t1)))
+
+
+def _douglas_peucker(ring: Ring, tol: float) -> Ring:
+    n = len(ring)
+    if n < 3:
+        return ring
+    keep = np.zeros(n, dtype=bool)
+    keep[0] = keep[-1] = True
+    stack = [(0, n - 1)]
+    while stack:
+        i0, i1 = stack.pop()
+        if i1 <= i0 + 1:
+            continue
+        p0, p1 = ring[i0], ring[i1]
+        seg = p1 - p0
+        L = math.hypot(seg[0], seg[1])
+        pts = ring[i0 + 1:i1]
+        if L == 0:
+            d = np.hypot(pts[:, 0] - p0[0], pts[:, 1] - p0[1])
+        else:
+            d = np.abs(np.cross(seg, pts - p0)) / L
+        imax = int(np.argmax(d))
+        if d[imax] > tol:
+            k = i0 + 1 + imax
+            keep[k] = True
+            stack.append((i0, k))
+            stack.append((k, i1))
+    return ring[keep]
+
+
+# ---------------------------------------------------------------------------
+# Rasterization — the drill mask burn
+# ---------------------------------------------------------------------------
+
+def rasterize(geom: Geometry, width: int, height: int,
+              geo_to_pixel, all_touched: bool = True) -> np.ndarray:
+    """Burn a geometry into a (height, width) uint8 mask.
+
+    ``geo_to_pixel(x_arr, y_arr) -> (col, row)`` maps geometry coordinates to
+    fractional pixel coords.  ``all_touched=True`` matches the reference's
+    GDALRasterizeGeometries ALL_TOUCHED=TRUE burn
+    (`worker/gdalprocess/drill.go:309-316`): any pixel touched by the polygon
+    boundary or interior is set.
+    """
+    mask = np.zeros((height, width), dtype=np.uint8)
+    if geom.kind in ("Point", "MultiPoint"):
+        c, r = geo_to_pixel(geom.points[:, 0], geom.points[:, 1])
+        c = np.floor(np.asarray(c)).astype(int)
+        r = np.floor(np.asarray(r)).astype(int)
+        ok = (c >= 0) & (c < width) & (r >= 0) & (r < height)
+        mask[r[ok], c[ok]] = 1
+        return mask
+    if geom.kind == "LineString":
+        c, r = geo_to_pixel(geom.points[:, 0], geom.points[:, 1])
+        px = np.stack([np.asarray(c, dtype=np.float64),
+                       np.asarray(r, dtype=np.float64)], axis=1)
+        _burn_lines(mask, px)
+        return mask
+
+    for poly in geom.polys:
+        rings_px = []
+        for ring in poly:
+            c, r = geo_to_pixel(ring[:, 0], ring[:, 1])
+            rings_px.append(np.stack([np.asarray(c, dtype=np.float64),
+                                      np.asarray(r, dtype=np.float64)], axis=1))
+        _fill_polygon(mask, rings_px, all_touched)
+    return mask
+
+
+def _fill_polygon(mask: np.ndarray, rings: List[np.ndarray], all_touched: bool):
+    height, width = mask.shape
+    # Scanline fill with even-odd rule at pixel centres (row + 0.5),
+    # vectorised over edges: for each edge find its active row span, compute
+    # all its scanline x-intersections at once, then sort crossings per row.
+    ey0, ey1, ex0, eslope = [], [], [], []
+    for ring in rings:
+        pts = ring
+        if len(pts) < 3:
+            continue
+        if pts[0][0] != pts[-1][0] or pts[0][1] != pts[-1][1]:
+            pts = np.vstack([pts, pts[:1]])
+        x0, y0 = pts[:-1, 0], pts[:-1, 1]
+        x1, y1 = pts[1:, 0], pts[1:, 1]
+        nz = y0 != y1
+        x0, y0, x1, y1 = x0[nz], y0[nz], x1[nz], y1[nz]
+        swap = y0 > y1
+        x0s = np.where(swap, x1, x0)
+        y0s = np.where(swap, y1, y0)
+        x1s = np.where(swap, x0, x1)
+        y1s = np.where(swap, y0, y1)
+        ey0.append(y0s)
+        ey1.append(y1s)
+        ex0.append(x0s)
+        eslope.append((x1s - x0s) / (y1s - y0s))
+    if not ey0:
+        return
+    y0 = np.concatenate(ey0)
+    y1 = np.concatenate(ey1)
+    x0 = np.concatenate(ex0)
+    slope = np.concatenate(eslope)
+    # active row range per edge: rows with y0 <= row+0.5 < y1
+    r0 = np.maximum(np.ceil(y0 - 0.5).astype(np.int64), 0)
+    r1 = np.minimum(np.ceil(y1 - 0.5).astype(np.int64), height)  # exclusive
+    counts = np.maximum(r1 - r0, 0)
+    total = int(counts.sum())
+    if total:
+        # expand to one (row, x) crossing per active edge-row
+        eidx = np.repeat(np.arange(len(y0)), counts)
+        starts = np.repeat(np.cumsum(counts) - counts, counts)
+        rows = r0[eidx] + (np.arange(total) - starts)
+        xs = x0[eidx] + (rows + 0.5 - y0[eidx]) * slope[eidx]
+        # sort by (row, x) and pair consecutive crossings per row
+        order = np.lexsort((xs, rows))
+        rows, xs = rows[order], xs[order]
+        row_start = np.searchsorted(rows, np.arange(height), side="left")
+        row_end = np.searchsorted(rows, np.arange(height), side="right")
+        for row in range(height):
+            s, e = row_start[row], row_end[row]
+            if s >= e:
+                continue
+            rxs = xs[s:e]
+            for i in range(0, len(rxs) - 1, 2):
+                c0 = int(math.ceil(rxs[i] - 0.5))
+                c1 = int(math.floor(rxs[i + 1] - 0.5))
+                if c1 >= 0 and c0 < width:
+                    mask[row, max(c0, 0):min(c1, width - 1) + 1] = 1
+    if all_touched:
+        # also burn every pixel the boundary passes through
+        for ring in rings:
+            _burn_lines(mask, ring)
+
+
+def _burn_lines(mask: np.ndarray, ring: np.ndarray):
+    height, width = mask.shape
+    pts = ring
+    for i in range(len(pts) - 1):
+        x0, y0 = pts[i]
+        x1, y1 = pts[i + 1]
+        n = int(max(abs(x1 - x0), abs(y1 - y0)) * 2) + 1
+        t = np.linspace(0.0, 1.0, n + 1)
+        cx = np.floor(x0 + (x1 - x0) * t).astype(int)
+        cy = np.floor(y0 + (y1 - y0) * t).astype(int)
+        ok = (cx >= 0) & (cx < width) & (cy >= 0) & (cy < height)
+        mask[cy[ok], cx[ok]] = 1
+
+
+# ---------------------------------------------------------------------------
+# Parsing
+# ---------------------------------------------------------------------------
+
+_WKT_NUM = r"[-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?"
+
+
+def _parse_ring_text(t: str) -> np.ndarray:
+    pts = []
+    for pair in t.split(","):
+        xy = pair.split()
+        pts.append((float(xy[0]), float(xy[1])))
+    return np.asarray(pts, dtype=np.float64)
+
+
+def _split_parens(t: str) -> List[str]:
+    """Extract the contents of each top-level parenthesised group:
+    '(a),(b (c))' -> ['a', 'b (c)']."""
+    out, depth, cur = [], 0, []
+    for ch in t:
+        if ch == "(":
+            depth += 1
+            if depth == 1:
+                cur = []
+                continue
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                out.append("".join(cur))
+                continue
+        if depth >= 1:
+            cur.append(ch)
+    return out
+
+
+def from_wkt(wkt: str) -> Geometry:
+    s = wkt.strip()
+    m = re.match(r"^\s*(\w+)\s*\((.*)\)\s*$", s, re.S)
+    if not m:
+        raise ValueError(f"bad WKT: {wkt[:80]!r}")
+    kind = m.group(1).upper()
+    body = m.group(2)
+    if kind == "POINT":
+        xy = body.split()
+        return Geometry.point(float(xy[0]), float(xy[1]))
+    if kind == "LINESTRING":
+        return Geometry("LineString", points=_parse_ring_text(body))
+    if kind == "POLYGON":
+        rings = [_parse_ring_text(r) for r in _split_parens(body)]
+        return Geometry("Polygon", polys=[rings])
+    if kind == "MULTIPOLYGON":
+        polys = []
+        for poly_txt in _split_parens(body):
+            rings = [_parse_ring_text(r) for r in _split_parens(poly_txt)]
+            polys.append(rings)
+        return Geometry("MultiPolygon", polys=polys)
+    raise ValueError(f"unsupported WKT type {kind}")
+
+
+def from_geojson(obj) -> Geometry:
+    """Parse a GeoJSON geometry / Feature / FeatureCollection (first feature),
+    matching the WPS input handling (`ows.go:1280-1304`)."""
+    if isinstance(obj, (str, bytes)):
+        obj = json.loads(obj)
+    t = obj.get("type")
+    if t == "FeatureCollection":
+        feats = obj.get("features") or []
+        if not feats:
+            raise ValueError("empty FeatureCollection")
+        return from_geojson(feats[0])
+    if t == "Feature":
+        return from_geojson(obj["geometry"])
+    coords = obj.get("coordinates")
+    if t == "Point":
+        return Geometry.point(float(coords[0]), float(coords[1]))
+    if t == "LineString":
+        return Geometry("LineString", points=np.asarray(coords, dtype=np.float64))
+    if t == "Polygon":
+        return Geometry("Polygon",
+                        polys=[[np.asarray(r, dtype=np.float64) for r in coords]])
+    if t == "MultiPolygon":
+        return Geometry("MultiPolygon",
+                        polys=[[np.asarray(r, dtype=np.float64) for r in poly]
+                               for poly in coords])
+    raise ValueError(f"unsupported GeoJSON type {t}")

@@ -1,0 +1,228 @@
+"""Affine geotransforms, bounding boxes and tile grids.
+
+Mirrors the coordinate plumbing the reference scatters across
+``utils/wms.go:487-532`` (canonical bbox / pixel resolution),
+``worker/gdalprocess/warp.go:103-155`` (geotransform handling) and the WMS
+tile conventions — rebuilt as small pure functions over numpy/jax arrays.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from .crs import CRS, EPSG3857, EPSG4326
+
+# Web-mercator world extent (what WMS EPSG:3857 tiles address).
+MERC_ORIGIN = 20037508.342789244
+
+
+@dataclass(frozen=True)
+class BBox:
+    """Axis-aligned bounding box in some CRS: (xmin, ymin, xmax, ymax)."""
+
+    xmin: float
+    ymin: float
+    xmax: float
+    ymax: float
+
+    def as_tuple(self) -> Tuple[float, float, float, float]:
+        return (self.xmin, self.ymin, self.xmax, self.ymax)
+
+    @property
+    def width(self) -> float:
+        return self.xmax - self.xmin
+
+    @property
+    def height(self) -> float:
+        return self.ymax - self.ymin
+
+    def intersects(self, other: "BBox") -> bool:
+        return not (self.xmax <= other.xmin or other.xmax <= self.xmin
+                    or self.ymax <= other.ymin or other.ymax <= self.ymin)
+
+    def intersection(self, other: "BBox") -> "BBox":
+        return BBox(max(self.xmin, other.xmin), max(self.ymin, other.ymin),
+                    min(self.xmax, other.xmax), min(self.ymax, other.ymax))
+
+    def union(self, other: "BBox") -> "BBox":
+        return BBox(min(self.xmin, other.xmin), min(self.ymin, other.ymin),
+                    max(self.xmax, other.xmax), max(self.ymax, other.ymax))
+
+    def is_empty(self) -> bool:
+        return self.xmax <= self.xmin or self.ymax <= self.ymin
+
+    def buffer(self, d: float) -> "BBox":
+        return BBox(self.xmin - d, self.ymin - d, self.xmax + d, self.ymax + d)
+
+    def to_polygon_wkt(self) -> str:
+        return (f"POLYGON(({self.xmin} {self.ymin},{self.xmax} {self.ymin},"
+                f"{self.xmax} {self.ymax},{self.xmin} {self.ymax},"
+                f"{self.xmin} {self.ymin}))")
+
+
+@dataclass(frozen=True)
+class GeoTransform:
+    """GDAL-style affine geotransform.
+
+    ``x = x0 + col*dx + row*rx``, ``y = y0 + col*ry + row*dy`` where
+    (x0, y0) is the outer corner of pixel (0, 0) and pixel coordinates are
+    measured at pixel centres as (col + 0.5, row + 0.5).
+    Matches the 6-tuple used throughout the reference
+    (`worker/gdalprocess/warp.go:118-131`).
+    """
+
+    x0: float
+    dx: float
+    rx: float  # row rotation/shear term for x
+    y0: float
+    ry: float  # column rotation/shear term for y
+    dy: float
+
+    @classmethod
+    def from_gdal(cls, g: Sequence[float]) -> "GeoTransform":
+        return cls(g[0], g[1], g[2], g[3], g[4], g[5])
+
+    def to_gdal(self) -> Tuple[float, ...]:
+        return (self.x0, self.dx, self.rx, self.y0, self.ry, self.dy)
+
+    @classmethod
+    def from_bbox(cls, bbox: BBox, width: int, height: int) -> "GeoTransform":
+        """North-up transform covering bbox with width x height pixels."""
+        return cls(bbox.xmin, bbox.width / width, 0.0,
+                   bbox.ymax, 0.0, -bbox.height / height)
+
+    # -- pixel <-> geo ------------------------------------------------------
+
+    def pixel_to_geo(self, col, row, xp=np):
+        """(col,row) pixel coords (fractional, origin at corner) -> (x,y)."""
+        x = self.x0 + col * self.dx + row * self.rx
+        y = self.y0 + col * self.ry + row * self.dy
+        return x, y
+
+    def geo_to_pixel(self, x, y, xp=np):
+        """(x,y) -> fractional (col,row)."""
+        det = self.dx * self.dy - self.rx * self.ry
+        inv_dx = self.dy / det
+        inv_rx = -self.rx / det
+        inv_ry = -self.ry / det
+        inv_dy = self.dx / det
+        dxv = x - self.x0
+        dyv = y - self.y0
+        col = inv_dx * dxv + inv_rx * dyv
+        row = inv_ry * dxv + inv_dy * dyv
+        return col, row
+
+    def bbox(self, width: int, height: int) -> BBox:
+        xs, ys = [], []
+        for c, r in ((0, 0), (width, 0), (0, height), (width, height)):
+            x, y = self.pixel_to_geo(c, r)
+            xs.append(x)
+            ys.append(y)
+        return BBox(min(xs), min(ys), max(xs), max(ys))
+
+    @property
+    def is_north_up(self) -> bool:
+        return self.rx == 0.0 and self.ry == 0.0
+
+    def resolution(self) -> Tuple[float, float]:
+        return (math.hypot(self.dx, self.ry), math.hypot(self.rx, self.dy))
+
+    def window(self, col0: int, row0: int) -> "GeoTransform":
+        """Transform for a sub-window starting at pixel (col0, row0)."""
+        x0, y0 = self.pixel_to_geo(col0, row0)
+        return GeoTransform(x0, self.dx, self.rx, y0, self.ry, self.dy)
+
+    def scaled(self, fx: float, fy: float) -> "GeoTransform":
+        """Transform for the same extent at resolution scaled by (fx, fy)
+        (fx > 1 means coarser pixels)."""
+        return GeoTransform(self.x0, self.dx * fx, self.rx * fy,
+                            self.y0, self.ry * fx, self.dy * fy)
+
+
+# ---------------------------------------------------------------------------
+# Reprojection of extents
+# ---------------------------------------------------------------------------
+
+def transform_bbox(bbox: BBox, src: CRS, dst: CRS, densify: int = 21) -> BBox:
+    """Reproject a bbox by densified edge sampling (the robust way GDAL's
+    transformer approximates reprojected extents; cf. `utils/wms.go:498-521`
+    which samples the 4 corners via OSR)."""
+    if src == dst:
+        return bbox
+    t = np.linspace(0.0, 1.0, densify)
+    xs = bbox.xmin + t * bbox.width
+    ys = bbox.ymin + t * bbox.height
+    ex = np.concatenate([xs, xs, np.full_like(t, bbox.xmin), np.full_like(t, bbox.xmax)])
+    ey = np.concatenate([np.full_like(t, bbox.ymin), np.full_like(t, bbox.ymax), ys, ys])
+    ox, oy = src.transform_to(dst, ex, ey)
+    ok = np.isfinite(ox) & np.isfinite(oy)
+    if not ok.any():
+        raise ValueError("bbox does not transform into destination CRS")
+    return BBox(float(np.min(ox[ok])), float(np.min(oy[ok])),
+                float(np.max(ox[ok])), float(np.max(oy[ok])))
+
+
+def canonical_bbox(bbox: BBox, crs: CRS) -> BBox:
+    """Canonicalise a request bbox into EPSG:3857, mirroring
+    `utils/wms.go:487-522` (used for zoom-level / overview decisions)."""
+    return transform_bbox(bbox, crs, EPSG3857)
+
+
+def pixel_resolution(bbox: BBox, crs: CRS, width: int, height: int) -> float:
+    """EPSG:3857 metres/pixel of a request, cf. `utils/wms.go:524-532`."""
+    c = canonical_bbox(bbox, crs)
+    return max(c.width / width, c.height / height)
+
+
+def suggest_output_size(src_gt: GeoTransform, src_w: int, src_h: int,
+                        src_crs: CRS, dst_crs: CRS,
+                        max_size: int = 65536) -> Tuple[BBox, int, int]:
+    """Suggest a destination extent + pixel size that roughly preserves source
+    resolution — the role of GDALSuggestedWarpOutput in the reference's extent
+    op (`worker/gdalprocess/warp.go:433-487`)."""
+    src_bbox = src_gt.bbox(src_w, src_h)
+    dst_bbox = transform_bbox(src_bbox, src_crs, dst_crs)
+    # estimate dst resolution by transforming the pixel diagonal at centre
+    cx = (src_bbox.xmin + src_bbox.xmax) / 2
+    cy = (src_bbox.ymin + src_bbox.ymax) / 2
+    rx, ry = src_gt.resolution()
+    x2, y2 = src_crs.transform_to(dst_crs, np.array([cx, cx + rx]), np.array([cy, cy + ry]))
+    dres = max(min(abs(float(x2[1] - x2[0])), abs(float(y2[1] - y2[0]))), 1e-9)
+    w = max(1, min(max_size, int(round(dst_bbox.width / dres))))
+    h = max(1, min(max_size, int(round(dst_bbox.height / dres))))
+    return dst_bbox, w, h
+
+
+# ---------------------------------------------------------------------------
+# Tile maths
+# ---------------------------------------------------------------------------
+
+def split_bbox(bbox: BBox, width: int, height: int,
+               tile_w: int, tile_h: int):
+    """Split an output raster into tiles, yielding
+    (tile_bbox, off_x, off_y, tw, th) — the WCS large-output decomposition
+    (`ows.go:815-833`)."""
+    gt = GeoTransform.from_bbox(bbox, width, height)
+    out = []
+    for row0 in range(0, height, tile_h):
+        th = min(tile_h, height - row0)
+        for col0 in range(0, width, tile_w):
+            tw = min(tile_w, width - col0)
+            x0, y0 = gt.pixel_to_geo(col0, row0)
+            x1, y1 = gt.pixel_to_geo(col0 + tw, row0 + th)
+            out.append((BBox(min(x0, x1), min(y0, y1), max(x0, x1), max(y0, y1)),
+                        col0, row0, tw, th))
+    return out
+
+
+def xyz_tile_bbox(z: int, x: int, y: int) -> BBox:
+    """EPSG:3857 bbox of a slippy-map tile (origin top-left)."""
+    n = 1 << z
+    size = 2 * MERC_ORIGIN / n
+    xmin = -MERC_ORIGIN + x * size
+    ymax = MERC_ORIGIN - y * size
+    return BBox(xmin, ymax - size, xmin + size, ymax)

@@ -1,0 +1,19 @@
+"""Test environment: force JAX onto CPU with 8 virtual devices so the
+multi-chip sharding paths are exercised without TPU hardware, and enable
+x64 so float64 coordinate math can be validated under jit.
+
+Note: env vars are not enough here — the container's sitecustomize imports
+jax and registers the TPU backend at interpreter startup, so we must use
+jax.config.update (backends initialize lazily, so this still works as long
+as no computation ran yet).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # for subprocesses we spawn
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_num_cpu_devices", 8)
