@@ -71,6 +71,20 @@ WGS84 = Ellipsoid(WGS84_A, WGS84_F)
 GRS80 = Ellipsoid(WGS84_A, GRS80_F)
 SPHERE = Ellipsoid(MODIS_SPHERE_R, 0.0)
 
+_ELLIPSOIDS = {
+    "WGS84": WGS84,
+    "GRS80": GRS80,
+    "GRS67": Ellipsoid(6378160.0, 1 / 298.247167427),
+    "WGS72": Ellipsoid(6378135.0, 1 / 298.26),
+    "bessel": Ellipsoid(6377397.155, 1 / 299.1528128),
+    "clrk66": Ellipsoid(6378206.4, 1 / 294.9786982),
+    "clrk80": Ellipsoid(6378249.145, 1 / 293.465),
+    "intl": Ellipsoid(6378388.0, 1 / 297.0),
+    "krass": Ellipsoid(6378245.0, 1 / 298.3),
+    "aust_SA": Ellipsoid(6378160.0, 1 / 298.25),
+    "sphere": Ellipsoid(6370997.0, 0.0),
+}
+
 
 # ---------------------------------------------------------------------------
 # Projection kernels (Snyder).  Each takes/returns radians-free degrees for
@@ -114,16 +128,16 @@ def _merc_inv(x, y, p, xp):
 
 def _webmerc_fwd(lon, lat, p, xp):
     a = p.ellps.a
-    x = a * _rad(lon, xp)
+    x = a * _rad(lon - p.lon0, xp) + p.x0
     lat = xp.clip(lat, -85.06, 85.06)
-    y = a * xp.log(xp.tan(math.pi / 4.0 + _rad(lat, xp) / 2.0))
+    y = a * xp.log(xp.tan(math.pi / 4.0 + _rad(lat, xp) / 2.0)) + p.y0
     return x, y
 
 
 def _webmerc_inv(x, y, p, xp):
     a = p.ellps.a
-    lon = _deg(x / a, xp)
-    lat = _deg(2.0 * xp.arctan(xp.exp(y / a)) - math.pi / 2.0, xp)
+    lon = p.lon0 + _deg((x - p.x0) / a, xp)
+    lat = _deg(2.0 * xp.arctan(xp.exp((y - p.y0) / a)) - math.pi / 2.0, xp)
     return lon, lat
 
 
@@ -476,6 +490,7 @@ class CRS:
             )
         inv_f = 1.0 / self.ellps.f if self.ellps.f else 0.0
         proj_names = {
+            "merc": "Mercator_1SP",
             "webmerc": "Mercator_1SP",
             "tmerc": "Transverse_Mercator",
             "aea": "Albers_Conic_Equal_Area",
@@ -506,10 +521,17 @@ class CRS:
 
     def to_proj4(self) -> str:
         e = self.ellps
-        ell = "+ellps=WGS84" if e.f else f"+R={e.a}"
+        if e.f == 0.0:
+            ell = f"+R={e.a}"
+        else:
+            name = next((n for n, el in _ELLIPSOIDS.items() if el == e), None)
+            ell = f"+ellps={name}" if name else f"+a={e.a} +rf={1.0 / e.f}"
         base = {
             "longlat": f"+proj=longlat {ell}",
-            "webmerc": f"+proj=merc +a={e.a} +b={e.a} +lon_0={self.lon0}",
+            "merc": (f"+proj=merc +lon_0={self.lon0} +k={self.k0} "
+                     f"+x_0={self.x0} +y_0={self.y0} {ell}"),
+            "webmerc": (f"+proj=merc +a={e.a} +b={e.a} +lon_0={self.lon0} "
+                        f"+x_0={self.x0} +y_0={self.y0}"),
             "tmerc": (f"+proj=tmerc +lat_0={self.lat0} +lon_0={self.lon0} "
                       f"+k={self.k0} +x_0={self.x0} +y_0={self.y0} {ell}"),
             "aea": (f"+proj=aea +lat_1={self.lat1} +lat_2={self.lat2} "
@@ -590,8 +612,11 @@ def _parse_proj4(s: str) -> CRS:
     elif kv.get("a") and kv.get("b"):
         a, b = float(kv["a"]), float(kv["b"])
         ellps = Ellipsoid(a, (a - b) / a)
-    elif kv.get("ellps") == "GRS80":
-        ellps = GRS80
+    elif kv.get("ellps"):
+        name = str(kv["ellps"])
+        if name not in _ELLIPSOIDS:
+            raise ValueError(f"unsupported ellipsoid {name!r}")
+        ellps = _ELLIPSOIDS[name]
     else:
         ellps = WGS84
     def f(name, default=0.0):
@@ -602,7 +627,8 @@ def _parse_proj4(s: str) -> CRS:
         # spherical (web) mercator only when explicitly spherical: +R, or
         # +a == +b; otherwise full ellipsoidal mercator
         if ellps.f == 0.0 or (kv.get("a") is not None and kv.get("a") == kv.get("b")):
-            return CRS("webmerc", Ellipsoid(ellps.a, 0.0), lon0=f("lon_0"))
+            return CRS("webmerc", Ellipsoid(ellps.a, 0.0), lon0=f("lon_0"),
+                       x0=f("x_0"), y0=f("y_0"))
         return CRS("merc", ellps, lon0=f("lon_0"), k0=f("k", f("k_0", 1.0)),
                    x0=f("x_0"), y0=f("y_0"))
     if proj in ("tmerc", "utm"):
@@ -674,7 +700,8 @@ def _parse_wkt(wkt: str) -> CRS:
         # is actually spherical ("Pseudo-Mercator"); detect it by name.
         if ellps.f == 0.0 or "pseudo-mercator" in wkt.lower() \
                 or "popular visualisation" in wkt.lower():
-            return CRS("webmerc", Ellipsoid(ellps.a, 0.0), lon0=lon0)
+            return CRS("webmerc", Ellipsoid(ellps.a, 0.0), lon0=lon0,
+                       x0=x0, y0=y0)
         return CRS("merc", ellps, lon0=lon0, k0=k0, x0=x0, y0=y0)
     if "geostationary" in pname:
         return CRS("geos", ellps, lon0=lon0,

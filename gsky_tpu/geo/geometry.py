@@ -317,13 +317,17 @@ def _fill_polygon(mask: np.ndarray, rings: List[np.ndarray], all_touched: bool):
     # Scanline fill with even-odd rule at pixel centres (row + 0.5),
     # vectorised over edges: for each edge find its active row span, compute
     # all its scanline x-intersections at once, then sort crossings per row.
+    def close(r):
+        if len(r) and (r[0][0] != r[-1][0] or r[0][1] != r[-1][1]):
+            return np.vstack([r, r[:1]])
+        return r
+
+    rings = [close(r) for r in rings]
     ey0, ey1, ex0, eslope = [], [], [], []
     for ring in rings:
         pts = ring
         if len(pts) < 3:
             continue
-        if pts[0][0] != pts[-1][0] or pts[0][1] != pts[-1][1]:
-            pts = np.vstack([pts, pts[:1]])
         x0, y0 = pts[:-1, 0], pts[:-1, 1]
         x1, y1 = pts[1:, 0], pts[1:, 1]
         nz = y0 != y1
@@ -370,7 +374,7 @@ def _fill_polygon(mask: np.ndarray, rings: List[np.ndarray], all_touched: bool):
                 if c1 >= 0 and c0 < width:
                     mask[row, max(c0, 0):min(c1, width - 1) + 1] = 1
     if all_touched:
-        # also burn every pixel the boundary passes through
+        # also burn every pixel the (closed) boundary passes through
         for ring in rings:
             _burn_lines(mask, ring)
 

@@ -1,0 +1,17 @@
+from .raster import (DTYPE_NP, GDAL_TYPES, Raster, nodata_mask)
+from .warp import coord_grid, warp_gather, warp
+from .mosaic import mosaic_first_valid, mosaic_weighted, compute_bit_mask
+from .scale import scale_to_byte
+from .palette import gradient_palette, apply_palette
+from .expr import compile_expr, parse_band_expressions
+from . import drill
+
+__all__ = [
+    "DTYPE_NP", "GDAL_TYPES", "Raster", "nodata_mask",
+    "coord_grid", "warp_gather", "warp",
+    "mosaic_first_valid", "mosaic_weighted", "compute_bit_mask",
+    "scale_to_byte",
+    "gradient_palette", "apply_palette",
+    "compile_expr", "parse_band_expressions",
+    "drill",
+]

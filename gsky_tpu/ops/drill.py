@@ -1,0 +1,121 @@
+"""Drill (WPS polygon time-series) reductions, on device.
+
+Port of the semantics of `worker/gdalprocess/drill.go:90-273` with the
+band axis as a batch dimension:
+
+- masked mean per band: pixels inside the rasterized polygon mask AND not
+  nodata; values outside [clip_lower, clip_upper] are excluded from the
+  mean but still counted when pixel-count mode asks for totals
+- pixel-count mode: value = fraction of valid pixels satisfying clip,
+  count = all valid pixels
+- deciles: sorted valid values (clip NOT applied, matching the reference);
+  step = n // (D+1); decile[i] = buf[(i+1)*step], averaged with the next
+  element when n % (D+1) == 0; n < D+1 falls back to cyclic padding
+- band strides: only endpoint bands are read; interior timesteps are
+  linearly interpolated between endpoint statistics
+  (`drill.go:119-214`)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BIG = jnp.float32(3.0e38)
+
+
+@functools.partial(jax.jit, static_argnames=("pixel_count",))
+def masked_mean(data, valid, clip_lower=-3.0e38, clip_upper=3.0e38,
+                pixel_count: bool = False):
+    """data (B, N) f32 (B bands, N pixels of the masked window), valid
+    (B, N) bool (mask & not-nodata).  Returns (value (B,), count (B,)).
+
+    Normal mode: value = mean of valid pixels within clip, count = number
+    contributing.  Pixel-count mode (reference `drill.go:155-171`):
+    value = fraction #{valid within clip} / #{valid}, count = #{valid}.
+    """
+    data = data.astype(jnp.float32)
+    inclip = valid & (data >= clip_lower) & (data <= clip_upper)
+    n_inclip = jnp.sum(inclip, axis=-1)
+    if pixel_count:
+        total = jnp.sum(valid, axis=-1)
+        value = jnp.where(total > 0, n_inclip / jnp.maximum(total, 1), 0.0)
+        # reference: sum of 1.0 per in-clip pixel / total valid
+        return value.astype(jnp.float32), total.astype(jnp.int32)
+    s = jnp.sum(jnp.where(inclip, data, 0.0), axis=-1)
+    value = jnp.where(n_inclip > 0, s / jnp.maximum(n_inclip, 1), 0.0)
+    return value.astype(jnp.float32), n_inclip.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_deciles",))
+def deciles(data, valid, n_deciles: int):
+    """Per-band deciles matching `computeDeciles` (`drill.go:229-273`).
+
+    data (B, N) f32, valid (B, N) bool -> (B, n_deciles) f32.
+    Bands with zero valid pixels return zeros (the caller zeroes them via
+    the count anyway, `drill.go:186-193`)."""
+    data = data.astype(jnp.float32)
+    B, N = data.shape
+    D = n_deciles
+    buf = jnp.sort(jnp.where(valid, data, _BIG), axis=-1)
+    n = jnp.sum(valid, axis=-1)  # (B,)
+    step = n // (D + 1)
+    is_even = (n % (D + 1)) == 0
+    i = jnp.arange(D)
+    # main path: idx = (i+1)*step, averaged with idx+1 when evenly divisible
+    nmax = jnp.maximum(n - 1, 0)[:, None]  # last VALID index, not padding
+    idx = (i[None, :] + 1) * step[:, None]
+    idx = jnp.clip(idx, 0, nmax)
+    idx2 = jnp.clip(idx + 1, 0, nmax)  # reference indexes past the end
+    # here (panic for n == D+1); clamping to the last valid value instead
+    v1 = jnp.take_along_axis(buf, idx, axis=-1)
+    v2 = jnp.take_along_axis(buf, idx2, axis=-1)
+    main = jnp.where(is_even[:, None], (v1 + v2) / 2.0, v1)
+    # padding path (n < D+1, n > 0): decile i takes buf[j] where j is the
+    # i-th element of the sorted multiset {k mod n repeated}; equivalently
+    # j = i // ceil(D/n) distributed cyclically.  Reference builds
+    # padding[k] = #{i in [0,D): i % n == k} and emits buf[k] that many
+    # times in order, i.e. j(i) = smallest k with sum(padding[:k+1]) > i.
+    nn = jnp.maximum(n, 1)
+    count_k = (D - jnp.arange(D)[None, :] - 1) // nn[:, None] + 1  # per k<n
+    count_k = jnp.where(jnp.arange(D)[None, :] < nn[:, None], count_k, 0)
+    cum = jnp.cumsum(count_k, axis=-1)
+    j = jnp.sum((i[None, None, :] >= cum[:, :, None]).astype(jnp.int32),
+                axis=1)  # (B, D): how many cums <= i
+    j = jnp.clip(j, 0, N - 1)
+    pad = jnp.take_along_axis(buf, j, axis=-1)
+    out = jnp.where((step > 0)[:, None], main, pad)
+    return jnp.where((n > 0)[:, None], out, 0.0)
+
+
+def interp_strided(values: np.ndarray, counts: np.ndarray,
+                   band_positions: np.ndarray, n_bands: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Linear interpolation of statistics between strided endpoint bands —
+    the approx fast path of `drill.go:119-214`.
+
+    values/counts: (K, C) stats at ``band_positions`` (sorted, includes 0
+    and n_bands-1); returns (n_bands, C) with interior rows interpolated
+    between neighbouring endpoints: value = v0 + ip*beta with beta =
+    (v1-v0)/(gap), count = round((c0+c1)/2).
+    """
+    K, C = values.shape
+    out_v = np.zeros((n_bands, C), dtype=np.float64)
+    out_c = np.zeros((n_bands, C), dtype=np.int32)
+    for k in range(K):
+        out_v[band_positions[k]] = values[k]
+        out_c[band_positions[k]] = counts[k]
+    for k in range(K - 1):
+        b0, b1 = band_positions[k], band_positions[k + 1]
+        gap = b1 - b0
+        if gap <= 1:
+            continue
+        beta = (values[k + 1] - values[k]) / gap
+        cmid = np.round((counts[k] + counts[k + 1]) / 2.0).astype(np.int32)
+        for ip in range(1, gap):
+            out_v[b0 + ip] = values[k] + ip * beta
+            out_c[b0 + ip] = cmid
+    return out_v, out_c

@@ -1,0 +1,329 @@
+"""Band-expression compiler: govaluate-style strings -> jax functions.
+
+The reference parses `rgb_products` entries like ``"ndvi = (nir-red)/(nir+red)"``
+into govaluate ASTs and interprets them per pixel in the merger
+(`utils/config.go:997-1062`, `processor/tile_merger.go:654-731`).  Here the
+same grammar compiles once into a jax-traceable closure, so expression
+evaluation fuses into the rest of the tile program on TPU and is evaluated
+for all pixels in one shot.
+
+Supported grammar (superset of what GSKY configs use):
+  numbers, band identifiers, + - * / % **, unary -, parentheses,
+  comparisons (== != < <= > >=) yielding 0/1, && || !, ternary ?:,
+  functions: abs sqrt log log10 exp sin cos tan floor ceil min max pow
+
+Nodata semantics follow the merger: a pixel is valid in the output iff it
+is valid in EVERY variable the expression references.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+_TOKEN_RE = re.compile(r"""
+    (?P<num>\d+\.\d*(?:[eE][-+]?\d+)?|\.\d+(?:[eE][-+]?\d+)?|\d+(?:[eE][-+]?\d+)?)
+  | (?P<name>\[[^\]]+\]|[A-Za-z_][A-Za-z0-9_:.#]*)
+  | (?P<op>\*\*|==|!=|<=|>=|&&|\|\||[-+*/%()<>!?:,])
+  | (?P<ws>\s+)
+""", re.X)
+
+_FUNCS = {
+    "abs": jnp.abs, "sqrt": jnp.sqrt, "log": jnp.log, "log10": jnp.log10,
+    "exp": jnp.exp, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "floor": jnp.floor, "ceil": jnp.ceil,
+    "min": jnp.minimum, "max": jnp.maximum, "pow": jnp.power,
+}
+
+
+def tokenize(src: str) -> List[Tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            raise ValueError(f"bad token at {src[pos:pos+10]!r} in {src!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        out.append((kind, m.group()))
+    out.append(("eof", ""))
+    return out
+
+
+# AST nodes: ("num", v) ("var", name) ("un", op, a) ("bin", op, a, b)
+# ("tern", c, a, b) ("call", fname, [args])
+
+class _Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def take(self, val=None):
+        k, v = self.toks[self.i]
+        if val is not None and v != val:
+            raise ValueError(f"expected {val!r}, got {v!r}")
+        self.i += 1
+        return k, v
+
+    def parse(self):
+        node = self.ternary()
+        if self.peek()[0] != "eof":
+            raise ValueError(f"trailing tokens at {self.peek()[1]!r}")
+        return node
+
+    def ternary(self):
+        cond = self.or_()
+        if self.peek()[1] == "?":
+            self.take("?")
+            a = self.ternary()
+            self.take(":")
+            b = self.ternary()
+            return ("tern", cond, a, b)
+        return cond
+
+    def or_(self):
+        node = self.and_()
+        while self.peek()[1] == "||":
+            self.take()
+            node = ("bin", "||", node, self.and_())
+        return node
+
+    def and_(self):
+        node = self.cmp()
+        while self.peek()[1] == "&&":
+            self.take()
+            node = ("bin", "&&", node, self.cmp())
+        return node
+
+    def cmp(self):
+        node = self.add()
+        while self.peek()[1] in ("==", "!=", "<", "<=", ">", ">="):
+            op = self.take()[1]
+            node = ("bin", op, node, self.add())
+        return node
+
+    def add(self):
+        node = self.mul()
+        while self.peek()[1] in ("+", "-"):
+            op = self.take()[1]
+            node = ("bin", op, node, self.mul())
+        return node
+
+    def mul(self):
+        node = self.unary()
+        while self.peek()[1] in ("*", "/", "%"):
+            op = self.take()[1]
+            node = ("bin", op, node, self.unary())
+        return node
+
+    def unary(self):
+        if self.peek()[1] == "-":
+            self.take()
+            return ("un", "-", self.unary())
+        if self.peek()[1] == "!":
+            self.take()
+            return ("un", "!", self.unary())
+        return self.power()
+
+    def power(self):
+        node = self.atom()
+        if self.peek()[1] == "**":
+            self.take()
+            return ("bin", "**", node, self.unary())  # right assoc
+        return node
+
+    def atom(self):
+        k, v = self.peek()
+        if v == "(":
+            self.take("(")
+            node = self.ternary()
+            self.take(")")
+            return node
+        if k == "num":
+            self.take()
+            return ("num", float(v))
+        if k == "name":
+            self.take()
+            name = v[1:-1] if v.startswith("[") else v
+            if self.peek()[1] == "(" and name in _FUNCS:
+                self.take("(")
+                args = [self.ternary()]
+                while self.peek()[1] == ",":
+                    self.take(",")
+                    args.append(self.ternary())
+                self.take(")")
+                return ("call", name, args)
+            return ("var", name)
+        raise ValueError(f"unexpected token {v!r}")
+
+
+def _collect_vars(node, acc):
+    tag = node[0]
+    if tag == "var":
+        acc.append(node[1])
+    elif tag == "un":
+        _collect_vars(node[2], acc)
+    elif tag == "bin":
+        _collect_vars(node[2], acc)
+        _collect_vars(node[3], acc)
+    elif tag == "tern":
+        for n in node[1:]:
+            _collect_vars(n, acc)
+    elif tag == "call":
+        for n in node[2]:
+            _collect_vars(n, acc)
+
+
+def _emit(node, env, xp):
+    tag = node[0]
+    if tag == "num":
+        return node[1]
+    if tag == "var":
+        return env[node[1]]
+    if tag == "un":
+        a = _emit(node[2], env, xp)
+        if node[1] == "-":
+            return -a
+        return xp.where(a != 0, 0.0, 1.0)
+    if tag == "bin":
+        op = node[1]
+        a = _emit(node[2], env, xp)
+        b = _emit(node[3], env, xp)
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return a / b
+        if op == "%":
+            return a % b
+        if op == "**":
+            return a ** b
+        if op == "==":
+            return (a == b) * 1.0
+        if op == "!=":
+            return (a != b) * 1.0
+        if op == "<":
+            return (a < b) * 1.0
+        if op == "<=":
+            return (a <= b) * 1.0
+        if op == ">":
+            return (a > b) * 1.0
+        if op == ">=":
+            return (a >= b) * 1.0
+        if op == "&&":
+            return ((a != 0) & (b != 0)) * 1.0
+        if op == "||":
+            return ((a != 0) | (b != 0)) * 1.0
+        raise ValueError(op)
+    if tag == "tern":
+        c = _emit(node[1], env, xp)
+        a = _emit(node[2], env, xp)
+        b = _emit(node[3], env, xp)
+        return xp.where(c != 0, a, b)
+    if tag == "call":
+        args = [_emit(n, env, xp) for n in node[2]]
+        return _FUNCS[node[1]](*args)
+    raise ValueError(tag)
+
+
+@dataclass
+class CompiledExpr:
+    """A compiled band expression: callable on dicts of arrays."""
+
+    src: str
+    variables: List[str]
+    _ast: tuple = field(repr=False, default=None)
+
+    def __call__(self, env: Dict[str, "jnp.ndarray"], xp=jnp):
+        missing = [v for v in self.variables if v not in env]
+        if missing:
+            raise KeyError(f"expression {self.src!r} missing bands {missing}")
+        return _emit(self._ast, env, xp)
+
+    def eval_masked(self, env, valid_env, xp=jnp):
+        """Evaluate + combine validity: output valid iff every referenced
+        band is valid (merger semantics, `tile_merger.go:684-714`)."""
+        out = self(env, xp)
+        ok = None
+        for v in self.variables:
+            m = valid_env[v]
+            ok = m if ok is None else (ok & m)
+        if ok is None:
+            ok = xp.ones(out.shape, bool)
+        # expressions can create new NaN/Inf (division by zero etc.)
+        ok = ok & xp.isfinite(out)
+        return xp.where(ok, out, 0.0), ok
+
+
+_cache: Dict[str, CompiledExpr] = {}
+
+
+def compile_expr(src: str) -> CompiledExpr:
+    if src in _cache:
+        return _cache[src]
+    ast = _Parser(tokenize(src)).parse()
+    vars_ = []
+    _collect_vars(ast, vars_)
+    seen = set()
+    uniq = [v for v in vars_ if not (v in seen or seen.add(v))]
+    ce = CompiledExpr(src, uniq, ast)
+    _cache[src] = ce
+    return ce
+
+
+@dataclass
+class BandExpressions:
+    """Parsed `rgb_products` list — mirror of the reference's
+    `BandExpressions` struct (`utils/config.go:997-1062`)."""
+
+    expressions: List[CompiledExpr]
+    expr_names: List[str]          # output namespace per entry
+    var_list: List[str]            # union of referenced bands (fetch list)
+    expr_var_ref: List[List[str]]  # per-entry referenced bands
+    expr_text: List[str]
+    passthrough: bool              # all entries are bare band names
+
+
+def parse_band_expressions(bands: Sequence[str]) -> BandExpressions:
+    """Parse entries like ``"ndvi = (nir-red)/(nir+red)"`` or plain band
+    names; ``name = expr`` binds the output namespace.  Split-on-'='
+    semantics match `utils/config.go:1002-1019` (at most one '=')."""
+    exprs, names, texts, var_refs = [], [], [], []
+    var_list: List[str] = []
+    seen = set()
+    has_expr = False
+    for b in bands:
+        parts = [p.strip() for p in b.split("=")]
+        if not parts or any(not p for p in parts):
+            raise ValueError(f"invalid expression: {b!r}")
+        if len(parts) == 1:
+            name = body = parts[0]
+        elif len(parts) == 2:
+            name, body = parts[0], parts[1]
+        else:
+            raise ValueError(f"invalid expression: {b!r}")
+        ce = compile_expr(body)
+        if ce._ast[0] != "var":
+            has_expr = True
+        exprs.append(ce)
+        names.append(name)
+        texts.append(b)
+        var_refs.append(list(ce.variables))
+        for v in ce.variables:
+            if v not in seen:
+                seen.add(v)
+                var_list.append(v)
+    return BandExpressions(exprs, names, var_list, var_refs, texts,
+                           passthrough=not has_expr)

@@ -1,0 +1,70 @@
+"""Colour palettes: 256-entry gradient ramps + device LUT application.
+
+Parity with `utils/palette.go`: interpolated mode divides 0..255 into
+len(colours)-1 sections (early sections get the remainder "bonus" pixel),
+linearly interpolating R, G, B with integer truncation and holding A from
+the section's lower colour; non-interpolated mode paints equal blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RGBA = Tuple[int, int, int, int]
+
+
+def gradient_palette(colours: Sequence[RGBA], interpolate: bool = True) -> np.ndarray:
+    """Build the 256x4 uint8 ramp (`utils/palette.go:27-69`)."""
+    colours = [tuple(int(x) for x in c) for c in colours]
+    ramp = np.zeros((256, 4), dtype=np.uint8)
+    if interpolate:
+        if len(colours) < 2:
+            raise ValueError("interpolated palette needs >= 2 colours")
+        bins = len(colours) - 1
+        section = 256 // bins
+        bonus = 256 - section * bins
+        index = 0
+        for s in range(bins):
+            a, b = colours[s], colours[s + 1]
+            length = section + (1 if s < bonus else 0)
+            for i in range(length):
+                # integer interpolation; Go-style division truncating
+                # toward zero (matters for descending channels)
+                def tdiv(n, d):
+                    return -((-n) // d) if n < 0 else n // d
+                ramp[index, 0] = (a[0] + tdiv(i * (b[0] - a[0]), section)) & 0xFF
+                ramp[index, 1] = (a[1] + tdiv(i * (b[1] - a[1]), section)) & 0xFF
+                ramp[index, 2] = (a[2] + tdiv(i * (b[2] - a[2]), section)) & 0xFF
+                ramp[index, 3] = a[3]
+                index += 1
+    else:
+        bins = len(colours)
+        section = 256 // bins
+        bonus = 256 - section * bins
+        index = 0
+        for s, c in enumerate(colours):
+            length = section + (1 if s < bonus else 0)
+            ramp[index:index + length] = c
+            index += length
+    return ramp
+
+
+@jax.jit
+def apply_palette(byte_img, lut):
+    """byte_img (H, W) uint8 (255 = nodata), lut (256, 4) uint8 ->
+    (H, W, 4) RGBA.  Index 255 should map to transparent; the caller
+    ensures lut[255] = (0,0,0,0) via `with_nodata_entry`."""
+    return lut[byte_img.astype(jnp.int32)]
+
+
+def with_nodata_entry(lut: np.ndarray) -> np.ndarray:
+    """Return a copy whose 0xFF entry is fully transparent (the PNG encoder
+    in `utils/ogc_encoders.go:82-142` treats 0xFF as the transparent
+    nodata index)."""
+    out = lut.copy()
+    out[255] = (0, 0, 0, 0)
+    return out

@@ -1,0 +1,88 @@
+"""Raster value types.
+
+The reference moves typed pixel buffers around as type-erased byte slices
+(`processor/tile_types.go` FlexRaster + the unsafe.SliceHeader casts in
+`tile_merger.go`).  On TPU everything computes in float32 with an explicit
+validity mask; the declared GDAL-style type tag survives for encode time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..geo.crs import CRS
+from ..geo.transform import BBox, GeoTransform
+
+# GDAL-style type names used throughout the reference
+# (`utils/ogc_encoders.go:253`, FlexRaster.Type).
+GDAL_TYPES = ("Byte", "SignedByte", "Int16", "UInt16", "Int32", "UInt32",
+              "Float32", "Float64")
+
+DTYPE_NP = {
+    "Byte": np.uint8,
+    "SignedByte": np.int8,
+    "Int16": np.int16,
+    "UInt16": np.uint16,
+    "Int32": np.int32,
+    "UInt32": np.uint32,
+    "Float32": np.float32,
+    "Float64": np.float64,
+}
+
+NP_TO_GDAL = {np.dtype(v): k for k, v in DTYPE_NP.items()}
+
+
+def gdal_type_of(arr: np.ndarray) -> str:
+    return NP_TO_GDAL[arr.dtype]
+
+
+def nodata_mask(data, nodata, xp=np):
+    """True where VALID.  NaN nodata means 'NaN is nodata'; NaN data values
+    are always invalid (matches the reference's float equality semantics
+    where NaN != NaN would otherwise leak NaNs into mosaics)."""
+    finite = ~xp.isnan(data) if data.dtype.kind == "f" else xp.ones(data.shape, bool)
+    if nodata is None:
+        return finite
+    if isinstance(nodata, float) and np.isnan(nodata):
+        return finite
+    return finite & (data != nodata)
+
+
+@dataclass
+class Raster:
+    """A decoded raster band (host side): data + georeferencing.
+
+    The device pipeline consumes `.data` as float32 plus a validity mask;
+    `dtype` keeps the declared storage type for encoders.
+    """
+
+    data: np.ndarray          # (H, W) in storage dtype
+    gt: GeoTransform
+    crs: CRS
+    nodata: Optional[float] = None
+    namespace: str = ""
+    timestamp: float = 0.0    # unix seconds; mosaic priority
+
+    @property
+    def dtype(self) -> str:
+        return gdal_type_of(self.data)
+
+    @property
+    def height(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.data.shape[1]
+
+    def bbox(self) -> BBox:
+        return self.gt.bbox(self.width, self.height)
+
+    def valid_mask(self) -> np.ndarray:
+        return nodata_mask(self.data, self.nodata)
+
+    def astype_f32(self) -> np.ndarray:
+        return self.data.astype(np.float32)

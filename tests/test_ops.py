@@ -1,0 +1,437 @@
+"""Ops layer tests: warp gather, mosaic, scaler, palette, expressions,
+drill reductions — each validated against independent numpy reference
+implementations of the documented semantics."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gsky_tpu.geo.crs import EPSG3857, EPSG4326, parse_crs
+from gsky_tpu.geo.transform import BBox, GeoTransform
+from gsky_tpu.ops import (apply_palette, compile_expr, compute_bit_mask,
+                          coord_grid, gradient_palette, mosaic_first_valid,
+                          mosaic_weighted, parse_band_expressions,
+                          scale_to_byte, warp, warp_gather)
+from gsky_tpu.ops import drill as D
+from gsky_tpu.ops.mosaic import mosaic_stack_host, priority_order
+from gsky_tpu.ops.palette import with_nodata_entry
+from gsky_tpu.ops.warp import pick_overview, src_window, warp_gather_batch
+
+
+class TestCoordGrid:
+    def test_identity_same_crs(self):
+        gt = GeoTransform(0.0, 1.0, 0.0, 10.0, 0.0, -1.0)
+        rows, cols = coord_grid(gt, EPSG4326, 10, 10, gt, EPSG4326)
+        # dst pixel (0,0) centre -> src index (0,0)
+        assert rows[0, 0] == pytest.approx(0.0)
+        assert cols[0, 0] == pytest.approx(0.0)
+        assert rows[9, 9] == pytest.approx(9.0)
+
+    def test_downsample_2x(self):
+        src_gt = GeoTransform(0.0, 0.5, 0.0, 10.0, 0.0, -0.5)
+        dst_gt = GeoTransform(0.0, 1.0, 0.0, 10.0, 0.0, -1.0)
+        rows, cols = coord_grid(dst_gt, EPSG4326, 5, 5, src_gt, EPSG4326)
+        # dst pixel 0 centre (0.5 deg) -> src index 0.5 (between px 0,1)
+        assert cols[0, 0] == pytest.approx(0.5)
+        assert cols[0, 1] == pytest.approx(2.5)
+
+    def test_reprojection_consistency(self):
+        # a 3857 tile over a 4326 source: corners must map to the right
+        # lon/lat pixels
+        src_gt = GeoTransform(140.0, 0.01, 0.0, -30.0, 0.0, -0.01)
+        tile = BBox(*EPSG3857.from_lonlat(148.0, -36.0),
+                    *EPSG3857.from_lonlat(150.0, -34.0))
+        dst_gt = GeoTransform.from_bbox(tile, 64, 64)
+        rows, cols = coord_grid(dst_gt, EPSG3857, 64, 64, src_gt, EPSG4326)
+        # top-left dst pixel ~ lon 148, lat -34 -> col (148-140)/0.01 = 800
+        assert cols[0, 0] == pytest.approx(800, abs=2)
+        assert rows[0, 0] == pytest.approx(400, abs=2)  # (-30--34)/0.01
+
+    def test_src_window(self):
+        rows = np.array([[10.2, 10.8], [40.1, 40.9]])
+        cols = np.array([[5.0, 80.0], [5.5, 80.5]])
+        w = src_window(rows, cols, 100, 100, margin=2)
+        assert w == (3, 8, 81, 36)  # col0,row0,w,h
+
+    def test_src_window_miss(self):
+        rows = np.full((4, 4), np.nan)
+        assert src_window(rows, rows, 100, 100) is None
+
+    def test_pick_overview(self):
+        cols, rows = np.meshgrid(np.arange(0, 64, 1.0), np.arange(0, 64, 1.0))
+        assert pick_overview(rows * 4, cols * 4, (1, 2, 4, 8)) == 4
+        assert pick_overview(rows, cols, (1, 2, 4, 8)) == 1
+
+
+def _np_nearest(src, valid, rows, cols, nodata=-1.0):
+    H, W = src.shape
+    out = np.full(rows.shape, 0.0, np.float32)
+    ok = np.zeros(rows.shape, bool)
+    ri = np.round(rows).astype(int)
+    ci = np.round(cols).astype(int)
+    for i in np.ndindex(rows.shape):
+        r, c = ri[i], ci[i]
+        if np.isfinite(rows[i]) and 0 <= r < H and 0 <= c < W and valid[r, c]:
+            out[i] = src[r, c]
+            ok[i] = True
+    return out, ok
+
+
+class TestWarpGather:
+    def setup_method(self):
+        rng = np.random.default_rng(42)
+        self.src = rng.uniform(0, 100, (33, 37)).astype(np.float32)
+        self.valid = rng.uniform(0, 1, (33, 37)) > 0.2
+        self.rows = rng.uniform(-3, 36, (16, 16))
+        self.cols = rng.uniform(-3, 40, (16, 16))
+
+    def test_nearest_matches_numpy(self):
+        out, ok = warp_gather(jnp.asarray(self.src), jnp.asarray(self.valid),
+                              jnp.asarray(self.rows), jnp.asarray(self.cols),
+                              "near")
+        ref_out, ref_ok = _np_nearest(self.src, self.valid, self.rows, self.cols)
+        np.testing.assert_array_equal(np.asarray(ok), ref_ok)
+        np.testing.assert_allclose(np.asarray(out)[ref_ok], ref_out[ref_ok])
+
+    def test_bilinear_interior_exact(self):
+        # all-valid source, in-bounds coords: classic bilinear
+        src = np.arange(25, dtype=np.float32).reshape(5, 5)
+        valid = np.ones((5, 5), bool)
+        rows = np.array([[1.5]]); cols = np.array([[2.25]])
+        out, ok = warp_gather(jnp.asarray(src), jnp.asarray(valid),
+                              jnp.asarray(rows), jnp.asarray(cols), "bilinear")
+        # value = 5*1.5 + 2.25
+        assert np.asarray(out)[0, 0] == pytest.approx(9.75, rel=1e-6)
+        assert np.asarray(ok)[0, 0]
+
+    def test_bilinear_nodata_renormalises(self):
+        src = np.array([[10.0, 20.0], [30.0, 40.0]], np.float32)
+        valid = np.array([[True, False], [True, True]])
+        rows = np.array([[0.5]]); cols = np.array([[0.5]])
+        out, ok = warp_gather(jnp.asarray(src), jnp.asarray(valid),
+                              jnp.asarray(rows), jnp.asarray(cols), "bilinear")
+        # weights 0.25 each; valid taps 10,30,40 -> (10+30+40)/3
+        assert np.asarray(out)[0, 0] == pytest.approx((10 + 30 + 40) / 3, rel=1e-5)
+
+    def test_cubic_reproduces_linear_ramp(self):
+        # Catmull-Rom exactly reproduces linear functions
+        src = np.outer(np.arange(8), np.ones(8)).astype(np.float32) * 3 + 1
+        valid = np.ones((8, 8), bool)
+        rows = np.array([[2.3, 3.7], [4.25, 2.5]])
+        cols = np.array([[3.1, 2.2], [4.4, 5.5]])
+        out, ok = warp_gather(jnp.asarray(src), jnp.asarray(valid),
+                              jnp.asarray(rows), jnp.asarray(cols), "cubic")
+        np.testing.assert_allclose(np.asarray(out), rows * 3 + 1, rtol=1e-5)
+        assert np.asarray(ok).all()
+
+    def test_nan_coords_invalid(self):
+        src = np.ones((4, 4), np.float32)
+        valid = np.ones((4, 4), bool)
+        rows = np.array([[np.nan, 1.0]])
+        cols = np.array([[1.0, np.nan]])
+        for m in ("near", "bilinear", "cubic"):
+            _, ok = warp_gather(jnp.asarray(src), jnp.asarray(valid),
+                                jnp.asarray(rows), jnp.asarray(cols), m)
+            assert not np.asarray(ok).any(), m
+
+    def test_batch(self):
+        B = 3
+        src = np.random.default_rng(0).uniform(0, 1, (B, 8, 8)).astype(np.float32)
+        valid = np.ones((B, 8, 8), bool)
+        rows = np.tile(np.linspace(0, 7, 4)[None, :, None], (B, 1, 4))
+        cols = np.tile(np.linspace(0, 7, 4)[None, None, :], (B, 4, 1))
+        out, ok = warp_gather_batch(jnp.asarray(src), jnp.asarray(valid),
+                                    jnp.asarray(rows), jnp.asarray(cols), "near")
+        assert out.shape == (B, 4, 4)
+        for b in range(B):
+            o, k = warp_gather(jnp.asarray(src[b]), jnp.asarray(valid[b]),
+                               jnp.asarray(rows[b]), jnp.asarray(cols[b]), "near")
+            np.testing.assert_array_equal(np.asarray(out[b]), np.asarray(o))
+
+    def test_end_to_end_warp_identity(self):
+        # same grid in/out -> identity for nearest
+        gt = GeoTransform(0, 1, 0, 10, 0, -1)
+        data = np.arange(100, dtype=np.int16).reshape(10, 10)
+        out, ok = warp(data, gt, EPSG4326, None, gt, EPSG4326, 10, 10, "near")
+        np.testing.assert_allclose(out, data.astype(np.float32))
+        assert ok.all()
+
+
+class TestMosaic:
+    def test_priority_order(self):
+        # newest first; ties broken by later arrival first
+        ts = [100.0, 300.0, 200.0, 300.0]
+        assert priority_order(ts) == [3, 1, 2, 0]
+
+    def test_newest_wins_older_fills_holes(self):
+        # matches tile_merger.go semantics via a sequential reference
+        rng = np.random.default_rng(7)
+        T, H, W = 4, 8, 8
+        nodata = -9.0
+        stamps = [10.0, 30.0, 20.0, 30.0]
+        rasters = []
+        for t in range(T):
+            d = rng.uniform(0, 50, (H, W)).astype(np.float32)
+            d[rng.uniform(0, 1, (H, W)) > 0.6] = nodata
+            rasters.append(d)
+        # exact reference semantics: iterate stamps desc; within equal
+        # stamp group, arrival order, each >= canvas stamp -> overwrite
+        canvas = np.full((H, W), nodata, np.float32)
+        canvas_ts = 0.0
+        for stamp in sorted(set(stamps), reverse=True):
+            for i in range(T):
+                if stamps[i] != stamp:
+                    continue
+                v = rasters[i] != nodata
+                if stamp >= canvas_ts:
+                    canvas[v] = rasters[i][v]
+                    canvas_ts = stamp
+                else:
+                    fill = v & (canvas == nodata)
+                    canvas[fill] = rasters[i][fill]
+        out, ok = mosaic_stack_host(
+            [r for r in rasters], [r != nodata for r in rasters], stamps)
+        got = np.where(ok, out, nodata)
+        np.testing.assert_array_equal(got, canvas)
+
+    def test_exclude_mask(self):
+        a = np.full((2, 2), 5.0, np.float32)
+        b = np.full((2, 2), 9.0, np.float32)
+        excl = np.array([[True, False], [False, False]])
+        out, ok = mosaic_stack_host([a, b], [np.ones((2, 2), bool)] * 2,
+                                    [2.0, 1.0],
+                                    exclude_masks=[excl, np.zeros((2, 2), bool)])
+        assert out[0, 0] == 9.0  # newest excluded there -> older fills
+        assert out[1, 1] == 5.0
+
+    def test_weighted(self):
+        a = np.full((2, 2), 10.0, np.float32)
+        b = np.full((2, 2), 20.0, np.float32)
+        out, ok = mosaic_stack_host([a, b], [np.ones((2, 2), bool)] * 2,
+                                    [1.0, 2.0], weights=[1.0, 3.0])
+        # priority order: b first (w=3), a (w=1) -> (3*20+1*10)/4 = 17.5
+        assert out[0, 0] == pytest.approx(17.5)
+
+    def test_bit_mask(self):
+        data = np.array([0b100000, 0b000001, 0b100001], np.uint8)
+        m = compute_bit_mask(data, "100000")
+        np.testing.assert_array_equal(np.asarray(m), [True, False, True])
+        m2 = compute_bit_mask(data, None, ["000001", "000001"])
+        np.testing.assert_array_equal(np.asarray(m2), [False, True, True])
+
+
+class TestScale:
+    def test_explicit_params(self):
+        data = np.array([[0.0, 50.0, 100.0, 300.0]], np.float32)
+        valid = np.ones((1, 4), bool)
+        b = scale_to_byte(jnp.asarray(data), jnp.asarray(valid),
+                          offset=0.0, scale=1.0, clip=254.0)
+        np.testing.assert_array_equal(np.asarray(b), [[0, 50, 100, 254]])
+
+    def test_clip_derived_scale(self):
+        data = np.array([[0.0, 5.0, 10.0]], np.float32)
+        valid = np.ones((1, 3), bool)
+        b = scale_to_byte(jnp.asarray(data), jnp.asarray(valid),
+                          offset=0.0, scale=0.0, clip=10.0)
+        # scale = 254/10
+        np.testing.assert_array_equal(np.asarray(b), [[0, 127, 254]])
+
+    def test_auto_minmax(self):
+        data = np.array([[10.0, 20.0, 30.0, -5.0]], np.float32)
+        valid = np.array([[True, True, True, False]])
+        b = scale_to_byte(jnp.asarray(data), jnp.asarray(valid), auto=True)
+        arr = np.asarray(b)
+        assert arr[0, 0] == 0
+        assert arr[0, 2] == 254
+        assert arr[0, 3] == 255  # nodata byte
+        assert arr[0, 1] == int(np.floor((20 - 10) * 254.0 / 20))
+
+    def test_auto_degenerate(self):
+        data = np.full((2, 2), 7.0, np.float32)
+        b = scale_to_byte(jnp.asarray(data), jnp.ones((2, 2), bool), auto=True)
+        assert (np.asarray(b) == 0).all()  # (7-7)*254/0.1 = 0
+
+    def test_log_scale(self):
+        data = np.array([[1.0, 10.0, 100.0, 0.0]], np.float32)
+        valid = np.ones((1, 4), bool)
+        b = scale_to_byte(jnp.asarray(data), jnp.asarray(valid),
+                          offset=0.0, scale=127.0, clip=2.0, colour_scale=1)
+        arr = np.asarray(b)
+        np.testing.assert_array_equal(arr[0, :3], [0, 127, 254])
+        assert arr[0, 3] == 255  # log10(0) = -inf -> nodata
+
+
+class TestPalette:
+    def test_two_colour_ramp(self):
+        lut = gradient_palette([(0, 0, 0, 255), (255, 255, 255, 255)])
+        assert lut.shape == (256, 4)
+        assert tuple(lut[0]) == (0, 0, 0, 255)
+        assert lut[255, 0] == 255 * 255 // 256  # go integer interpolation
+        assert np.all(np.diff(lut[:, 0].astype(int)) >= 0)
+
+    def test_block_palette(self):
+        lut = gradient_palette([(255, 0, 0, 255), (0, 255, 0, 255),
+                                (0, 0, 255, 255), (9, 9, 9, 255)],
+                               interpolate=False)
+        assert tuple(lut[0][:3]) == (255, 0, 0)
+        assert tuple(lut[255][:3]) == (9, 9, 9)
+
+    def test_apply(self):
+        lut = with_nodata_entry(
+            gradient_palette([(0, 0, 0, 255), (255, 255, 255, 255)]))
+        img = np.array([[0, 254, 255]], np.uint8)
+        rgba = np.asarray(apply_palette(jnp.asarray(img), jnp.asarray(lut)))
+        assert rgba.shape == (1, 3, 4)
+        assert rgba[0, 2, 3] == 0  # nodata transparent
+
+
+class TestExpr:
+    def test_ndvi(self):
+        ce = compile_expr("(nir - red) / (nir + red)")
+        assert ce.variables == ["nir", "red"]
+        nir = jnp.asarray(np.array([0.8, 0.5], np.float32))
+        red = jnp.asarray(np.array([0.2, 0.5], np.float32))
+        out = ce({"nir": nir, "red": red})
+        np.testing.assert_allclose(np.asarray(out), [0.6, 0.0], atol=1e-6)
+
+    def test_precedence_and_power(self):
+        ce = compile_expr("2 + 3 * 4 ** 2 / 8")
+        assert float(ce({}, xp=np)) == pytest.approx(8.0)
+
+    def test_ternary_comparison(self):
+        ce = compile_expr("b1 > 5 ? b1 * 2 : 0 - 1")
+        out = ce({"b1": jnp.asarray(np.array([3.0, 7.0], np.float32))})
+        np.testing.assert_allclose(np.asarray(out), [-1.0, 14.0])
+
+    def test_masked_eval(self):
+        ce = compile_expr("a / b")
+        a = jnp.asarray(np.array([1.0, 2.0, 3.0], np.float32))
+        b = jnp.asarray(np.array([2.0, 0.0, 3.0], np.float32))
+        va = jnp.asarray(np.array([True, True, False]))
+        vb = jnp.asarray(np.array([True, True, True]))
+        out, ok = ce.eval_masked({"a": a, "b": b}, {"a": va, "b": vb})
+        np.testing.assert_array_equal(np.asarray(ok), [True, False, False])
+        assert np.asarray(out)[0] == pytest.approx(0.5)
+
+    def test_parse_band_expressions(self):
+        be = parse_band_expressions(
+            ["ndvi = (nir-red)/(nir+red)", "nir"])
+        assert be.expr_names == ["ndvi", "nir"]
+        assert be.var_list == ["nir", "red"]
+        assert be.expr_var_ref[0] == ["nir", "red"]
+        assert not be.passthrough
+
+    def test_passthrough(self):
+        be = parse_band_expressions(["red", "green", "blue"])
+        assert be.passthrough
+        assert be.var_list == ["red", "green", "blue"]
+
+    def test_bracketed_identifier(self):
+        ce = compile_expr("[band #1] * 2")
+        out = ce({"band #1": jnp.asarray(np.float32(3.0))})
+        assert float(out) == 6.0
+
+    def test_bad_expr(self):
+        with pytest.raises(ValueError):
+            compile_expr("1 +")
+        with pytest.raises(ValueError):
+            compile_expr("(a")
+
+
+class TestDrill:
+    def test_masked_mean(self):
+        data = jnp.asarray(np.array([[1.0, 2.0, 3.0, 100.0],
+                                     [5.0, 5.0, 5.0, 5.0]], np.float32))
+        valid = jnp.asarray(np.array([[True, True, True, True],
+                                      [True, False, False, False]]))
+        v, c = D.masked_mean(data, valid, clip_upper=50.0)
+        np.testing.assert_allclose(np.asarray(v), [2.0, 5.0])
+        np.testing.assert_array_equal(np.asarray(c), [3, 1])
+
+    def test_pixel_count_mode(self):
+        data = jnp.asarray(np.array([[1.0, 2.0, 60.0, 4.0]], np.float32))
+        valid = jnp.asarray(np.array([[True, True, True, False]]))
+        v, c = D.masked_mean(data, valid, clip_upper=50.0, pixel_count=True)
+        assert np.asarray(v)[0] == pytest.approx(2.0 / 3.0)
+        assert np.asarray(c)[0] == 3
+
+    def test_deciles_match_reference_algorithm(self):
+        rng = np.random.default_rng(3)
+        vals = rng.uniform(0, 100, 83).astype(np.float32)
+        Dn = 9
+
+        def ref_deciles(buf, Dn):
+            buf = np.sort(buf)
+            step = len(buf) // (Dn + 1)
+            out = np.zeros(Dn, np.float32)
+            if step > 0:
+                even = len(buf) % (Dn + 1) == 0
+                for i in range(Dn):
+                    k = (i + 1) * step
+                    out[i] = (buf[k] + buf[min(k + 1, len(buf) - 1)]) / 2 if even else buf[k]
+            return out
+
+        data = jnp.asarray(vals[None])
+        valid = jnp.ones((1, 83), bool)
+        got = np.asarray(D.deciles(data, valid, Dn))[0]
+        np.testing.assert_allclose(got, ref_deciles(vals, Dn), rtol=1e-6)
+
+    def test_deciles_even_divisible(self):
+        vals = np.arange(20, dtype=np.float32)  # n=20, D=9 -> step=2, even
+        got = np.asarray(D.deciles(jnp.asarray(vals[None]),
+                                   jnp.ones((1, 20), bool), 9))[0]
+        expect = [(vals[(i + 1) * 2] + vals[(i + 1) * 2 + 1]) / 2 for i in range(9)]
+        np.testing.assert_allclose(got, expect)
+
+    def test_deciles_padding_small_n(self):
+        # n=2 < D+1: reference pads [b0]*5 + [b1]*4 for D=9
+        vals = np.array([3.0, 7.0], np.float32)
+        data = np.full((1, 10), np.nan, np.float32)
+        data[0, :2] = vals
+        valid = np.zeros((1, 10), bool)
+        valid[0, :2] = True
+        got = np.asarray(D.deciles(jnp.asarray(data), jnp.asarray(valid), 9))[0]
+        np.testing.assert_allclose(got, [3, 3, 3, 3, 3, 7, 7, 7, 7])
+
+    def test_deciles_empty(self):
+        got = np.asarray(D.deciles(jnp.zeros((1, 5)), jnp.zeros((1, 5), bool), 9))
+        np.testing.assert_array_equal(got, np.zeros((1, 9)))
+
+    def test_interp_strided(self):
+        # endpoints at bands 0 and 3 (stride 4): interior interpolated
+        values = np.array([[10.0], [40.0]])
+        counts = np.array([[100], [50]])
+        v, c = D.interp_strided(values, counts, np.array([0, 3]), 4)
+        np.testing.assert_allclose(v[:, 0], [10, 20, 30, 40])
+        assert c[1, 0] == 75 and c[2, 0] == 75
+
+
+class TestReviewRegressions:
+    def test_nearest_truncation_parity(self):
+        # reference truncates (int)(px+1e-10) in corner coords: centre
+        # coord 2.5 (corner 3.0) must pick pixel 3, not banker-round to 2
+        src = np.arange(36, dtype=np.float32).reshape(6, 6)
+        valid = np.ones((6, 6), bool)
+        rows = np.array([[2.5, 1.5]])
+        cols = np.array([[0.0, 0.0]])
+        out, ok = warp_gather(jnp.asarray(src), jnp.asarray(valid),
+                              jnp.asarray(rows), jnp.asarray(cols), "near")
+        np.testing.assert_array_equal(np.asarray(out), [[18.0, 12.0]])
+
+    def test_bit_mask_signed_high_bit(self):
+        # int8 band, mask 10000000: int8 & int8(-128) is never > 0, so no
+        # pixel is excluded (tile_merger.go semantics in the band's type)
+        data = np.array([-1, -128, 5, 127], np.int8)
+        m = compute_bit_mask(data, "10000000")
+        assert not np.asarray(m).any()
+        # same pattern on a Byte band: 0x80 & 0x80 = 128 > 0 -> excluded
+        datab = np.array([0x80, 0x7F, 0xFF], np.uint8)
+        mb = compute_bit_mask(datab, "10000000")
+        np.testing.assert_array_equal(np.asarray(mb), [True, False, True])
+
+    def test_proj4_ellipsoid_roundtrip(self):
+        p = parse_crs("+proj=tmerc +lon_0=9 +ellps=bessel")
+        assert "+ellps=bessel" in p.to_proj4()
+        p2 = parse_crs(p.to_proj4())
+        assert p2.ellps == p.ellps
