@@ -1,0 +1,6 @@
+from .geotiff import GeoTIFF, write_geotiff
+from .png import encode_png, encode_rgba_png
+from . import netcdf
+
+__all__ = ["GeoTIFF", "write_geotiff", "encode_png", "encode_rgba_png",
+           "netcdf"]

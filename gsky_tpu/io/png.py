@@ -1,0 +1,100 @@
+"""PNG/JPEG encoding of rendered tiles.
+
+Parity with `utils/ogc_encoders.go:82-142` (EncodePNG): 1-band byte
+rasters are encoded as paletted PNG with index 0xFF transparent; 3 bands
+become RGB with 0xFF-in-all-bands transparent; 4 bands RGBA.  PIL supplies
+the (C-accelerated) codec.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional, Sequence
+
+import numpy as np
+from PIL import Image
+
+NODATA_BYTE = 255
+
+
+def encode_png(bands: Sequence[np.ndarray],
+               palette: Optional[np.ndarray] = None) -> bytes:
+    """bands: list of (H, W) uint8 arrays (1, 3 or 4 of them);
+    palette: (256, 4) uint8 RGBA LUT for the 1-band case."""
+    if len(bands) == 1:
+        img = Image.fromarray(bands[0], "P")
+        if palette is None:
+            # greyscale ramp with transparent nodata
+            lut = np.stack([np.arange(256)] * 3 + [np.full(256, 255)], 1)
+            lut = lut.astype(np.uint8)
+        else:
+            lut = np.asarray(palette, np.uint8)
+            if lut.shape != (256, 4):
+                raise ValueError("palette must be (256,4) RGBA")
+        img.putpalette(lut[:, :3].reshape(-1).tobytes(), "RGB")
+        img.info["transparency"] = bytes(lut[:, 3].tolist())
+        buf = io.BytesIO()
+        img.save(buf, "PNG", transparency=bytes(lut[:, 3].tolist()))
+        return buf.getvalue()
+    if len(bands) == 3:
+        h, w = bands[0].shape
+        rgba = np.zeros((h, w, 4), np.uint8)
+        for i in range(3):
+            rgba[..., i] = bands[i]
+        nodata = (bands[0] == NODATA_BYTE) & (bands[1] == NODATA_BYTE) \
+            & (bands[2] == NODATA_BYTE)
+        rgba[..., 3] = np.where(nodata, 0, 255)
+        img = Image.fromarray(rgba, "RGBA")
+        buf = io.BytesIO()
+        img.save(buf, "PNG")
+        return buf.getvalue()
+    if len(bands) == 4:
+        h, w = bands[0].shape
+        rgba = np.stack(bands, axis=-1)
+        img = Image.fromarray(rgba, "RGBA")
+        buf = io.BytesIO()
+        img.save(buf, "PNG")
+        return buf.getvalue()
+    raise ValueError(f"cannot encode {len(bands)} bands as PNG")
+
+
+def encode_rgba_png(rgba: np.ndarray) -> bytes:
+    """(H, W, 4) uint8 -> PNG bytes (the device palette path output)."""
+    buf = io.BytesIO()
+    Image.fromarray(np.asarray(rgba, np.uint8), "RGBA").save(buf, "PNG")
+    return buf.getvalue()
+
+
+def encode_jpeg(bands: Sequence[np.ndarray], quality: int = 85) -> bytes:
+    """3-band JPEG (the tile_jpg_enc.go analogue)."""
+    if len(bands) == 1:
+        img = Image.fromarray(bands[0], "L")
+    elif len(bands) == 3:
+        img = Image.fromarray(np.stack(bands, axis=-1), "RGB")
+    else:
+        raise ValueError(f"cannot encode {len(bands)} bands as JPEG")
+    buf = io.BytesIO()
+    img.save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def decode_png(data: bytes) -> np.ndarray:
+    """PNG bytes -> (H, W, 4) uint8 (used by tests and the empty-tile
+    resizer `utils/empty_tile.go:14`)."""
+    img = Image.open(io.BytesIO(data)).convert("RGBA")
+    return np.asarray(img)
+
+
+def empty_tile_png(width: int, height: int,
+                   tile_image: Optional[bytes] = None) -> bytes:
+    """Transparent (or tiled-image) PNG of the requested size — the
+    zoom-limit / error tile of `utils/empty_tile.go:14-53`."""
+    canvas = Image.new("RGBA", (width, height), (0, 0, 0, 0))
+    if tile_image:
+        tile = Image.open(io.BytesIO(tile_image)).convert("RGBA")
+        for x in range(0, width, tile.width):
+            for y in range(0, height, tile.height):
+                canvas.paste(tile, (x, y))
+    buf = io.BytesIO()
+    canvas.save(buf, "PNG")
+    return buf.getvalue()

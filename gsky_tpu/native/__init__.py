@@ -1,0 +1,75 @@
+"""Native (C++) decode kernels, loaded via ctypes.
+
+Build with ``make -C gsky_tpu/native``; every consumer falls back to the
+pure-Python implementations when the shared library is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libgskycodec.so")
+
+import numpy as np
+
+_lib: Optional[ctypes.CDLL] = None
+if os.path.exists(_LIB_PATH):
+    _lib = ctypes.CDLL(_LIB_PATH)
+    _lib.lzw_decode.restype = ctypes.c_long
+    _lib.lzw_decode.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                ctypes.c_void_p, ctypes.c_long]
+    _lib.packbits_decode.restype = ctypes.c_long
+    _lib.packbits_decode.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                     ctypes.c_void_p, ctypes.c_long]
+    for name in ("unpredict_h8", "unpredict_h16", "unpredict_h32"):
+        fn = getattr(_lib, name)
+        fn.restype = None
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_long, ctypes.c_long,
+                       ctypes.c_long]
+    _lib.unpredict_fp.restype = None
+    _lib.unpredict_fp.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                  ctypes.c_long, ctypes.c_long,
+                                  ctypes.c_long, ctypes.c_long]
+
+
+class codec:
+    """Namespace mirroring the pure-Python codec helpers."""
+
+    @staticmethod
+    def lzw_decode(data: bytes, expected: int) -> bytes:
+        buf = ctypes.create_string_buffer(expected)
+        n = _lib.lzw_decode(data, len(data), buf, expected)
+        if n < 0:
+            raise ValueError("corrupt LZW stream")
+        return buf.raw[:n]
+
+    @staticmethod
+    def packbits_decode(data: bytes, expected: int) -> bytes:
+        buf = ctypes.create_string_buffer(expected)
+        n = _lib.packbits_decode(data, len(data), buf, expected)
+        return buf.raw[:n]
+
+    @staticmethod
+    def unpredict_h(arr: "np.ndarray") -> bool:
+        """In-place horizontal predictor undo on a C-contiguous
+        (rows, cols, samples) array of 1/2/4-byte integers."""
+        fn = {1: _lib.unpredict_h8, 2: _lib.unpredict_h16,
+              4: _lib.unpredict_h32}.get(arr.dtype.itemsize)
+        if fn is None or not arr.flags.c_contiguous:
+            return False
+        rows, cols, samples = arr.shape
+        fn(arr.ctypes.data, rows, cols, samples)
+        return True
+
+    @staticmethod
+    def unpredict_fp(data: bytes, rows: int, cols: int, samples: int,
+                     itemsize: int) -> bytes:
+        buf = ctypes.create_string_buffer(len(data))
+        _lib.unpredict_fp(data, buf, rows, cols, samples, itemsize)
+        return buf.raw
+
+
+if _lib is None:
+    codec = None  # type: ignore  # geotiff.py falls back to pure Python

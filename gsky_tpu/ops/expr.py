@@ -18,6 +18,7 @@ is valid in EVERY variable the expression references.
 
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -207,7 +208,9 @@ def _emit(node, env, xp):
         if op == "/":
             return a / b
         if op == "%":
-            return a % b
+            # govaluate uses Go math.Mod (truncated, sign of dividend);
+            # xp.% would be floored modulo and diverge for negatives
+            return xp.fmod(a, b) if hasattr(xp, "fmod") else math.fmod(a, b)
         if op == "**":
             return a ** b
         if op == "==":
@@ -255,7 +258,7 @@ class CompiledExpr:
     def eval_masked(self, env, valid_env, xp=jnp):
         """Evaluate + combine validity: output valid iff every referenced
         band is valid (merger semantics, `tile_merger.go:684-714`)."""
-        out = self(env, xp)
+        out = xp.asarray(self(env, xp))  # constant-only exprs yield floats
         ok = None
         for v in self.variables:
             m = valid_env[v]

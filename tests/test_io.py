@@ -1,0 +1,386 @@
+"""IO layer tests: GeoTIFF reader/writer (cross-validated against PIL),
+NetCDF3/NetCDF4 readers, CF parsing, PNG encoding."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from gsky_tpu.geo.crs import EPSG4326, parse_crs
+from gsky_tpu.geo.transform import BBox, GeoTransform
+from gsky_tpu.io import GeoTIFF, write_geotiff, encode_png
+from gsky_tpu.io.netcdf import (NetCDF, cf_times_to_unix, crs_from_cf,
+                                parse_cf_time_units, write_netcdf3)
+from gsky_tpu.io.png import decode_png, empty_tile_png, encode_jpeg
+
+
+@pytest.fixture
+def tmp_tif(tmp_path):
+    return str(tmp_path / "t.tif")
+
+
+class TestGeoTIFFRoundtrip:
+    def _roundtrip(self, tmp_tif, data, **kw):
+        gt = GeoTransform(1000.0, 25.0, 0.0, 5000.0, 0.0, -25.0)
+        crs = parse_crs("EPSG:32755")
+        write_geotiff(tmp_tif, data, gt, crs, **kw)
+        with GeoTIFF(tmp_tif) as g:
+            if data.ndim == 2:
+                got = g.read(1)
+                np.testing.assert_array_equal(got, data)
+            else:
+                for b in range(data.shape[0]):
+                    np.testing.assert_array_equal(g.read(b + 1), data[b])
+            assert g.gt.x0 == 1000.0
+            assert g.gt.dx == 25.0
+            assert g.crs.epsg == 32755
+        return tmp_tif
+
+    def test_float32(self, tmp_tif):
+        rng = np.random.default_rng(0)
+        self._roundtrip(tmp_tif, rng.normal(size=(300, 200)).astype(np.float32))
+
+    def test_uint8_multiband(self, tmp_tif):
+        rng = np.random.default_rng(1)
+        self._roundtrip(
+            tmp_tif, rng.integers(0, 255, (3, 100, 130)).astype(np.uint8))
+
+    def test_int16_nodata(self, tmp_tif):
+        data = np.arange(-500, 500, dtype=np.int16).reshape(20, 50)
+        gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+        write_geotiff(tmp_tif, data, gt, EPSG4326, nodata=-32768)
+        with GeoTIFF(tmp_tif) as g:
+            assert g.nodata == -32768
+            assert g.crs == EPSG4326
+            np.testing.assert_array_equal(g.read(1), data)
+
+    def test_uncompressed(self, tmp_tif):
+        data = np.arange(64, dtype=np.uint16).reshape(8, 8)
+        gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+        write_geotiff(tmp_tif, data, gt, EPSG4326, compress=False)
+        with GeoTIFF(tmp_tif) as g:
+            np.testing.assert_array_equal(g.read(1), data)
+
+    def test_window_read(self, tmp_tif):
+        rng = np.random.default_rng(2)
+        data = rng.integers(0, 1000, (700, 900)).astype(np.uint16)
+        gt = GeoTransform(0.0, 1.0, 0.0, 700.0, 0.0, -1.0)
+        write_geotiff(tmp_tif, data, gt, EPSG4326, tile_size=128)
+        with GeoTIFF(tmp_tif) as g:
+            win = g.read(1, (250, 130, 400, 300))
+            np.testing.assert_array_equal(win, data[130:430, 250:650])
+
+    def test_window_geo(self, tmp_tif):
+        data = np.arange(10000, dtype=np.float32).reshape(100, 100)
+        gt = GeoTransform(100.0, 1.0, 0.0, 100.0, 0.0, -1.0)
+        write_geotiff(tmp_tif, data, gt, EPSG4326)
+        with GeoTIFF(tmp_tif) as g:
+            sub, wgt = g.read_window_geo(BBox(110, 50, 130, 80))
+            assert sub.shape == (30, 20)
+            assert wgt.x0 == 110.0
+            assert wgt.y0 == 80.0
+            np.testing.assert_array_equal(sub, data[20:50, 10:30])
+            none, _ = g.read_window_geo(BBox(500, 500, 600, 600))
+            assert none is None
+
+    def test_proj4_fallback_crs(self, tmp_tif):
+        crs = parse_crs("+proj=sinu +R=6371007.181")
+        gt = GeoTransform(0.0, 500.0, 0.0, 0.0, 0.0, -500.0)
+        write_geotiff(tmp_tif, np.zeros((4, 4), np.float32), gt, crs)
+        with GeoTIFF(tmp_tif) as g:
+            assert g.crs.proj == "sinu"
+
+
+class TestGeoTIFFvsPIL:
+    """Cross-validation against an independent TIFF implementation."""
+
+    def test_pil_reads_our_tiles(self, tmp_tif):
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 255, (100, 150)).astype(np.uint8)
+        gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+        write_geotiff(tmp_tif, data, gt, EPSG4326, tile_size=64)
+        img = Image.open(tmp_tif)
+        np.testing.assert_array_equal(np.asarray(img), data)
+
+    @pytest.mark.parametrize("comp", [None, "tiff_lzw", "tiff_adobe_deflate",
+                                      "packbits"])
+    def test_we_read_pil_strips(self, tmp_path, comp):
+        rng = np.random.default_rng(4)
+        data = rng.integers(0, 255, (90, 121)).astype(np.uint8)
+        p = str(tmp_path / f"pil_{comp}.tif")
+        img = Image.fromarray(data)
+        if comp:
+            img.save(p, compression=comp)
+        else:
+            img.save(p)
+        with GeoTIFF(p) as g:
+            np.testing.assert_array_equal(g.read(1), data)
+
+    def test_we_read_pil_rgb(self, tmp_path):
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 255, (64, 80, 3)).astype(np.uint8)
+        p = str(tmp_path / "rgb.tif")
+        Image.fromarray(data, "RGB").save(p, compression="tiff_adobe_deflate")
+        with GeoTIFF(p) as g:
+            assert g.count == 3
+            for b in range(3):
+                np.testing.assert_array_equal(g.read(b + 1), data[..., b])
+
+    def test_we_read_pil_float(self, tmp_path):
+        data = np.linspace(0, 1, 48 * 50, dtype=np.float32).reshape(48, 50)
+        p = str(tmp_path / "f32.tif")
+        Image.fromarray(data, "F").save(p)
+        with GeoTIFF(p) as g:
+            np.testing.assert_allclose(g.read(1), data)
+
+
+class TestCFTime:
+    def test_units(self):
+        mult, epoch = parse_cf_time_units("days since 1970-01-01")
+        assert mult == 86400.0 and epoch == 0.0
+        mult, epoch = parse_cf_time_units("seconds since 2000-01-01 12:00:00")
+        assert mult == 1.0
+        assert epoch == 946728000.0
+
+    def test_convert(self):
+        t = cf_times_to_unix(np.array([0.0, 1.0]), "hours since 1970-01-02")
+        np.testing.assert_allclose(t, [86400.0, 90000.0])
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            parse_cf_time_units("fortnights since forever")
+
+
+class TestCFGridMapping:
+    def test_albers(self):
+        crs = crs_from_cf({
+            "grid_mapping_name": "albers_conical_equal_area",
+            "standard_parallel": np.array([-18.0, -36.0]),
+            "longitude_of_central_meridian": 132.0,
+            "latitude_of_projection_origin": 0.0,
+            "false_easting": 0.0, "false_northing": 0.0,
+            "semi_major_axis": 6378137.0,
+            "inverse_flattening": 298.257222101,
+        })
+        ref = parse_crs("EPSG:3577")
+        x1, y1 = crs.from_lonlat(145.0, -30.0)
+        x2, y2 = ref.from_lonlat(145.0, -30.0)
+        assert x1 == pytest.approx(x2, abs=1e-3)
+        assert y1 == pytest.approx(y2, abs=1e-3)
+
+    def test_spatial_ref_shortcut(self):
+        crs = crs_from_cf({"spatial_ref": parse_crs("EPSG:32755").to_wkt()})
+        assert crs.epsg == 32755
+
+
+class TestNetCDF3:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "a.nc")
+        rng = np.random.default_rng(6)
+        data = rng.normal(size=(3, 40, 50)).astype(np.float32)
+        x = np.linspace(100.25, 124.75, 50)
+        y = np.linspace(-10.25, -29.75, 40)
+        times = np.array([0.0, 86400.0, 172800.0])
+        write_netcdf3(p, {"fc": data}, x, y, EPSG4326, times=times,
+                      nodata=-999.0)
+        with NetCDF(p) as nc:
+            assert "fc" in nc.variables
+            v = nc.variables["fc"]
+            assert v.shape == (3, 40, 50)
+            assert v.nodata == -999.0
+            np.testing.assert_allclose(np.asarray(v[(1, slice(None), slice(None))]),
+                                       data[1], rtol=1e-6)
+            ts = nc.timestamps()
+            np.testing.assert_allclose(ts, times)
+            gt = nc.geotransform()
+            assert gt.dx == pytest.approx(0.5)
+            assert gt.x0 == pytest.approx(100.0)
+            sl = nc.read_slice("fc", 2, (10, 5, 20, 12))
+            np.testing.assert_allclose(sl, data[2, 5:17, 10:30], rtol=1e-6)
+
+    def test_projected_crs(self, tmp_path):
+        p = str(tmp_path / "b.nc")
+        x = np.arange(10) * 25.0
+        y = np.arange(8) * -25.0
+        write_netcdf3(p, {"v": np.zeros((8, 10), np.int16)}, x, y,
+                      parse_crs("EPSG:3577"))
+        with NetCDF(p) as nc:
+            crs = nc.crs(nc.variables["v"])
+            assert crs.proj == "aea"
+            assert crs.lon0 == 132.0
+
+
+@pytest.mark.skipif(not pytest.importorskip("h5py"), reason="h5py missing")
+class TestNetCDF4:
+    def test_h5_file(self, tmp_path):
+        import h5py
+        p = str(tmp_path / "c.nc")
+        rng = np.random.default_rng(7)
+        data = rng.normal(size=(2, 30, 20)).astype(np.float32)
+        with h5py.File(p, "w") as f:
+            d = f.create_dataset("ndvi", data=data)
+            d.attrs["_FillValue"] = np.float32(-1.0)
+            d.attrs["grid_mapping"] = "crs"
+            f.create_dataset("x", data=np.arange(20) * 0.1 + 140.0)
+            f.create_dataset("y", data=-10.0 - np.arange(30) * 0.1)
+            t = f.create_dataset("time", data=np.array([10.0, 11.0]))
+            t.attrs["units"] = "days since 2020-01-01"
+            t.attrs["standard_name"] = "time"
+            c = f.create_dataset("crs", data=0)
+            c.attrs["grid_mapping_name"] = "latitude_longitude"
+        with NetCDF(p) as nc:
+            v = nc.variables["ndvi"]
+            assert v.nodata == -1.0
+            ts = nc.timestamps()
+            assert ts is not None and len(ts) == 2
+            sl = nc.read_slice("ndvi", 1, (2, 3, 10, 12))
+            np.testing.assert_allclose(sl, data[1, 3:15, 2:12])
+            gt = nc.geotransform()
+            assert gt.dx == pytest.approx(0.1)
+
+
+class TestPNG:
+    def test_paletted(self):
+        img = np.array([[0, 100], [200, 255]], np.uint8)
+        lut = np.zeros((256, 4), np.uint8)
+        lut[:, 0] = np.arange(256)
+        lut[:, 3] = 255
+        lut[255] = (0, 0, 0, 0)
+        png = encode_png([img], lut)
+        rgba = decode_png(png)
+        assert rgba.shape == (2, 2, 4)
+        assert rgba[0, 0, 0] == 0
+        assert rgba[1, 0, 0] == 200
+        assert rgba[1, 1, 3] == 0  # nodata transparent
+
+    def test_rgb(self):
+        r = np.full((4, 4), 10, np.uint8)
+        g = np.full((4, 4), 20, np.uint8)
+        b = np.full((4, 4), 30, np.uint8)
+        b[0, 0] = 255; r[0, 0] = 255; g[0, 0] = 255
+        rgba = decode_png(encode_png([r, g, b]))
+        assert tuple(rgba[1, 1][:3]) == (10, 20, 30)
+        assert rgba[0, 0, 3] == 0  # all-255 pixel transparent
+
+    def test_empty_tile(self):
+        png = empty_tile_png(64, 32)
+        rgba = decode_png(png)
+        assert rgba.shape == (32, 64, 4)
+        assert (rgba[..., 3] == 0).all()
+
+    def test_jpeg(self):
+        bands = [np.full((8, 8), v, np.uint8) for v in (50, 100, 150)]
+        data = encode_jpeg(bands)
+        assert data[:2] == b"\xff\xd8"
+
+
+class TestNC3CrossValidation:
+    """Cross-validate the classic-NetCDF reader/writer against scipy's
+    independent implementation."""
+
+    def test_read_scipy_single_record_var(self, tmp_path):
+        # exactly one record variable: records are packed UNPADDED
+        from scipy.io import netcdf_file
+        p = str(tmp_path / "rec.nc")
+        f = netcdf_file(p, "w")
+        f.createDimension("time", None)
+        f.createDimension("x", 3)
+        v = f.createVariable("v", np.int16, ("time", "x"))
+        data = np.arange(12, dtype=np.int16).reshape(4, 3)
+        for i in range(4):
+            v[i] = data[i]
+        f.flush(); f.close()
+        with NetCDF(p) as nc:
+            got = nc.variables["v"][(slice(None), slice(None))]
+            np.testing.assert_array_equal(got, data)
+            got1 = nc.variables["v"][(2, slice(None))]
+            np.testing.assert_array_equal(got1, data[2])
+
+    def test_scipy_reads_our_writer(self, tmp_path):
+        from scipy.io import netcdf_file
+        p = str(tmp_path / "ours.nc")
+        data = np.arange(24, dtype=np.float32).reshape(4, 6)
+        x = np.linspace(0, 5, 6); y = np.linspace(0, 3, 4)
+        write_netcdf3(p, {"band1": data}, x, y, EPSG4326, nodata=-1.0)
+        f = netcdf_file(p, "r")
+        np.testing.assert_allclose(f.variables["band1"][:], data)
+        np.testing.assert_allclose(f.variables["x"][:], x)
+        f.close()
+
+    def test_unsigned_roundtrip(self, tmp_path):
+        p = str(tmp_path / "u8.nc")
+        data = np.array([[0, 127, 128, 255]], np.uint8)
+        write_netcdf3(p, {"b": data}, np.arange(4.0), np.arange(1.0),
+                      EPSG4326, nodata=255)
+        with NetCDF(p) as nc:
+            got = nc.variables["b"][(slice(None), slice(None))]
+            assert got.dtype == np.uint8
+            np.testing.assert_array_equal(got, data)
+            assert nc.variables["b"].nodata == 255
+
+
+class TestPredictors:
+    def _make_tiff(self, tmp_path, data, predictor, dtype):
+        """Hand-craft a single-strip little-endian TIFF with a predictor."""
+        import struct as st
+        h, w = data.shape
+        if predictor == 2:
+            enc = data.copy()
+            enc[:, 1:] = data[:, 1:] - data[:, :-1]
+            raw = enc.astype(dtype).tobytes()
+        else:  # predictor 3 on float32
+            be = data.astype(">f4").view(np.uint8).reshape(h, w, 4)
+            planes = np.transpose(be, (0, 2, 1)).reshape(h, w * 4)
+            enc = planes.copy()
+            enc[:, 1:] = planes[:, 1:] - planes[:, :-1]
+            raw = enc.tobytes()
+        bits = np.dtype(dtype).itemsize * 8
+        fmt = {"u": 1, "i": 2, "f": 3}[np.dtype(dtype).kind]
+        tags = [
+            (256, 3, [w]), (257, 3, [h]), (258, 3, [bits]), (259, 3, [1]),
+            (262, 3, [1]), (273, 4, [8]), (277, 3, [1]), (278, 3, [h]),
+            (279, 4, [len(raw)]), (317, 3, [predictor]), (339, 3, [fmt]),
+        ]
+        buf = b"II*\0" + st.pack("<I", 8 + len(raw))
+        buf += raw
+        buf += st.pack("<H", len(tags))
+        for tag, typ, vals in tags:
+            fmtc = {3: "H", 4: "I"}[typ]
+            inline = st.pack("<" + fmtc * len(vals), *vals).ljust(4, b"\0")
+            buf += st.pack("<HHI", tag, typ, len(vals)) + inline
+        buf += st.pack("<I", 0)
+        p = str(tmp_path / f"pred{predictor}.tif")
+        open(p, "wb").write(buf)
+        return p
+
+    def test_predictor2_uint8(self, tmp_path):
+        rng = np.random.default_rng(8)
+        data = rng.integers(0, 255, (16, 32)).astype(np.uint8)
+        p = self._make_tiff(tmp_path, data, 2, np.uint8)
+        with GeoTIFF(p) as g:
+            np.testing.assert_array_equal(g.read(1), data)
+
+    def test_predictor2_uint16(self, tmp_path):
+        rng = np.random.default_rng(9)
+        data = rng.integers(0, 60000, (8, 20)).astype(np.uint16)
+        p = self._make_tiff(tmp_path, data, 2, np.uint16)
+        with GeoTIFF(p) as g:
+            np.testing.assert_array_equal(g.read(1), data)
+
+    def test_predictor3_float32(self, tmp_path):
+        rng = np.random.default_rng(10)
+        data = rng.normal(size=(6, 10)).astype(np.float32)
+        p = self._make_tiff(tmp_path, data, 3, np.float32)
+        with GeoTIFF(p) as g:
+            np.testing.assert_array_equal(g.read(1), data)
+
+    def test_predictor_python_fallback(self, tmp_path, monkeypatch):
+        import gsky_tpu.io.geotiff as gtf
+        rng = np.random.default_rng(11)
+        data = rng.normal(size=(5, 7)).astype(np.float32)
+        p = self._make_tiff(tmp_path, data, 3, np.float32)
+        monkeypatch.setattr(gtf, "_native", None)
+        with GeoTIFF(p) as g:
+            np.testing.assert_array_equal(g.read(1), data)
