@@ -1,0 +1,4 @@
+from .store import MASStore
+from .client import MASClient, Dataset
+
+__all__ = ["MASStore", "MASClient", "Dataset"]

@@ -1,0 +1,119 @@
+"""masapi — the HTTP face of the metadata index.
+
+Contract parity with `mas/api/api.go`: every GET/POST path is a collection
+path; the operation is selected by bare query keys ``?intersects``,
+``?timestamps``, ``?extents``; parameters arrive as query or form values
+(big drill polygons POST their wkt, `processor/drill_indexer.go:131-140`).
+Responses are the JSON the store builds; errors come back as
+``{"error": "..."}`` with HTTP 400/500 (httpJSONError equivalent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import Optional
+
+from aiohttp import web
+
+from .store import MASStore
+
+
+def build_app(store: MASStore) -> web.Application:
+    async def handler(request: web.Request) -> web.Response:
+        q = request.query
+        form = await request.post() if request.method == "POST" else {}
+
+        def val(key: str, default: str = "") -> str:
+            return q.get(key) or (form.get(key) if form else None) or default
+
+        gpath = request.path
+        try:
+            if "intersects" in q:
+                ns = val("namespace")
+                result = store.intersects(
+                    gpath,
+                    srs=val("srs"),
+                    wkt=val("wkt"),
+                    nseg=int(val("nseg") or 2),
+                    time=val("time"),
+                    until=val("until"),
+                    namespaces=ns.split(",") if ns else None,
+                    metadata=val("metadata"),
+                    limit=int(val("limit") or 0),
+                )
+            elif "timestamps" in q:
+                ns = val("namespace")
+                result = store.timestamps(
+                    gpath,
+                    time=val("time"),
+                    until=val("until"),
+                    namespaces=ns.split(",") if ns else None,
+                    token=val("token"),
+                )
+            elif "extents" in q:
+                ns = val("namespace")
+                result = store.extents(
+                    gpath, namespaces=ns.split(",") if ns else None)
+            else:
+                return web.json_response(
+                    {"error": "unknown operation; currently supported: "
+                              "?intersects, ?timestamps, ?extents"},
+                    status=400)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(result)
+
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app.router.add_route("GET", "/{tail:.*}", handler)
+    app.router.add_route("POST", "/{tail:.*}", handler)
+    return app
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="gsky-mas",
+                                 description="GSKY metadata index API")
+    ap.add_argument("-database", default=":memory:",
+                    help="sqlite database path")
+    ap.add_argument("-port", type=int, default=8888)
+    ap.add_argument("-host", default="0.0.0.0")
+    ap.add_argument("-ingest", nargs="*", default=[],
+                    help="crawler TSV/JSON files to ingest at startup")
+    args = ap.parse_args(argv)
+
+    store = MASStore(args.database)
+    for path in args.ingest:
+        ingest_file(store, path)
+    web.run_app(build_app(store), host=args.host, port=args.port,
+                print=lambda *a: print(f"masapi listening on "
+                                       f"{args.host}:{args.port}"))
+
+
+def ingest_file(store: MASStore, path: str) -> int:
+    """Ingest a crawler output file: JSON-lines or TSV
+    (`path\\tgdal\\tjson`, the crawl pipeline format)."""
+    n = 0
+    opener = open
+    if path.endswith(".gz"):
+        import gzip
+        opener = gzip.open
+    with opener(path, "rt") as fp:
+        for line in fp:
+            line = line.strip()
+            if not line:
+                continue
+            if "\t" in line:
+                parts = line.split("\t")
+                rec = json.loads(parts[-1])
+                if "filename" not in rec:
+                    rec["filename"] = parts[0]
+            else:
+                rec = json.loads(line)
+            n += store.ingest(rec)
+    return n
+
+
+if __name__ == "__main__":
+    main()

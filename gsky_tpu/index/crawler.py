@@ -1,0 +1,219 @@
+"""gsky-crawl — extract per-file geospatial metadata for ingestion.
+
+CLI parity with `crawl/crawl.go`: reads file paths (args or stdin), emits
+one JSON record per file — ``{"filename", "file_type", "geo_metadata":
+[...]}`` — raw or as ``path\\tgdal\\tjson`` TSV (`crawl.go:118-127`).
+Metadata extraction mirrors `crawl/extractor/info.go`: dtype, dims,
+geotransform, footprint polygon WKT (in the file's SRS), projection,
+timestamps (NetCDF time variable or filename patterns,
+`worker/gdalprocess/info.go:42-57`), generalised extra axes, and optional
+approximate per-band means/sample counts consumed by the drill fast path
+(`processor/drill_grpc.go:70-93`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures as cf
+import datetime as dt
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..geo.transform import GeoTransform
+from ..io.geotiff import GeoTIFF
+from ..io.netcdf import NetCDF
+from ..ops.raster import NP_TO_GDAL
+from .store import ISO, fmt_time
+
+# filename timestamp patterns (generic subset of the reference's 13
+# product rules, `worker/gdalprocess/info.go:42-57`)
+_TIME_PATTERNS = [
+    (re.compile(r"(\d{4})-(\d{2})-(\d{2})[T_ ]?(\d{2})[:\-]?(\d{2})"), "ymdhm"),
+    (re.compile(r"(\d{4})(\d{2})(\d{2})(\d{2})(\d{2})"), "ymdhm"),
+    (re.compile(r"(\d{4})-(\d{2})-(\d{2})"), "ymd"),
+    (re.compile(r"(\d{4})(\d{2})(\d{2})"), "ymd"),
+    (re.compile(r"A(\d{4})(\d{3})"), "yj"),  # MODIS A2018123
+]
+
+
+def timestamp_from_filename(name: str) -> Optional[str]:
+    base = os.path.basename(name)
+    for pat, kind in _TIME_PATTERNS:
+        m = pat.search(base)
+        if not m:
+            continue
+        try:
+            if kind == "yj":
+                d = dt.datetime(int(m.group(1)), 1, 1,
+                                tzinfo=dt.timezone.utc) \
+                    + dt.timedelta(days=int(m.group(2)) - 1)
+            elif kind == "ymdhm":
+                d = dt.datetime(int(m.group(1)), int(m.group(2)),
+                                int(m.group(3)), int(m.group(4)),
+                                int(m.group(5)), tzinfo=dt.timezone.utc)
+            else:
+                d = dt.datetime(int(m.group(1)), int(m.group(2)),
+                                int(m.group(3)), tzinfo=dt.timezone.utc)
+            return d.strftime(ISO)
+        except ValueError:
+            continue
+    return None
+
+
+def _polygon_wkt(gt: GeoTransform, w: int, h: int) -> str:
+    x0, y0 = gt.pixel_to_geo(0, 0)
+    x1, y1 = gt.pixel_to_geo(w, 0)
+    x2, y2 = gt.pixel_to_geo(w, h)
+    x3, y3 = gt.pixel_to_geo(0, h)
+    return (f"POLYGON(({x0} {y0},{x1} {y1},{x2} {y2},{x3} {y3},{x0} {y0}))")
+
+
+def _approx_stats(data: np.ndarray, nodata) -> Dict:
+    valid = np.isfinite(data.astype(np.float64))
+    if nodata is not None and not (isinstance(nodata, float) and math.isnan(nodata)):
+        valid &= data != nodata
+    n = int(valid.sum())
+    mean = float(data[valid].mean()) if n else 0.0
+    return {"means": [mean], "sample_counts": [n]}
+
+
+def extract_geotiff(path: str, namespace: Optional[str] = None,
+                    approx_stats: bool = False) -> Dict:
+    with GeoTIFF(path) as g:
+        stem = re.sub(r"[^a-zA-Z0-9_]", "_",
+                      os.path.splitext(os.path.basename(path))[0])
+        ts = timestamp_from_filename(path)
+        geo_md = []
+        for b in range(1, g.count + 1):
+            ns = namespace or (stem if g.count == 1 else f"{stem}_b{b}")
+            ds = {
+                "ds_name": f"{path}:{b}" if g.count > 1 else path,
+                "namespace": ns,
+                "array_type": NP_TO_GDAL.get(np.dtype(g.dtype), "Float32"),
+                "proj_wkt": g.crs.to_wkt(),
+                "proj4": g.crs.to_proj4(),
+                "geotransform": list(g.gt.to_gdal()),
+                "x_size": g.width,
+                "y_size": g.height,
+                "polygon": _polygon_wkt(g.gt, g.width, g.height),
+                "timestamps": [ts] if ts else [],
+                "nodata": g.nodata,
+                "band": b,
+                "overviews": [{"x_size": i.width, "y_size": i.height}
+                              for _, i in g.overviews] or None,
+            }
+            if approx_stats:
+                ds.update(_approx_stats(g.read(b), g.nodata))
+            geo_md.append(ds)
+    return {"filename": path, "file_type": "GeoTIFF", "geo_metadata": geo_md}
+
+
+def extract_netcdf(path: str, approx_stats: bool = False) -> Dict:
+    with NetCDF(path) as nc:
+        gt = nc.geotransform()
+        ts = nc.timestamps()
+        geo_md = []
+        for v in nc.raster_vars():
+            crs = nc.crs(v)
+            h, w = v.shape[-2], v.shape[-1]
+            stamps = [fmt_time(t) for t in ts] if ts is not None else []
+            if not stamps:
+                fn_ts = timestamp_from_filename(path)
+                stamps = [fn_ts] if fn_ts else []
+            axes = []
+            if len(v.shape) > 2 and ts is not None:
+                axes.append({"name": "time", "params": list(map(float, ts)),
+                             "strides": [1], "shape": [len(ts)],
+                             "grid": "default"})
+            ds = {
+                "ds_name": f'NETCDF:"{path}":{v.name}',
+                "namespace": v.name,
+                "array_type": NP_TO_GDAL.get(np.dtype(v.dtype.newbyteorder("=")),
+                                             "Float32"),
+                "proj_wkt": crs.to_wkt(),
+                "proj4": crs.to_proj4(),
+                "geotransform": list(gt.to_gdal()),
+                "x_size": w,
+                "y_size": h,
+                "polygon": _polygon_wkt(gt, w, h),
+                "timestamps": stamps,
+                "nodata": v.nodata,
+                "axes": axes or None,
+            }
+            if approx_stats and len(v.shape) == 3:
+                means, counts = [], []
+                for t in range(v.shape[0]):
+                    st = _approx_stats(nc.read_slice(v.name, t), v.nodata)
+                    means.append(st["means"][0])
+                    counts.append(st["sample_counts"][0])
+                ds["means"] = means
+                ds["sample_counts"] = counts
+            geo_md.append(ds)
+    return {"filename": path, "file_type": "NetCDF", "geo_metadata": geo_md}
+
+
+def extract(path: str, approx_stats: bool = False) -> Dict:
+    path = os.path.abspath(path)  # MAS scopes queries by path prefix
+    low = path.lower()
+    try:
+        if low.endswith((".nc", ".nc4", ".cdf")):
+            return extract_netcdf(path, approx_stats)
+        if low.endswith((".tif", ".tiff", ".gtiff")):
+            return extract_geotiff(path, approx_stats=approx_stats)
+        # sniff
+        with open(path, "rb") as fp:
+            magic = fp.read(8)
+        if magic[:3] == b"CDF" or magic[:8] == b"\x89HDF\r\n\x1a\n":
+            return extract_netcdf(path, approx_stats)
+        return extract_geotiff(path, approx_stats=approx_stats)
+    except Exception as e:
+        return {"filename": path, "file_type": "", "error": str(e),
+                "geo_metadata": []}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="gsky-crawl",
+        description="Crawl raster files, emit MAS ingest records")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories ('-' reads paths from stdin)")
+    ap.add_argument("-conc", type=int, default=4,
+                    help="concurrent extractors")
+    ap.add_argument("-approx", action="store_true",
+                    help="compute approximate band statistics")
+    ap.add_argument("-fmt", choices=("json", "tsv"), default="tsv",
+                    help="output format (tsv matches crawl_pipeline.sh)")
+    args = ap.parse_args(argv)
+
+    paths: List[str] = []
+    for p in args.paths:
+        if p == "-":
+            paths += [line.strip() for line in sys.stdin if line.strip()]
+        elif os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                paths += [os.path.join(root, f) for f in files
+                          if f.lower().endswith((".tif", ".tiff", ".nc",
+                                                 ".nc4"))]
+        else:
+            paths.append(p)
+    if not paths:
+        ap.error("no input files")
+
+    with cf.ThreadPoolExecutor(args.conc) as ex:
+        for rec in ex.map(lambda p: extract(p, args.approx), paths):
+            if args.fmt == "tsv":
+                sys.stdout.write(
+                    f"{rec['filename']}\tgdal\t{json.dumps(rec)}\n")
+            else:
+                sys.stdout.write(json.dumps(rec) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,392 @@
+"""MAS — the metadata index, sqlite-backed.
+
+The reference's MAS is Postgres+PostGIS with a schema-per-shard layout and
+a `polygons` materialized view carrying per-subdataset geometries + GIST
+indexes (`mas/db/schema.sql`, `mas/MAS_Design.md`).  The HTTP contract it
+serves (`mas/api/api.go:58-124`, `mas/api/mas.sql:363-709`) is small:
+
+- ``?intersects``: files (and optionally bundled `gdal` metadata records)
+  whose footprint intersects a query geometry and time range
+- ``?timestamps``: distinct sorted timestamps with a cache token
+- ``?extents``: EPSG:3857 envelope + stamp range + variables
+
+This rebuild keeps that exact JSON contract but stores records in sqlite:
+bbox + stamp-range columns do the SQL prefilter, and the final polygon
+test runs with our own geometry engine (`mas_intersects`'s ST_Intersects
+equivalent).  Ingest takes the same `{"filename", "file_type",
+"geo_metadata": [...]}` records the crawler emits
+(`crawl/extractor/info.go`).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import hashlib
+import json
+import math
+import sqlite3
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..geo import geometry as geom
+from ..geo.crs import EPSG3857, EPSG4326, parse_crs
+from ..geo.transform import BBox, transform_bbox
+
+ISO = "%Y-%m-%dT%H:%M:%S.000Z"
+
+
+def parse_time(s: str) -> float:
+    """RFC3339-ish -> unix seconds (the formats Go emits/accepts)."""
+    s = s.strip()
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ",
+                "%Y-%m-%dT%H:%M:%S%z", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            d = dt.datetime.strptime(s, fmt)
+            if d.tzinfo is None:
+                d = d.replace(tzinfo=dt.timezone.utc)
+            return d.timestamp()
+        except ValueError:
+            continue
+    raise ValueError(f"cannot parse time {s!r}")
+
+
+def fmt_time(t: float) -> str:
+    return dt.datetime.fromtimestamp(t, dt.timezone.utc).strftime(ISO)
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS files(
+    path TEXT PRIMARY KEY,
+    file_type TEXT,
+    meta TEXT
+);
+CREATE TABLE IF NOT EXISTS datasets(
+    id INTEGER PRIMARY KEY,
+    path TEXT NOT NULL,
+    ds_name TEXT,
+    namespace TEXT,
+    array_type TEXT,
+    srs TEXT,
+    geo_transform TEXT,
+    polygon TEXT,          -- WKT in the file's SRS
+    nodata REAL,
+    xmin REAL, ymin REAL, xmax REAL, ymax REAL,   -- EPSG:4326 bbox
+    min_stamp REAL, max_stamp REAL,               -- unix seconds
+    timestamps TEXT,       -- JSON array of RFC3339
+    axes TEXT,
+    means TEXT,
+    sample_counts TEXT,
+    geo_loc TEXT,
+    overviews TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_ds_path ON datasets(path);
+CREATE INDEX IF NOT EXISTS idx_ds_bbox ON datasets(xmin, xmax, ymin, ymax);
+CREATE INDEX IF NOT EXISTS idx_ds_ns ON datasets(namespace);
+"""
+
+
+class MASStore:
+    """The index.  Thread-safe for concurrent reads."""
+
+    def __init__(self, db_path: str = ":memory:"):
+        self._db_path = db_path
+        self._local = threading.local()
+        self._memory_conn: Optional[sqlite3.Connection] = None
+        if db_path == ":memory:":
+            self._memory_conn = sqlite3.connect(":memory:",
+                                                check_same_thread=False)
+            self._memory_lock = threading.Lock()
+        self._conn().executescript(_SCHEMA)
+        self._conn().commit()
+
+    def _conn(self) -> sqlite3.Connection:
+        if self._memory_conn is not None:
+            return self._memory_conn
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = sqlite3.connect(self._db_path)
+            self._local.conn = c
+        return c
+
+    # -- ingest --------------------------------------------------------------
+
+    def ingest(self, record: Dict) -> int:
+        """Ingest one crawler record: {"filename", "file_type",
+        "geo_metadata": [...]}.  Returns number of datasets indexed.
+        (The bash ingest pipeline `mas/db/shard_ingest.sh` analogue is a
+        loop over these.)"""
+        path = record.get("filename") or record.get("file_path")
+        if not path:
+            raise ValueError("record missing filename")
+        conn = self._conn()
+        conn.execute("INSERT OR REPLACE INTO files(path, file_type, meta) "
+                     "VALUES (?,?,?)",
+                     (path, record.get("file_type", ""), json.dumps(record)))
+        conn.execute("DELETE FROM datasets WHERE path = ?", (path,))
+        n = 0
+        for ds in record.get("geo_metadata", []):
+            srs = ds.get("proj_wkt") or ds.get("proj4") or ds.get("srs") or ""
+            poly_wkt = ds.get("polygon", "")
+            bbox4326 = (None, None, None, None)
+            if poly_wkt:
+                try:
+                    g = geom.from_wkt(poly_wkt)
+                    b = g.bbox()
+                    if srs:
+                        crs = parse_crs(srs)
+                        b = transform_bbox(b, crs, EPSG4326)
+                    bbox4326 = (b.xmin, b.ymin, b.xmax, b.ymax)
+                except (ValueError, KeyError):
+                    pass
+            stamps = ds.get("timestamps") or []
+            unix = sorted(parse_time(s) for s in stamps) if stamps else []
+            conn.execute(
+                "INSERT INTO datasets(path, ds_name, namespace, array_type,"
+                " srs, geo_transform, polygon, nodata, xmin, ymin, xmax,"
+                " ymax, min_stamp, max_stamp, timestamps, axes, means,"
+                " sample_counts, geo_loc, overviews)"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (path,
+                 ds.get("ds_name", path),
+                 _sanitize_ns(ds.get("namespace", "")),
+                 ds.get("array_type", "Float32"),
+                 srs,
+                 json.dumps(ds.get("geotransform") or ds.get("geo_transform")),
+                 poly_wkt,
+                 _float_or_none(ds.get("nodata")),
+                 *bbox4326,
+                 unix[0] if unix else None,
+                 unix[-1] if unix else None,
+                 json.dumps([fmt_time(t) for t in unix]),
+                 json.dumps(ds.get("axes")) if ds.get("axes") else None,
+                 json.dumps(ds.get("means")) if ds.get("means") else None,
+                 json.dumps(ds.get("sample_counts"))
+                 if ds.get("sample_counts") else None,
+                 json.dumps(ds.get("geo_loc")) if ds.get("geo_loc") else None,
+                 json.dumps(ds.get("overviews"))
+                 if ds.get("overviews") else None))
+            n += 1
+        conn.commit()
+        return n
+
+    # -- queries -------------------------------------------------------------
+
+    def intersects(self, gpath: str, srs: str = "", wkt: str = "",
+                   nseg: int = 2, time: str = "", until: str = "",
+                   namespaces: Optional[Sequence[str]] = None,
+                   metadata: str = "", limit: int = 0) -> Dict:
+        """`mas_intersects` (`mas/api/mas.sql:363-547`).  Returns
+        {"files": [...]} or {"gdal": [...]} when metadata == "gdal"."""
+        q_geom = None
+        if wkt:
+            g = geom.from_wkt(wkt)
+            if srs:
+                crs = parse_crs(srs)
+                if crs != EPSG4326:
+                    if nseg and nseg > 1:
+                        b = g.bbox()
+                        seg = max((b.width + b.height) / (2 * nseg), 1e-9)
+                        g = g.segmentize(seg)
+                    g = g.transform(
+                        lambda x, y: crs.transform_to(EPSG4326, x, y))
+            q_geom = g
+
+        t_a = parse_time(time) if time else None
+        t_b = parse_time(until) if until else None
+
+        sql = "SELECT * FROM datasets WHERE path LIKE ? ESCAPE '\\'"
+        args: List = [_like_prefix(gpath)]
+        if q_geom is not None:
+            qb = q_geom.bbox()
+            sql += (" AND NOT (xmax < ? OR xmin > ? OR ymax < ? OR ymin > ?"
+                    " OR xmin IS NULL)")
+            args += [qb.xmin, qb.xmax, qb.ymin, qb.ymax]
+        if t_a is not None and t_b is None:
+            sql += " AND min_stamp <= ? AND max_stamp >= ?"
+            args += [t_a, t_a]
+        elif t_a is not None and t_b is not None:
+            # postgres OVERLAPS with the reference's 1s slack
+            sql += " AND ? < max_stamp + 1 AND min_stamp - 1 < ?"
+            args += [t_a, t_b]
+        if namespaces:
+            sql += " AND namespace IN (%s)" % ",".join("?" * len(namespaces))
+            args += list(namespaces)
+        rows = self._conn().execute(sql, args).fetchall()
+        cols = [d[0] for d in self._conn().execute(
+            "SELECT * FROM datasets LIMIT 0").description]
+
+        # refine: exact polygon intersection in 4326
+        out_rows = []
+        for row in rows:
+            r = dict(zip(cols, row))
+            if q_geom is not None and r["polygon"]:
+                try:
+                    p = geom.from_wkt(r["polygon"])
+                    if r["srs"]:
+                        crs = parse_crs(r["srs"])
+                        if crs != EPSG4326:
+                            p = p.transform(lambda x, y: crs.transform_to(
+                                EPSG4326, x, y))
+                    if not _geoms_intersect(p, q_geom):
+                        continue
+                except (ValueError, KeyError):
+                    pass
+            out_rows.append(r)
+            if limit and len(out_rows) >= limit:
+                break
+
+        if metadata != "gdal":
+            return {"files": sorted({r["path"] for r in out_rows})}
+        gdal = []
+        for r in out_rows:
+            gdal.append({
+                "file_path": r["path"],
+                "ds_name": r["ds_name"],
+                "namespace": r["namespace"],
+                "array_type": r["array_type"],
+                "srs": r["srs"],
+                "geo_transform": json.loads(r["geo_transform"] or "null"),
+                "timestamps": json.loads(r["timestamps"] or "[]"),
+                "polygon": r["polygon"],
+                "overviews": json.loads(r["overviews"]) if r["overviews"] else None,
+                "means": json.loads(r["means"]) if r["means"] else None,
+                "sample_counts": json.loads(r["sample_counts"])
+                if r["sample_counts"] else None,
+                "nodata": r["nodata"] if r["nodata"] is not None else 0.0,
+                "axes": json.loads(r["axes"]) if r["axes"] else None,
+                "geo_loc": json.loads(r["geo_loc"]) if r["geo_loc"] else None,
+            })
+        return {"gdal": gdal}
+
+    def timestamps(self, gpath: str, time: str = "", until: str = "",
+                   namespaces: Optional[Sequence[str]] = None,
+                   token: str = "") -> Dict:
+        """`mas_timestamps` with the cache-token protocol
+        (`mas/api/mas.sql:549-598`): a matching token short-circuits to an
+        empty list (caller keeps its cache)."""
+        t_a = parse_time(time) if time else None
+        t_b = parse_time(until) if until else dt.datetime.now(
+            dt.timezone.utc).timestamp()
+        sql = ("SELECT timestamps FROM datasets WHERE path LIKE ? "
+               "ESCAPE '\\'")
+        args: List = [_like_prefix(gpath)]
+        if namespaces:
+            sql += " AND namespace IN (%s)" % ",".join("?" * len(namespaces))
+            args += list(namespaces)
+        stamps = set()
+        for (ts_json,) in self._conn().execute(sql, args):
+            for s in json.loads(ts_json or "[]"):
+                t = parse_time(s)
+                if (t_a is None or t >= t_a) and t <= t_b:
+                    stamps.add(t)
+        result = [fmt_time(t) for t in sorted(stamps)]
+        query_token = hashlib.md5(json.dumps(result).encode()).hexdigest()
+        if token and token == query_token:
+            return {"timestamps": [], "token": token}
+        return {"timestamps": result, "token": query_token}
+
+    def extents(self, gpath: str,
+                namespaces: Optional[Sequence[str]] = None) -> Dict:
+        """`mas_spatial_temporal_extents` (`mas/api/mas.sql:640-709`):
+        EPSG:3857 envelope + stamp range + variable list."""
+        sql = ("SELECT namespace, xmin, ymin, xmax, ymax, min_stamp,"
+               " max_stamp FROM datasets WHERE path LIKE ? ESCAPE '\\'")
+        args: List = [_like_prefix(gpath)]
+        if namespaces:
+            sql += " AND namespace IN (%s)" % ",".join("?" * len(namespaces))
+            args += list(namespaces)
+        rows = self._conn().execute(sql, args).fetchall()
+        if not rows:
+            return {}
+        nss = sorted({r[0] for r in rows if r[0]})
+        xs0 = [r[1] for r in rows if r[1] is not None]
+        ys0 = [r[2] for r in rows if r[2] is not None]
+        xs1 = [r[3] for r in rows if r[3] is not None]
+        ys1 = [r[4] for r in rows if r[4] is not None]
+        stamps_min = [r[5] for r in rows if r[5] is not None]
+        stamps_max = [r[6] for r in rows if r[6] is not None]
+        out: Dict = {"variables": nss}
+        if xs0:
+            b = transform_bbox(BBox(min(xs0), min(ys0), max(xs1), max(ys1)),
+                               EPSG4326, EPSG3857)
+            out.update({"xmin": b.xmin, "ymin": b.ymin,
+                        "xmax": b.xmax, "ymax": b.ymax})
+        if stamps_min:
+            out["min_stamp"] = fmt_time(min(stamps_min))
+            out["max_stamp"] = fmt_time(max(stamps_max))
+        return out
+
+    def list_files(self) -> List[str]:
+        return [r[0] for r in self._conn().execute(
+            "SELECT path FROM files ORDER BY path")]
+
+
+def _sanitize_ns(ns: str) -> str:
+    """`regexp_replace(trim(ns), '[^a-zA-Z0-9_]', '_')` (mas.sql:495)."""
+    import re
+    return re.sub(r"[^a-zA-Z0-9_]", "_", ns.strip())
+
+
+def _float_or_none(v) -> Optional[float]:
+    if v is None:
+        return None
+    try:
+        f = float(v)
+        return None if math.isnan(f) else f
+    except (TypeError, ValueError):
+        return None
+
+
+def _like_prefix(gpath: str) -> str:
+    esc = gpath.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
+    return esc + "%"
+
+
+def _geoms_intersect(a: geom.Geometry, b: geom.Geometry) -> bool:
+    """Polygon/polygon (or point) intersection test."""
+    if not a.bbox().intersects(b.bbox()):
+        return False
+    if b.kind in ("Point", "MultiPoint"):
+        return any(a.contains_point(p[0], p[1]) for p in b.points)
+    if a.kind in ("Point", "MultiPoint"):
+        return any(b.contains_point(p[0], p[1]) for p in a.points)
+    # vertex containment either way
+    for poly in a.polys:
+        for p in poly[0][:: max(1, len(poly[0]) // 64)]:
+            if b.contains_point(p[0], p[1]):
+                return True
+    for poly in b.polys:
+        for p in poly[0][:: max(1, len(poly[0]) // 64)]:
+            if a.contains_point(p[0], p[1]):
+                return True
+    # edge crossings
+    for pa in a.polys:
+        for pb in b.polys:
+            if _rings_cross(pa[0], pb[0]):
+                return True
+    return False
+
+
+def _rings_cross(r1: np.ndarray, r2: np.ndarray) -> bool:
+    """Any segment of r1 crosses any segment of r2 (vectorised)."""
+    def closed(r):
+        if r[0][0] != r[-1][0] or r[0][1] != r[-1][1]:
+            return np.vstack([r, r[:1]])
+        return r
+    r1 = closed(r1)
+    r2 = closed(r2)
+    p = r1[:-1][:, None, :]   # (N,1,2)
+    pr = r1[1:][:, None, :] - p
+    q = r2[:-1][None, :, :]   # (1,M,2)
+    qs = r2[1:][None, :, :] - q
+    d = q - p                 # (N,M,2)
+    rxs = np.cross(pr, qs)    # (N,M)
+    t = np.cross(d, qs)
+    u = np.cross(d, pr)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        tt = t / rxs
+        uu = u / rxs
+    hit = (rxs != 0) & (tt >= 0) & (tt <= 1) & (uu >= 0) & (uu <= 1)
+    return bool(hit.any())

@@ -547,10 +547,18 @@ def write_geotiff(path: str, data: np.ndarray, gt: GeoTransform, crs: CRS,
         (T_TILE_W, 3, [ts]),
         (T_TILE_H, 3, [ts]),
         (T_SAMPLE_FORMAT, 3, [fmt_code] * bands),
-        (T_MODEL_PIXEL_SCALE, 12, [abs(gt.dx), abs(gt.dy), 0.0]),
-        (T_MODEL_TIEPOINT, 12, [0.0, 0.0, 0.0, gt.x0, gt.y0, 0.0]),
         (T_GEO_DIR, 3, geo_dir),
     ]
+    if gt.is_north_up and gt.dy < 0:
+        tags.append((T_MODEL_PIXEL_SCALE, 12, [gt.dx, -gt.dy, 0.0]))
+        tags.append((T_MODEL_TIEPOINT, 12, [0.0, 0.0, 0.0, gt.x0, gt.y0, 0.0]))
+    else:
+        # south-up or rotated: the full affine ModelTransformation matrix
+        tags.append((T_MODEL_TRANSFORM, 12,
+                     [gt.dx, gt.rx, 0.0, gt.x0,
+                      gt.ry, gt.dy, 0.0, gt.y0,
+                      0.0, 0.0, 0.0, 0.0,
+                      0.0, 0.0, 0.0, 1.0]))
     if ascii_params:
         tags.append((T_GEO_ASCII, 2, ascii_params))
     if nodata is not None:

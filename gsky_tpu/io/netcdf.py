@@ -470,8 +470,17 @@ class _NC3Reader:
                 tkey, rest = key[0], key[1:]
             else:
                 tkey, rest = key, ()
-            idxs = range(var.shape[0])[tkey] if isinstance(tkey, slice) \
-                else [int(tkey)]
+            if isinstance(tkey, slice):
+                idxs = range(var.shape[0])[tkey]
+            else:
+                t = int(tkey)
+                if t < 0:
+                    t += var.shape[0]
+                if not 0 <= t < var.shape[0]:
+                    raise IndexError(
+                        f"record index {tkey} out of range for "
+                        f"{var.name} with {var.shape[0]} records")
+                idxs = [t]
             recs = []
             for t in idxs:
                 off = self.begin + t * self.rec_stride
@@ -526,8 +535,13 @@ def write_netcdf3(path: str, arrays: Dict[str, np.ndarray],
                 _NC3_DTYPES[typ]).tobytes()
             return typ, raw, True
         if k == "i8":
+            if arr.size and (arr.max() > 2**31 - 1 or arr.min() < -2**31):
+                raise ValueError("int64 values exceed NetCDF-3 int range")
             arr = arr.astype(np.int32)
             k = "i4"
+        if k not in ("i1", "i2", "i4", "f4", "f8"):
+            raise ValueError(f"dtype {arr.dtype} not representable in "
+                             "NetCDF-3 classic")
         typ = {"i1": 1, "i2": 3, "i4": 4, "f4": 5, "f8": 6}[k]
         return typ, arr.astype(_NC3_DTYPES[typ]).tobytes(), False
 

@@ -27,6 +27,7 @@ def encode_png(bands: Sequence[np.ndarray],
             # greyscale ramp with transparent nodata
             lut = np.stack([np.arange(256)] * 3 + [np.full(256, 255)], 1)
             lut = lut.astype(np.uint8)
+            lut[NODATA_BYTE] = (0, 0, 0, 0)
         else:
             lut = np.asarray(palette, np.uint8)
             if lut.shape != (256, 4):

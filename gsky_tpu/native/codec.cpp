@@ -32,9 +32,8 @@ long lzw_decode(const uint8_t* src, long src_len, uint8_t* dst, long dst_len) {
     int prev_code = -1;
 
     auto emit = [&](int code) -> bool {
-        // write the expansion of `code` at dst+out (backwards fill)
-        int32_t len = table[code].len;
-        if (out + len > dst_len) len = (int32_t)(dst_len - out);
+        // write the expansion of `code` at dst+out (backwards fill);
+        // per-byte `w < dst_len` guard below handles truncation
         long end = out + table[code].len;
         long w = end - 1;
         int c = code;

@@ -1,0 +1,60 @@
+"""Shared synthetic test data: a small Landsat-ish archive of GeoTIFF
+granules + a NetCDF time-series, with a populated in-memory MAS store."""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from gsky_tpu.geo.crs import EPSG4326, parse_crs
+from gsky_tpu.geo.transform import BBox, GeoTransform
+from gsky_tpu.index import MASStore
+from gsky_tpu.index.crawler import extract
+from gsky_tpu.io import write_geotiff
+from gsky_tpu.io.netcdf import write_netcdf3
+
+UTM55 = parse_crs("EPSG:32755")
+
+
+def make_archive(root: str, *, scenes: int = 2, size: int = 512,
+                 with_nc: bool = True) -> Dict:
+    """Create overlapping UTM-55S granules around (148.2E, -35.3S) with
+    distinct acquisition dates + a lat/lon NetCDF time series.
+
+    Returns {"store": MASStore, "paths": [...], "bbox3857": BBox}.
+    """
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(99)
+    paths: List[str] = []
+    # granule grid: 30 m pixels, shifted origins so scenes overlap
+    for i in range(scenes):
+        gt = GeoTransform(590000.0 + i * size * 30 // 2, 30.0, 0.0,
+                          6105000.0 - i * size * 30 // 4, 0.0, -30.0)
+        data = (rng.uniform(200, 3000, (size, size))).astype(np.int16)
+        data[: size // 8, : size // 8] = -999  # nodata corner
+        date = f"2020-01-{10 + i:02d}"
+        p = os.path.join(root, f"LC08_{date.replace('-', '')}_T1.tif")
+        write_geotiff(p, data, gt, UTM55, nodata=-999)
+        paths.append(p)
+    if with_nc:
+        x = np.linspace(147.5, 149.5, 128)
+        y = np.linspace(-34.5, -36.5, 128)
+        times = np.array(
+            [dt.datetime(2020, 1, d, tzinfo=dt.timezone.utc).timestamp()
+             for d in (10, 11, 12)])
+        fc = rng.uniform(0, 100, (3, 128, 128)).astype(np.float32)
+        fc[:, :10, :10] = -1.0
+        p = os.path.join(root, "fc_metrics_2020.nc")
+        write_netcdf3(p, {"phot_veg": fc, "bare_soil": fc * 0.5}, x, y,
+                      EPSG4326, times=times, nodata=-1.0)
+        paths.append(p)
+
+    store = MASStore()
+    for p in paths:
+        rec = extract(p, approx_stats=True)
+        assert not rec.get("error"), rec
+        store.ingest(rec)
+    return {"store": store, "paths": paths, "root": root}

@@ -1,0 +1,213 @@
+"""MAS index tests: store queries, HTTP API contract, crawler, client."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from gsky_tpu.geo.crs import EPSG4326, parse_crs
+from gsky_tpu.geo.transform import BBox, GeoTransform, transform_bbox
+from gsky_tpu.index import MASClient, MASStore
+from gsky_tpu.index.api import build_app, ingest_file
+from gsky_tpu.index.crawler import extract, timestamp_from_filename
+from gsky_tpu.index.store import fmt_time, parse_time
+
+from fixtures import make_archive
+
+
+@pytest.fixture(scope="module")
+def archive(tmp_path_factory):
+    return make_archive(str(tmp_path_factory.mktemp("arch")))
+
+
+class TestTimeParse:
+    def test_roundtrip(self):
+        t = parse_time("2020-01-10T00:00:00.000Z")
+        assert fmt_time(t) == "2020-01-10T00:00:00.000Z"
+
+    def test_formats(self):
+        assert parse_time("2020-01-10") == parse_time("2020-01-10T00:00:00Z")
+
+    def test_filename_patterns(self):
+        assert timestamp_from_filename("LC08_20200110_T1.tif") == \
+            "2020-01-10T00:00:00.000Z"
+        assert timestamp_from_filename("MOD13_A2018123.hdf.tif") == \
+            "2018-05-03T00:00:00.000Z"
+        assert timestamp_from_filename("x_2013-02-10_y.nc") == \
+            "2013-02-10T00:00:00.000Z"
+        assert timestamp_from_filename("nodate.tif") is None
+
+
+class TestCrawler:
+    def test_geotiff_record(self, archive):
+        rec = extract(archive["paths"][0])
+        assert rec["file_type"] == "GeoTIFF"
+        md = rec["geo_metadata"][0]
+        assert md["array_type"] == "Int16"
+        assert md["nodata"] == -999
+        assert md["timestamps"] == ["2020-01-10T00:00:00.000Z"]
+        assert md["polygon"].startswith("POLYGON")
+        assert len(md["geotransform"]) == 6
+
+    def test_netcdf_record(self, archive):
+        rec = extract(archive["paths"][-1])
+        assert rec["file_type"] == "NetCDF"
+        names = {m["namespace"] for m in rec["geo_metadata"]}
+        assert names == {"phot_veg", "bare_soil"}
+        md = rec["geo_metadata"][0]
+        assert len(md["timestamps"]) == 3
+        assert md["axes"][0]["name"] == "time"
+
+    def test_approx_stats(self, archive):
+        rec = extract(archive["paths"][0], approx_stats=True)
+        md = rec["geo_metadata"][0]
+        assert md["sample_counts"][0] > 0
+        assert 200 <= md["means"][0] <= 3000
+
+
+class TestStoreQueries:
+    def test_intersects_files(self, archive):
+        store = archive["store"]
+        resp = store.intersects("/", srs="EPSG:4326",
+                                wkt="POLYGON((148 -35.5,148.5 -35.5,"
+                                    "148.5 -35,148 -35,148 -35.5))")
+        assert len(resp["files"]) >= 2
+
+    def test_intersects_gdal_metadata(self, archive):
+        store = archive["store"]
+        resp = store.intersects(
+            "/", srs="EPSG:4326",
+            wkt="POLYGON((148 -35.5,148.5 -35.5,148.5 -35,148 -35,148 -35.5))",
+            metadata="gdal", time="2020-01-10T00:00:00.000Z")
+        gdal = resp["gdal"]
+        assert gdal
+        d = gdal[0]
+        for k in ("file_path", "ds_name", "namespace", "array_type", "srs",
+                  "geo_transform", "timestamps", "polygon", "nodata"):
+            assert k in d
+
+    def test_time_filtering(self, archive):
+        store = archive["store"]
+        wkt = "POLYGON((148 -36,149 -36,149 -35,148 -35,148 -36))"
+        r1 = store.intersects("/", srs="EPSG:4326", wkt=wkt,
+                              time="2020-01-11T00:00:00.000Z",
+                              metadata="gdal")
+        # only scene 2 + the nc (covering 01-10..01-12) match exactly 01-11
+        paths = {d["file_path"] for d in r1["gdal"]}
+        assert any("20200111" in p for p in paths)
+        assert not any("20200110" in p for p in paths)
+        r2 = store.intersects("/", srs="EPSG:4326", wkt=wkt,
+                              time="2020-01-09T00:00:00.000Z",
+                              until="2020-01-12T00:00:00.000Z",
+                              metadata="gdal")
+        assert len(r2["gdal"]) > len(r1["gdal"])
+
+    def test_namespace_filter(self, archive):
+        store = archive["store"]
+        wkt = "POLYGON((148 -36,149 -36,149 -35,148 -35,148 -36))"
+        r = store.intersects("/", srs="EPSG:4326", wkt=wkt,
+                             namespaces=["phot_veg"], metadata="gdal")
+        assert {d["namespace"] for d in r["gdal"]} == {"phot_veg"}
+
+    def test_disjoint_geometry(self, archive):
+        r = archive["store"].intersects(
+            "/", srs="EPSG:4326",
+            wkt="POLYGON((10 10,11 10,11 11,10 11,10 10))")
+        assert r["files"] == []
+
+    def test_3857_query(self, archive):
+        # same tile requested in web mercator coords
+        b = transform_bbox(BBox(148.0, -35.5, 148.5, -35.0), EPSG4326,
+                           parse_crs("EPSG:3857"))
+        r = archive["store"].intersects(
+            "/", srs="EPSG:3857", wkt=b.to_polygon_wkt())
+        assert len(r["files"]) >= 2
+
+    def test_timestamps_and_token(self, archive):
+        store = archive["store"]
+        r = store.timestamps("/")
+        assert len(r["timestamps"]) >= 3
+        assert r["timestamps"] == sorted(r["timestamps"])
+        # token short-circuit
+        r2 = store.timestamps("/", token=r["token"])
+        assert r2["timestamps"] == []
+        assert r2["token"] == r["token"]
+        # time-windowed
+        r3 = store.timestamps("/", time="2020-01-11T00:00:00.000Z",
+                              until="2020-01-11T23:59:59.000Z")
+        assert r3["timestamps"] == ["2020-01-11T00:00:00.000Z"]
+
+    def test_extents(self, archive):
+        r = archive["store"].extents("/")
+        assert "phot_veg" in r["variables"]
+        assert r["min_stamp"] == "2020-01-10T00:00:00.000Z"
+        assert r["xmin"] < r["xmax"]
+        # 3857 envelope should cover ~148E
+        assert r["xmax"] > 16_400_000
+
+    def test_path_prefix_scoping(self, archive):
+        r = archive["store"].intersects("/nonexistent/prefix",
+                                        srs="", wkt="")
+        assert r["files"] == []
+
+
+class TestHTTPAPI:
+    @pytest.fixture
+    def client(self, archive, aiohttp_client_factory=None):
+        return build_app(archive["store"])
+
+    def _request(self, app, path):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def go():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.get(path)
+                return resp.status, await resp.json()
+            finally:
+                await client.close()
+        return asyncio.new_event_loop().run_until_complete(go())
+
+    def test_intersects_http(self, client):
+        wkt = "POLYGON((148 -36,149 -36,149 -35,148 -35,148 -36))"
+        status, j = self._request(
+            client, f"/?intersects&metadata=gdal&srs=EPSG:4326&wkt={wkt}")
+        assert status == 200
+        assert j["gdal"]
+
+    def test_timestamps_http(self, client):
+        status, j = self._request(client, "/?timestamps")
+        assert status == 200
+        assert j["timestamps"]
+
+    def test_unknown_op(self, client):
+        status, j = self._request(client, "/?frobnicate")
+        assert status == 400
+        assert "unknown operation" in j["error"]
+
+
+class TestClientFacade:
+    def test_direct_client(self, archive):
+        c = MASClient(archive["store"])
+        ds = c.intersects("/", srs="EPSG:4326",
+                          wkt="POLYGON((148 -36,149 -36,149 -35,148 -35,"
+                              "148 -36))",
+                          time="2020-01-10T00:00:00.000Z",
+                          until="2020-01-12T00:00:00.000Z")
+        assert ds
+        assert ds[0].timestamps  # parsed to unix
+        assert isinstance(ds[0].nodata, float)
+        ts = c.timestamps("/")
+        assert ts["timestamps"]
+
+    def test_ingest_file_tsv(self, tmp_path, archive):
+        rec = extract(archive["paths"][0])
+        p = str(tmp_path / "crawl.tsv")
+        with open(p, "w") as fp:
+            fp.write(f"{rec['filename']}\tgdal\t{json.dumps(rec)}\n")
+        store = MASStore()
+        n = ingest_file(store, p)
+        assert n == 1
+        assert store.list_files() == [rec["filename"]]

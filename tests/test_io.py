@@ -384,3 +384,43 @@ class TestPredictors:
         monkeypatch.setattr(gtf, "_native", None)
         with GeoTIFF(p) as g:
             np.testing.assert_array_equal(g.read(1), data)
+
+
+class TestIOReviewRegressions:
+    def test_default_png_nodata_transparent(self):
+        img = np.array([[10, 255]], np.uint8)
+        rgba = decode_png(encode_png([img]))
+        assert rgba[0, 0, 3] == 255
+        assert rgba[0, 1, 3] == 0  # nodata transparent by default
+
+    def test_nc3_negative_and_oob_record_index(self, tmp_path):
+        from scipy.io import netcdf_file
+        p = str(tmp_path / "rec2.nc")
+        f = netcdf_file(p, "w")
+        f.createDimension("time", None)
+        f.createDimension("x", 3)
+        v = f.createVariable("v", np.int16, ("time", "x"))
+        data = np.arange(12, dtype=np.int16).reshape(4, 3)
+        for i in range(4):
+            v[i] = data[i]
+        f.flush(); f.close()
+        with NetCDF(p) as nc:
+            np.testing.assert_array_equal(
+                nc.variables["v"][(-1, slice(None))], data[-1])
+            with pytest.raises(IndexError):
+                nc.variables["v"][(7, slice(None))]
+
+    def test_south_up_geotiff_roundtrip(self, tmp_path):
+        p = str(tmp_path / "southup.tif")
+        gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, 1.0)  # dy positive
+        data = np.arange(12, dtype=np.float32).reshape(3, 4)
+        write_geotiff(p, data, gt, EPSG4326)
+        with GeoTIFF(p) as g:
+            assert g.gt.dy == 1.0
+            np.testing.assert_array_equal(g.read(1), data)
+
+    def test_nc3_int64_overflow_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            write_netcdf3(str(tmp_path / "x.nc"),
+                          {"t": np.array([[2 ** 40]], np.int64)},
+                          np.arange(1.0), np.arange(1.0), EPSG4326)
