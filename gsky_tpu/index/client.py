@@ -121,8 +121,17 @@ class MASClient:
             raise ValueError(op)
         qs = urllib.parse.urlencode({op: "", **params})
         url = f"http://{self.address}{urllib.parse.quote(gpath)}?{qs}"
-        with urllib.request.urlopen(url, timeout=60) as resp:
-            return json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # surface masapi's JSON error body instead of a bare 400/500
+            try:
+                body = json.loads(e.read())
+            except Exception:
+                raise RuntimeError(f"MAS HTTP {e.code}") from e
+            raise RuntimeError(
+                f"MAS error: {body.get('error', e.code)}") from e
 
     def intersects(self, gpath: str, *, srs: str = "", wkt: str = "",
                    time: str = "", until: str = "", namespaces: str = "",

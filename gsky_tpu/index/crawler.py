@@ -29,7 +29,7 @@ from ..geo.transform import GeoTransform
 from ..io.geotiff import GeoTIFF
 from ..io.netcdf import NetCDF
 from ..ops.raster import NP_TO_GDAL
-from .store import ISO, fmt_time
+from .store import ISO, fmt_time, sanitize_namespace
 
 # filename timestamp patterns (generic subset of the reference's 13
 # product rules, `worker/gdalprocess/info.go:42-57`)
@@ -86,8 +86,8 @@ def _approx_stats(data: np.ndarray, nodata) -> Dict:
 def extract_geotiff(path: str, namespace: Optional[str] = None,
                     approx_stats: bool = False) -> Dict:
     with GeoTIFF(path) as g:
-        stem = re.sub(r"[^a-zA-Z0-9_]", "_",
-                      os.path.splitext(os.path.basename(path))[0])
+        stem = sanitize_namespace(
+            os.path.splitext(os.path.basename(path))[0])
         ts = timestamp_from_filename(path)
         geo_md = []
         for b in range(1, g.count + 1):

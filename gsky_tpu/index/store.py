@@ -94,12 +94,27 @@ class MASStore:
         self._db_path = db_path
         self._local = threading.local()
         self._memory_conn: Optional[sqlite3.Connection] = None
+        # a single :memory: connection is shared across threads, so every
+        # statement must serialise through _lock; file databases get one
+        # connection per thread instead and need no lock
+        self._lock = threading.Lock()
         if db_path == ":memory:":
             self._memory_conn = sqlite3.connect(":memory:",
                                                 check_same_thread=False)
-            self._memory_lock = threading.Lock()
-        self._conn().executescript(_SCHEMA)
-        self._conn().commit()
+        with self._maybe_lock():
+            self._conn().executescript(_SCHEMA)
+            self._conn().commit()
+        self._columns = [d[0] for d in self._conn().execute(
+            "SELECT * FROM datasets LIMIT 0").description]
+
+    def _maybe_lock(self):
+        import contextlib
+        return self._lock if self._memory_conn is not None \
+            else contextlib.nullcontext()
+
+    def _fetchall(self, sql: str, args=()) -> List[tuple]:
+        with self._maybe_lock():
+            return self._conn().execute(sql, args).fetchall()
 
     def _conn(self) -> sqlite3.Connection:
         if self._memory_conn is not None:
@@ -120,6 +135,10 @@ class MASStore:
         path = record.get("filename") or record.get("file_path")
         if not path:
             raise ValueError("record missing filename")
+        with self._maybe_lock():
+            return self._ingest_locked(record, path)
+
+    def _ingest_locked(self, record: Dict, path: str) -> int:
         conn = self._conn()
         conn.execute("INSERT OR REPLACE INTO files(path, file_type, meta) "
                      "VALUES (?,?,?)",
@@ -213,9 +232,8 @@ class MASStore:
         if namespaces:
             sql += " AND namespace IN (%s)" % ",".join("?" * len(namespaces))
             args += list(namespaces)
-        rows = self._conn().execute(sql, args).fetchall()
-        cols = [d[0] for d in self._conn().execute(
-            "SELECT * FROM datasets LIMIT 0").description]
+        rows = self._fetchall(sql, args)
+        cols = self._columns
 
         # refine: exact polygon intersection in 4326
         out_rows = []
@@ -276,7 +294,7 @@ class MASStore:
             sql += " AND namespace IN (%s)" % ",".join("?" * len(namespaces))
             args += list(namespaces)
         stamps = set()
-        for (ts_json,) in self._conn().execute(sql, args):
+        for (ts_json,) in self._fetchall(sql, args):
             for s in json.loads(ts_json or "[]"):
                 t = parse_time(s)
                 if (t_a is None or t >= t_a) and t <= t_b:
@@ -297,7 +315,7 @@ class MASStore:
         if namespaces:
             sql += " AND namespace IN (%s)" % ",".join("?" * len(namespaces))
             args += list(namespaces)
-        rows = self._conn().execute(sql, args).fetchall()
+        rows = self._fetchall(sql, args)
         if not rows:
             return {}
         nss = sorted({r[0] for r in rows if r[0]})
@@ -319,14 +337,19 @@ class MASStore:
         return out
 
     def list_files(self) -> List[str]:
-        return [r[0] for r in self._conn().execute(
+        return [r[0] for r in self._fetchall(
             "SELECT path FROM files ORDER BY path")]
 
 
-def _sanitize_ns(ns: str) -> str:
-    """`regexp_replace(trim(ns), '[^a-zA-Z0-9_]', '_')` (mas.sql:495)."""
+def sanitize_namespace(ns: str) -> str:
+    """`regexp_replace(trim(ns), '[^a-zA-Z0-9_]', '_')` (mas.sql:495) —
+    the single source of the namespace character rule, shared with the
+    crawler."""
     import re
     return re.sub(r"[^a-zA-Z0-9_]", "_", ns.strip())
+
+
+_sanitize_ns = sanitize_namespace
 
 
 def _float_or_none(v) -> Optional[float]:

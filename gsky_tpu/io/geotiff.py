@@ -179,12 +179,14 @@ class GeoTIFF:
     `overviews` (list of (factor, IFD))."""
 
     def __init__(self, path_or_fp: Union[str, BinaryIO]):
+        import threading
         if isinstance(path_or_fp, (str, bytes)):
             self._fp = open(path_or_fp, "rb")
             self.path = path_or_fp
         else:
             self._fp = path_or_fp
             self.path = getattr(path_or_fp, "name", "<memory>")
+        self._fp_lock = threading.Lock()
         self._parse_header()
         self._parse_geo()
 
@@ -435,8 +437,9 @@ class GeoTIFF:
 
     def _decode_block(self, offset: int, nbytes: int, comp: int, pred: int,
                       rows: int, cols: int, samples: int, dt: np.dtype) -> np.ndarray:
-        self._fp.seek(offset)
-        raw = self._fp.read(nbytes)
+        with self._fp_lock:  # shared handles are read from worker threads
+            self._fp.seek(offset)
+            raw = self._fp.read(nbytes)
         expected = rows * cols * samples * dt.itemsize
         data = _decompress(raw, comp, expected)
         if len(data) < expected:

@@ -337,8 +337,10 @@ class _NC3File:
     property the GSKY_netCDF fork exists for)."""
 
     def __init__(self, path: str):
+        import threading
         self.path = path
         self._fp = open(path, "rb")
+        self._fp_lock = threading.Lock()
         b = self._fp.read(4)
         if b[:3] != b"CDF" or b[3] not in (1, 2):
             raise ValueError("not a NetCDF classic file")
@@ -352,8 +354,9 @@ class _NC3File:
         self._parse_vars()
 
     def read_at(self, pos: int, n: int) -> bytes:
-        self._fp.seek(pos)
-        return self._fp.read(n)
+        with self._fp_lock:  # shared handles are read from worker threads
+            self._fp.seek(pos)
+            return self._fp.read(n)
 
     # -- primitive header readers --
 

@@ -1,0 +1,7 @@
+from .types import GeoTileRequest, GeoDrillRequest, Granule, MaskSpec
+from .tile import TilePipeline
+from .drill import DrillPipeline
+from .extent import compute_reprojection_extent
+
+__all__ = ["GeoTileRequest", "GeoDrillRequest", "Granule", "MaskSpec",
+           "TilePipeline", "DrillPipeline", "compute_reprojection_extent"]

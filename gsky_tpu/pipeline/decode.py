@@ -1,0 +1,149 @@
+"""Granule window decoding: the host-side IO stage feeding the TPU.
+
+Plays the role of the reference's GDAL subprocess reads
+(`worker/gdalprocess/warp.go:89-101` + block IO `:259-345`): for each
+granule, work out which source window the dst tile's gather footprint
+touches, read only that window (GeoTIFF tile/strip subset or NetCDF
+hyperslab), and hand back float32 + validity.  Reads run in a thread pool
+(decode releases the GIL in zlib/h5py) — the analogue of the process pool
+(`worker/gdalprocess/pool.go`), without needing crash isolation since
+there's no C library state to corrupt.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..geo.crs import CRS, parse_crs
+from ..geo.transform import BBox, GeoTransform, transform_bbox
+from ..io.geotiff import GeoTIFF
+from ..io.netcdf import NetCDF
+from ..ops.raster import nodata_mask
+from .types import Granule
+
+
+@dataclass
+class DecodedWindow:
+    granule: Granule
+    data: np.ndarray          # (h, w) float32
+    valid: np.ndarray         # (h, w) bool
+    window_gt: GeoTransform   # georeferencing of the window
+    src_crs: CRS
+
+
+class _HandleCache:
+    """Open-file handle cache (the expensive part of GDAL open that
+    band_query exists to avoid is amortised here)."""
+
+    def __init__(self, max_handles: int = 64):
+        self._lock = threading.Lock()
+        self._handles: Dict[str, object] = {}
+        self._order: List[str] = []
+        self._max = max_handles
+
+    def get(self, path: str, is_netcdf: bool):
+        with self._lock:
+            h = self._handles.get(path)
+            if h is not None:
+                return h
+        h = NetCDF(path) if is_netcdf else GeoTIFF(path)
+        with self._lock:
+            if path in self._handles:
+                h.close()
+                return self._handles[path]
+            self._handles[path] = h
+            self._order.append(path)
+            while len(self._order) > self._max:
+                old = self._order.pop(0)
+                try:
+                    self._handles.pop(old).close()
+                except Exception:
+                    pass
+        return h
+
+
+_handles = _HandleCache()
+
+
+def margin_for(resample: str) -> int:
+    return {"near": 1, "nearest": 1, "bilinear": 2, "cubic": 3}.get(resample, 2)
+
+
+def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
+                  resample: str = "near") -> Optional[DecodedWindow]:
+    """Read the source window covering dst_bbox (+ resample margin).
+    Returns None when the granule doesn't intersect the tile."""
+    src_crs = parse_crs(granule.srs) if granule.srs else dst_crs
+    gt = GeoTransform.from_gdal(granule.geo_transform)
+    try:
+        src_bbox = transform_bbox(dst_bbox, dst_crs, src_crs)
+    except ValueError:
+        return None
+
+    margin = margin_for(resample)
+    h = _handles.get(granule.path, granule.is_netcdf)
+    if granule.is_netcdf:
+        v = h.variables.get(granule.var_name)
+        if v is None:
+            return None
+        H, W = v.shape[-2], v.shape[-1]
+        win = _pixel_window(gt, src_bbox, W, H, margin)
+        if win is None:
+            return None
+        c0, r0, w, ww = win
+        data = h.read_slice(granule.var_name, granule.time_index,
+                            (c0, r0, w, ww))
+        nodata = granule.nodata if granule.nodata is not None else v.nodata
+    else:
+        W, H = h.width, h.height
+        win = _pixel_window(gt, src_bbox, W, H, margin)
+        if win is None:
+            return None
+        c0, r0, w, ww = win
+        data = h.read(granule.band, (c0, r0, w, ww))
+        nodata = granule.nodata if granule.nodata is not None else h.nodata
+    window_gt = gt.window(win[0], win[1])
+    valid = nodata_mask(data, nodata)
+    return DecodedWindow(granule, data.astype(np.float32), valid,
+                         window_gt, src_crs)
+
+
+def _pixel_window(gt: GeoTransform, bbox: BBox, W: int, H: int,
+                  margin: int) -> Optional[Tuple[int, int, int, int]]:
+    import math
+    c0, r0 = gt.geo_to_pixel(bbox.xmin, bbox.ymax)
+    c1, r1 = gt.geo_to_pixel(bbox.xmax, bbox.ymin)
+    c0, c1 = sorted((c0, c1))
+    r0, r1 = sorted((r0, r1))
+    c0 = max(int(math.floor(c0)) - margin, 0)
+    r0 = max(int(math.floor(r0)) - margin, 0)
+    c1 = min(int(math.ceil(c1)) + margin, W)
+    r1 = min(int(math.ceil(r1)) + margin, H)
+    if c0 >= c1 or r0 >= r1:
+        return None
+    return c0, r0, c1 - c0, r1 - r0
+
+
+def decode_all(granules: List[Granule], dst_bbox: BBox, dst_crs: CRS,
+               resample: str = "near",
+               workers: int = 8) -> List[Optional[DecodedWindow]]:
+    """Decode all granule windows concurrently, preserving order."""
+    if not granules:
+        return []
+    with cf.ThreadPoolExecutor(min(workers, len(granules))) as ex:
+        return list(ex.map(
+            lambda g: _safe_decode(g, dst_bbox, dst_crs, resample), granules))
+
+
+def _safe_decode(g, dst_bbox, dst_crs, resample):
+    try:
+        return decode_window(g, dst_bbox, dst_crs, resample)
+    except Exception:
+        # failures degrade to an empty granule, not a failed request
+        # (EmptyTile sentinel behaviour, `tile_indexer.go:106,211,307`)
+        return None

@@ -1,0 +1,277 @@
+"""The drill pipeline: polygon time-series statistics (WPS Execute).
+
+Reference dataflow: DrillIndexer -> GeoDrillGRPC -> DrillMerger
+(`processor/drill_pipeline.go`).  Here:
+
+1. index: MAS ?intersects with the polygon WKT
+2. fast path: crawler-precomputed means/sample_counts answer without
+   touching files (`processor/drill_grpc.go:70-93`)
+3. else per file: rasterize the polygon into the file grid (the
+   GDALRasterizeGeometries burn, `worker/gdalprocess/drill.go:275-327`),
+   read the masked window, run the banded reductions on device
+   (`gsky_tpu.ops.drill`), optionally strided + interpolated
+4. merge: per-date weighted means across files (weights = pixel counts,
+   `processor/drill_merger.go:54-93`), then band expressions per date
+   (`drill_merger.go:110-155`); decile columns become `ns_d1..9`
+   namespaces (`drill_pipeline.go:72-83`)
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..geo import geometry as geom
+from ..geo.crs import EPSG4326, parse_crs
+from ..geo.transform import GeoTransform
+from ..index.client import Dataset, MASClient
+from ..index.store import fmt_time
+from ..io.geotiff import GeoTIFF
+from ..io.netcdf import NetCDF
+from ..ops import drill as D
+from ..ops.raster import nodata_mask
+from .types import DrillResult, GeoDrillRequest
+
+_BIG = 3.0e38
+
+
+class DrillPipeline:
+    def __init__(self, mas: MASClient):
+        self.mas = mas
+
+    def index(self, req: GeoDrillRequest) -> List[Dataset]:
+        kw = dict(srs="EPSG:4326", wkt=req.geometry_wkt,
+                  namespaces=",".join(req.band_exprs.var_list))
+        if req.start_time is not None:
+            kw["time"] = fmt_time(req.start_time)
+        if req.end_time is not None:
+            kw["until"] = fmt_time(req.end_time)
+        return self.mas.intersects(req.collection, **kw)
+
+    def process(self, req: GeoDrillRequest) -> DrillResult:
+        datasets = self.index(req)
+        g4326 = geom.from_wkt(req.geometry_wkt)
+
+        # (namespace, date) -> [(value, count)] accumulated across files
+        acc: Dict[Tuple[str, float], List[Tuple[float, int]]] = defaultdict(list)
+
+        for ds in datasets:
+            sel = _selected_times(ds, req)
+            if not sel:
+                continue
+            if req.approx and ds.means and ds.sample_counts \
+                    and len(ds.means) >= len(ds.timestamps):
+                # crawler-stats fast path: no file IO at all
+                for ti in sel:
+                    date = ds.timestamps[ti] if ds.timestamps else 0.0
+                    acc[(ds.namespace, date)].append(
+                        (float(ds.means[min(ti, len(ds.means) - 1)]),
+                         int(ds.sample_counts[min(ti, len(ds.sample_counts) - 1)])))
+                continue
+            stats = _drill_file(ds, sel, g4326, req)
+            if stats is None:
+                continue
+            values, counts, deciles = stats
+            for k, ti in enumerate(sel):
+                date = ds.timestamps[ti] if ds.timestamps else 0.0
+                acc[(ds.namespace, date)].append(
+                    (float(values[k]), int(counts[k])))
+                for d in range(req.deciles):
+                    acc[(f"{ds.namespace}_d{d + 1}", date)].append(
+                        (float(deciles[k, d]), 1))
+
+        return _merge(acc, req)
+
+
+def _selected_times(ds: Dataset, req: GeoDrillRequest) -> List[int]:
+    if not ds.timestamps:
+        return [0]
+    out = []
+    for i, t in enumerate(ds.timestamps):
+        if req.start_time is not None and t < req.start_time - 1:
+            continue
+        if req.end_time is not None and t > req.end_time + 1:
+            continue
+        out.append(i)
+    return out
+
+
+def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
+                req: GeoDrillRequest):
+    """Masked reductions for the selected bands of one file."""
+    try:
+        src_crs = parse_crs(ds.srs) if ds.srs else EPSG4326
+    except ValueError:
+        return None
+    gt = GeoTransform.from_gdal(ds.geo_transform)
+    g = g4326 if src_crs == EPSG4326 else g4326.transform(
+        lambda x, y: EPSG4326.transform_to(src_crs, x, y))
+
+    is_nc = ds.file_path.lower().endswith((".nc", ".nc4")) \
+        or ds.ds_name.upper().startswith("NETCDF:")
+    try:
+        if is_nc:
+            h = NetCDF(ds.file_path)
+            var = ds.ds_name.split(":")[-1].strip('"')
+            v = h.variables[var]
+            H, W = v.shape[-2], v.shape[-1]
+        else:
+            h = GeoTIFF(ds.file_path)
+            H, W = h.height, h.width
+    except (OSError, ValueError, KeyError):
+        return None
+
+    try:
+        # envelope intersect + ALL_TOUCHED mask burn
+        b = g.bbox()
+        c0, r0 = gt.geo_to_pixel(b.xmin, b.ymax)
+        c1, r1 = gt.geo_to_pixel(b.xmax, b.ymin)
+        c0, c1 = sorted((c0, c1))
+        r0, r1 = sorted((r0, r1))
+        c0 = max(int(math.floor(c0)), 0)
+        r0 = max(int(math.floor(r0)), 0)
+        c1 = min(int(math.ceil(c1)), W)
+        r1 = min(int(math.ceil(r1)), H)
+        if c0 >= c1 or r0 >= r1:
+            return None
+        wgt = gt.window(c0, r0)
+        mask = geom.rasterize(g, c1 - c0, r1 - r0,
+                              lambda x, y: wgt.geo_to_pixel(x, y),
+                              all_touched=True)
+        if not mask.any():
+            return None
+
+        # strided band reads with interpolation (`drill.go:119-214`)
+        stride = max(req.band_strides, 1)
+        read_idx: List[int] = []
+        for s in range(0, len(sel), stride):
+            e = min(s + stride, len(sel))
+            read_idx.append(s)
+            if e - 1 != s:
+                read_idx.append(e - 1)
+        read_idx = sorted(set(read_idx))
+
+        band0 = 1
+        if not is_nc and ":" in ds.ds_name \
+                and ds.ds_name.rsplit(":", 1)[-1].isdigit():
+            band0 = int(ds.ds_name.rsplit(":", 1)[-1])
+        bands_data = []
+        for k in read_idx:
+            ti = sel[k]
+            if is_nc:
+                data = h.read_slice(var, ti if len(v.shape) > 2 else None,
+                                    (c0, r0, c1 - c0, r1 - r0))
+                nodata = ds.nodata if ds.nodata is not None else v.nodata
+            else:
+                # GeoTIFF granules carry one timestamp per file; the band
+                # index comes from the crawler's ds_name suffix
+                data = h.read(band0, (c0, r0, c1 - c0, r1 - r0))
+                nodata = ds.nodata if ds.nodata is not None else h.nodata
+            bands_data.append((data.astype(np.float32),
+                               nodata_mask(data, nodata)))
+
+        data = np.stack([d for d, _ in bands_data])
+        valid = np.stack([m for _, m in bands_data]) & (mask[None] > 0)
+        B = data.shape[0]
+        dataf = data.reshape(B, -1)
+        validf = valid.reshape(B, -1)
+        vals, counts = D.masked_mean(
+            jnp.asarray(dataf), jnp.asarray(validf),
+            clip_lower=req.clip_lower, clip_upper=req.clip_upper,
+            pixel_count=req.pixel_count)
+        vals = np.asarray(vals)
+        counts = np.asarray(counts)
+        if req.deciles:
+            dec = np.asarray(D.deciles(jnp.asarray(dataf),
+                                       jnp.asarray(validf), req.deciles))
+        else:
+            dec = np.zeros((B, 0), np.float32)
+
+        if stride > 1 and len(read_idx) < len(sel):
+            cols = np.concatenate([vals[:, None], dec], axis=1)
+            vi, ci = D.interp_strided(cols, np.tile(counts[:, None],
+                                                    (1, cols.shape[1])),
+                                      np.asarray(read_idx), len(sel))
+            vals = vi[:, 0]
+            dec = vi[:, 1:]
+            counts = ci[:, 0]
+        return vals, counts, dec
+    finally:
+        h.close()
+
+
+def _merge(acc, req: GeoDrillRequest) -> DrillResult:
+    """Weighted means per (namespace, date), then band expressions."""
+    dates = sorted({d for (_, d) in acc})
+    raw_ns = sorted({n for (n, _) in acc})
+    series: Dict[str, List[float]] = {}
+    counts: Dict[str, List[int]] = {}
+    for ns in raw_ns:
+        vs, cs = [], []
+        for d in dates:
+            items = acc.get((ns, d), [])
+            tot = sum(c for _, c in items)
+            if tot > 0:
+                vs.append(sum(v * c for v, c in items) / tot)
+            else:
+                vs.append(float("nan"))
+            cs.append(tot)
+        series[ns] = vs
+        counts[ns] = cs
+
+    exprs = req.band_exprs
+    out_values: Dict[str, List[float]] = {}
+    out_counts: Dict[str, List[int]] = {}
+    for ce, name in zip(exprs.expressions, exprs.expr_names):
+        if ce._ast[0] == "var" and ce.variables[0] in series:
+            out_values[name] = series[ce.variables[0]]
+            out_counts[name] = counts[ce.variables[0]]
+            continue
+        vs, cs = [], []
+        for di, d in enumerate(dates):
+            env = {}
+            ok = True
+            cnt = 0
+            for var in ce.variables:
+                if var not in series or math.isnan(series[var][di]):
+                    ok = False
+                    break
+                env[var] = np.float64(series[var][di])
+                cnt = max(cnt, counts[var][di])
+            if ok:
+                try:
+                    vs.append(float(ce(env, xp=np)))
+                except ZeroDivisionError:
+                    vs.append(float("nan"))
+            else:
+                vs.append(float("nan"))
+            cs.append(cnt if ok else 0)
+        out_values[name] = vs
+        out_counts[name] = cs
+    # decile columns pass through
+    for ns in raw_ns:
+        if "_d" in ns and ns not in out_values:
+            out_values[ns] = series[ns]
+            out_counts[ns] = counts[ns]
+    return DrillResult(dates, out_values, out_counts, raw_ns)
+
+
+def drill_csv(res: DrillResult, namespaces: Optional[List[str]] = None) -> str:
+    """CSV rows 'date,v1,v2,...' — the WPS template payload format
+    (`processor/drill_merger.go:161-171`)."""
+    import datetime as dt
+    ns = namespaces or list(res.values)
+    lines = []
+    for i, d in enumerate(res.dates):
+        stamp = dt.datetime.fromtimestamp(d, dt.timezone.utc) \
+            .strftime("%Y-%m-%d")
+        row = [stamp]
+        for n in ns:
+            v = res.values.get(n, [float("nan")] * len(res.dates))[i]
+            row.append("" if math.isnan(v) else f"{v:.4f}")
+        lines.append(",".join(row))
+    return "\n".join(lines)

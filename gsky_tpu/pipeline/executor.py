@@ -1,0 +1,112 @@
+"""The TPU warp executor: shape-bucketed batched gather dispatch.
+
+Replaces the reference's per-granule worker RPC fan-out
+(`processor/tile_grpc.go:219-242` + the C warp loop) with one XLA dispatch
+per (source-shape bucket, method): source windows are padded up to a small
+set of shapes so recompilation is bounded (SURVEY §7 "padded shape
+buckets"), coordinates are computed once per (dst grid, src CRS) in f64 on
+host and only the cheap affine part is per-granule.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..geo.crs import CRS
+from ..geo.transform import GeoTransform
+from ..ops.warp import warp_gather_batch
+from .decode import DecodedWindow
+
+# padded source-window shape buckets (H and W independently bucketed)
+_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def _bucket(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return int(math.ceil(n / 4096) * 4096)
+
+
+class WarpExecutor:
+    """Batches decoded granule windows into device dispatches."""
+
+    def __init__(self):
+        self._geo_cache: Dict[tuple, Tuple[np.ndarray, np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+    def _dst_geo_coords(self, dst_gt: GeoTransform, dst_crs: CRS,
+                        height: int, width: int,
+                        src_crs: CRS) -> Tuple[np.ndarray, np.ndarray]:
+        """(sx, sy): dst pixel centres projected into src CRS, cached —
+        the projection math is shared by every granule in that CRS (the
+        expensive part of `coord_grid`)."""
+        key = (dst_gt.to_gdal(), dst_crs, height, width, src_crs)
+        with self._lock:
+            hit = self._geo_cache.get(key)
+        if hit is not None:
+            return hit
+        c = np.arange(width, dtype=np.float64) + 0.5
+        r = np.arange(height, dtype=np.float64) + 0.5
+        C, R = np.meshgrid(c, r)
+        x, y = dst_gt.pixel_to_geo(C, R, np)
+        sx, sy = dst_crs.transform_to(src_crs, x, y, np)
+        sx = np.asarray(sx, np.float64)
+        sy = np.asarray(sy, np.float64)
+        with self._lock:
+            if len(self._geo_cache) > 256:
+                self._geo_cache.clear()
+            self._geo_cache[key] = (sx, sy)
+        return sx, sy
+
+    def warp_all(self, windows: Sequence[Optional[DecodedWindow]],
+                 dst_gt: GeoTransform, dst_crs: CRS, height: int, width: int,
+                 method: str = "near") -> List[Optional[Tuple[np.ndarray, np.ndarray]]]:
+        """Warp every decoded window onto the dst grid.  Returns, per
+        input, (data (H,W) f32, ok (H,W) bool) or None."""
+        jobs: List[Tuple[int, DecodedWindow, np.ndarray, np.ndarray]] = []
+        for i, wdw in enumerate(windows):
+            if wdw is None:
+                continue
+            sx, sy = self._dst_geo_coords(dst_gt, dst_crs, height, width,
+                                          wdw.src_crs)
+            col, row = wdw.window_gt.geo_to_pixel(sx, sy, np)
+            jobs.append((i, wdw, (row - 0.5).astype(np.float32),
+                         (col - 0.5).astype(np.float32)))
+
+        results: List[Optional[Tuple[np.ndarray, np.ndarray]]] = \
+            [None] * len(windows)
+        # bucket by padded source shape
+        buckets: Dict[Tuple[int, int], List] = {}
+        for job in jobs:
+            h, w = job[1].data.shape
+            buckets.setdefault((_bucket(h), _bucket(w)), []).append(job)
+
+        for (bh, bw), batch in buckets.items():
+            B = len(batch)
+            src = np.zeros((B, bh, bw), np.float32)
+            valid = np.zeros((B, bh, bw), bool)
+            rows = np.stack([j[2] for j in batch])
+            cols = np.stack([j[3] for j in batch])
+            for k, (_, wdw, _, _) in enumerate(batch):
+                h, w = wdw.data.shape
+                src[k, :h, :w] = wdw.data
+                valid[k, :h, :w] = wdw.valid
+            out, ok = warp_gather_batch(
+                jnp.asarray(src), jnp.asarray(valid),
+                jnp.asarray(rows), jnp.asarray(cols), method)
+            out = np.asarray(out)
+            ok = np.asarray(ok)
+            for k, (i, _, _, _) in enumerate(batch):
+                results[i] = (out[k], ok[k])
+        return results
+
+
+# module-level default executor (compile cache shared across requests)
+default_executor = WarpExecutor()

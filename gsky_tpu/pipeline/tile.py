@@ -1,0 +1,208 @@
+"""The tile pipeline: index -> decode -> batched TPU warp -> mosaic ->
+band expressions.
+
+The reference wires TileIndexer -> GeoRasterGRPC -> RasterMerger as
+channel-connected goroutine stages (`processor/tile_pipeline.go:51-146`);
+here the same dataflow is a function: the indexer is one MAS query +
+granule expansion, the worker fan-out is one batched device dispatch, and
+the merger is a vectorised mosaic + jit'd expressions.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..geo.crs import EPSG4326
+from ..index.client import MASClient
+from ..index.store import fmt_time
+from ..ops import mosaic as M
+from ..ops.expr import BandExpressions
+from .decode import decode_all
+from .executor import WarpExecutor, default_executor
+from .granule import expand_granules
+from .types import GeoTileRequest, Granule, TileResult
+
+log = logging.getLogger("gsky.tile")
+
+
+class TilePipeline:
+    def __init__(self, mas: MASClient, executor: Optional[WarpExecutor] = None,
+                 decode_workers: int = 8):
+        self.mas = mas
+        self.executor = executor or default_executor
+        self.decode_workers = decode_workers
+
+    # -- indexing ------------------------------------------------------------
+
+    def index(self, req: GeoTileRequest) -> List[Granule]:
+        """MAS query + axis intersection (the TileIndexer stage)."""
+        exprs = req.band_exprs
+        namespaces = list(exprs.var_list)
+        if req.mask is not None and req.mask.id \
+                and not req.mask.data_source:
+            if req.mask.id not in namespaces:
+                namespaces.append(req.mask.id)
+        wkt = req.bbox.to_polygon_wkt()
+        kw = dict(srs=req.crs.name(), wkt=wkt,
+                  namespaces=",".join(namespaces),
+                  nseg=req.polygon_segments, limit=req.query_limit)
+        if req.start_time is not None:
+            kw["time"] = fmt_time(req.start_time)
+        if req.end_time is not None:
+            kw["until"] = fmt_time(req.end_time)
+        datasets = self.mas.intersects(req.collection, **kw)
+        granules = expand_granules(datasets, req.start_time, req.end_time,
+                                   req.axes)
+        # separately indexed mask collection (`tile_indexer.go:265-284`)
+        if req.mask is not None and req.mask.data_source:
+            mkw = dict(kw)
+            mkw["namespaces"] = req.mask.id
+            mds = self.mas.intersects(req.mask.data_source, **mkw)
+            granules += expand_granules(mds, req.start_time, req.end_time,
+                                        req.axes)
+        return granules
+
+    # -- full render ---------------------------------------------------------
+
+    def process(self, req: GeoTileRequest) -> TileResult:
+        granules = self.index(req)
+        return self.render(req, granules)
+
+    def render(self, req: GeoTileRequest, granules: List[Granule]) -> TileResult:
+        exprs = req.band_exprs
+        H, W = req.height, req.width
+        if not granules:
+            return _empty_result(exprs, H, W)
+
+        mask_id = req.mask.id if req.mask is not None else None
+        # mask bands always resample nearest: interpolating bitfields is
+        # meaningless (the reference's warp kernel is nearest-only anyway)
+        is_mask = [mask_id is not None and g.base_namespace == mask_id
+                   for g in granules]
+        warped: List[Optional[Tuple[np.ndarray, np.ndarray]]] = \
+            [None] * len(granules)
+        for method, idxs in (
+                (req.resample, [i for i, m in enumerate(is_mask) if not m]),
+                ("near", [i for i, m in enumerate(is_mask) if m])):
+            if not idxs:
+                continue
+            ws = decode_all([granules[i] for i in idxs], req.bbox, req.crs,
+                            method, self.decode_workers)
+            wr = self.executor.warp_all(ws, req.dst_gt(), req.crs, H, W,
+                                        method)
+            for k, i in enumerate(idxs):
+                warped[i] = wr[k]
+        # group warped granules by base namespace
+        by_ns: Dict[str, List[Tuple[Granule, np.ndarray, np.ndarray]]] = {}
+        mask_by_stamp: Dict[float, np.ndarray] = {}
+        for g, wr in zip(granules, warped):
+            if wr is None:
+                continue
+            data, ok = wr
+            if mask_id is not None and g.base_namespace == mask_id:
+                excl = np.asarray(M.compute_bit_mask(
+                    _restore_int(data, g.array_type),
+                    req.mask.value or None, req.mask.bit_tests))
+                excl = np.where(ok, excl, False)
+                if req.mask.inclusive:
+                    excl = ~excl & ok
+                prev = mask_by_stamp.get(g.timestamp)
+                mask_by_stamp[g.timestamp] = \
+                    excl if prev is None else (prev | excl)
+                if mask_id not in [n for n in exprs.var_list]:
+                    continue
+            by_ns.setdefault(g.namespace, []).append((g, data, ok))
+
+        # mosaic per namespace (newest wins, older fills holes)
+        data_env: Dict[str, np.ndarray] = {}
+        valid_env: Dict[str, np.ndarray] = {}
+        for ns, items in by_ns.items():
+            rasters = [d for _, d, _ in items]
+            valids = []
+            for g, _, ok in items:
+                excl = mask_by_stamp.get(g.timestamp)
+                valids.append(ok & ~excl if excl is not None else ok)
+            stamps = [g.timestamp for g, _, _ in items]
+            out, okm = M.mosaic_stack_host(rasters, valids, stamps)
+            data_env[ns] = out
+            valid_env[ns] = okm
+
+        return evaluate_expressions(exprs, data_env, valid_env, H, W,
+                                    granule_count=len(granules),
+                                    file_count=len({g.path for g in granules}))
+
+
+def evaluate_expressions(exprs: BandExpressions,
+                         data_env: Dict[str, np.ndarray],
+                         valid_env: Dict[str, np.ndarray],
+                         H: int, W: int, granule_count: int = 0,
+                         file_count: int = 0) -> TileResult:
+    """Band-expression evaluation over mosaic canvases — the merger's
+    final stage (`processor/tile_merger.go:523-731`).  Variables the index
+    produced with axis suffixes (`var#axis=value`) are matched to the
+    plain variable when unambiguous."""
+    import jax.numpy as jnp
+
+    out_data: Dict[str, np.ndarray] = {}
+    out_valid: Dict[str, np.ndarray] = {}
+    names: List[str] = []
+
+    def lookup(var: str) -> Optional[str]:
+        if var in data_env:
+            return var
+        cands = [k for k in data_env if k.split("#")[0] == var]
+        return cands[0] if len(cands) == 1 else None
+
+    for ce, name in zip(exprs.expressions, exprs.expr_names):
+        env = {}
+        venv = {}
+        missing = False
+        for var in ce.variables:
+            k = lookup(var)
+            if k is None:
+                missing = True
+                break
+            env[var] = jnp.asarray(data_env[k])
+            venv[var] = jnp.asarray(valid_env[k])
+        if missing:
+            out_data[name] = np.zeros((H, W), np.float32)
+            out_valid[name] = np.zeros((H, W), bool)
+        elif ce._ast[0] == "var":
+            k = lookup(ce.variables[0])
+            out_data[name] = data_env[k].astype(np.float32)
+            out_valid[name] = valid_env[k]
+        else:
+            o, ok = ce.eval_masked(env, venv)
+            out_data[name] = np.asarray(o, np.float32)
+            out_valid[name] = np.asarray(ok)
+        names.append(name)
+
+    # axis-expanded outputs with no expression (`var#axis=value` pass
+    # through as extra namespaces)
+    for k in data_env:
+        if "#" in k and k not in out_data:
+            out_data[k] = data_env[k].astype(np.float32)
+        if "#" in k and k not in out_valid:
+            out_valid[k] = valid_env[k]
+            names.append(k)
+
+    return TileResult(out_data, out_valid, names, granule_count, file_count)
+
+
+def _restore_int(data: np.ndarray, array_type: str) -> np.ndarray:
+    """Warped mask bands come back float32; restore the integer type for
+    bitwise tests."""
+    from ..ops.raster import DTYPE_NP
+    dt = DTYPE_NP.get(array_type, np.int32)
+    if np.dtype(dt).kind not in "iu":
+        dt = np.int32
+    return data.astype(dt)
+
+
+def _empty_result(exprs: BandExpressions, H: int, W: int) -> TileResult:
+    data = {n: np.zeros((H, W), np.float32) for n in exprs.expr_names}
+    valid = {n: np.zeros((H, W), bool) for n in exprs.expr_names}
+    return TileResult(data, valid, list(exprs.expr_names), 0, 0)

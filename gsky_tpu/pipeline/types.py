@@ -1,0 +1,146 @@
+"""Request/granule types for the pipelines.
+
+Mirrors the reference's `processor/tile_types.go` (ConfigPayLoad,
+GeoTileRequest, GeoTileGranule) and `drill_types.go` — flattened into the
+fields the TPU pipeline actually needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..geo.crs import CRS, EPSG3857
+from ..geo.transform import BBox, GeoTransform
+from ..ops.expr import BandExpressions, parse_band_expressions
+
+
+@dataclass
+class MaskSpec:
+    """A quality/cloud mask band (`utils.Mask`, `utils/config.go:70-80`)."""
+
+    id: str                               # namespace of the mask band
+    value: str = ""                       # binary mask string
+    bit_tests: List[str] = field(default_factory=list)
+    data_source: str = ""                 # other collection, if any
+    inclusive: bool = False               # mask selects KEPT pixels instead
+
+
+@dataclass
+class AxisSelector:
+    """Selection on a non-spatial axis (WCS subset / WMS dim_*):
+    either a value range or explicit indices (`utils/wcs.go:228-510`
+    AxisParam + AxisIdxSelector)."""
+
+    name: str
+    start: Optional[float] = None
+    end: Optional[float] = None
+    in_values: Optional[List[float]] = None
+    idx_start: Optional[int] = None
+    idx_end: Optional[int] = None
+    idx_step: int = 1
+    order: int = 0        # output ordering
+    aggregate: int = 1    # 1 = aggregate over axis (mosaic), 0 = expand
+
+
+@dataclass
+class GeoTileRequest:
+    """One tile render request (GetMap tile / WCS sub-tile)."""
+
+    collection: str                       # MAS gpath
+    bands: Sequence[str]                  # rgb_products entries
+    bbox: BBox
+    crs: CRS = EPSG3857
+    width: int = 256
+    height: int = 256
+    start_time: Optional[float] = None    # unix seconds
+    end_time: Optional[float] = None
+    axes: List[AxisSelector] = field(default_factory=list)
+    mask: Optional[MaskSpec] = None
+    resample: str = "near"                # near | bilinear | cubic
+    nodata_out: float = float("nan")
+    overview_level: int = -1              # -1 = auto
+    query_limit: int = 0
+    polygon_segments: int = 2
+    metrics: Optional[object] = None
+
+    _exprs: Optional[BandExpressions] = None
+
+    @property
+    def band_exprs(self) -> BandExpressions:
+        if self._exprs is None:
+            object.__setattr__(self, "_exprs",
+                               parse_band_expressions(list(self.bands)))
+        return self._exprs
+
+    def dst_gt(self) -> GeoTransform:
+        return GeoTransform.from_bbox(self.bbox, self.width, self.height)
+
+
+@dataclass
+class Granule:
+    """One unit of warp work: (file, variable/band, axis combination) —
+    `GeoTileGranule` (`tile_types.go:60-90`) without the channel plumbing."""
+
+    path: str
+    ds_name: str
+    namespace: str                        # output namespace (+axis suffix)
+    base_namespace: str                   # the MAS namespace it came from
+    band: int                             # 1-based band / time index + 1
+    time_index: Optional[int]             # NetCDF time index
+    timestamp: float
+    srs: str
+    geo_transform: List[float]
+    nodata: float
+    array_type: str = "Float32"
+    is_netcdf: bool = False
+    var_name: str = ""
+
+
+@dataclass
+class TileResult:
+    """Per-namespace float32 canvases + validity masks."""
+
+    data: Dict[str, np.ndarray]           # namespace -> (H, W) float32
+    valid: Dict[str, np.ndarray]          # namespace -> (H, W) bool
+    namespaces: List[str]                 # output order
+    granule_count: int = 0
+    file_count: int = 0
+
+
+@dataclass
+class GeoDrillRequest:
+    """WPS polygon drill request (`drill_types.go`)."""
+
+    collection: str
+    bands: Sequence[str]
+    geometry_wkt: str                     # in EPSG:4326 (GeoJSON input)
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    clip_lower: float = -3.0e38
+    clip_upper: float = 3.0e38
+    deciles: int = 0
+    pixel_count: bool = False
+    band_strides: int = 1
+    approx: bool = True                   # use crawler stats fast path
+
+    _exprs: Optional[BandExpressions] = None
+
+    @property
+    def band_exprs(self) -> BandExpressions:
+        if self._exprs is None:
+            object.__setattr__(self, "_exprs",
+                               parse_band_expressions(list(self.bands)))
+        return self._exprs
+
+
+@dataclass
+class DrillResult:
+    """Per-date aggregated statistics: rows indexed by timestamp."""
+
+    dates: List[float]                                  # unix, sorted
+    values: Dict[str, List[float]]                      # namespace -> series
+    counts: Dict[str, List[int]]
+    raw_namespaces: List[str] = field(default_factory=list)

@@ -1,0 +1,322 @@
+"""Pipeline tests: tile rendering end-to-end over the fixture archive,
+granule expansion, drill statistics, extent suggestion, feature info."""
+
+import datetime as dt
+import math
+
+import numpy as np
+import pytest
+
+from gsky_tpu.geo.crs import EPSG3857, EPSG4326, parse_crs
+from gsky_tpu.geo.transform import BBox, GeoTransform, transform_bbox
+from gsky_tpu.index import MASClient
+from gsky_tpu.index.client import Dataset, DatasetAxis
+from gsky_tpu.io.geotiff import GeoTIFF
+from gsky_tpu.pipeline import (DrillPipeline, GeoDrillRequest, GeoTileRequest,
+                               TilePipeline, compute_reprojection_extent)
+from gsky_tpu.pipeline.drill import drill_csv
+from gsky_tpu.pipeline.feature_info import get_feature_info
+from gsky_tpu.pipeline.granule import expand_granules
+from gsky_tpu.pipeline.types import AxisSelector, MaskSpec
+
+from fixtures import make_archive
+
+
+@pytest.fixture(scope="module")
+def archive(tmp_path_factory):
+    return make_archive(str(tmp_path_factory.mktemp("parch")))
+
+
+@pytest.fixture(scope="module")
+def mas(archive):
+    return MASClient(archive["store"])
+
+
+def t(day: int) -> float:
+    return dt.datetime(2020, 1, day, tzinfo=dt.timezone.utc).timestamp()
+
+
+# over the fixture granules: UTM55 E 590000-613040, N 6085800-6105000
+# ~ lon 147.99-148.24, lat -35.19..-35.37
+TILE_BBOX = transform_bbox(BBox(148.02, -35.32, 148.12, -35.22),
+                           EPSG4326, EPSG3857)
+
+
+class TestGranuleExpansion:
+    def _ds(self, stamps, axes=None):
+        return Dataset(
+            file_path="/x.nc", ds_name='NETCDF:"/x.nc":v', namespace="v",
+            array_type="Float32", srs="EPSG:4326",
+            geo_transform=[0, 1, 0, 0, 0, -1],
+            timestamps=[float(s) for s in stamps],
+            timestamps_iso=[str(s) for s in stamps],
+            polygon="POLYGON((0 0,1 0,1 1,0 1,0 0))", nodata=-1.0,
+            axes=axes or [])
+
+    def test_time_range(self):
+        ds = self._ds([100, 200, 300])
+        gs = expand_granules([ds], 150.0, 350.0)
+        assert [g.timestamp for g in gs] == [200.0, 300.0]
+        assert [g.band for g in gs] == [2, 3]  # time index + 1
+        assert all(g.time_index == g.band - 1 for g in gs)
+
+    def test_exact_time(self):
+        ds = self._ds([100, 200])
+        gs = expand_granules([ds], 200.0, None)
+        assert [g.timestamp for g in gs] == [200.0]
+
+    def test_extra_axis_expansion(self):
+        ax = DatasetAxis(name="depth", params=[5.0, 10.0, 20.0],
+                         strides=[2], shape=[3], grid="default")
+        ds = self._ds([100], axes=[ax])
+        sel = AxisSelector(name="depth", start=5.0, end=15.0)
+        gs = expand_granules([ds], 100.0, None, [sel])
+        assert {g.namespace for g in gs} == {"v#depth=5", "v#depth=10"}
+        assert sorted(g.band for g in gs) == [1, 3]  # strides applied
+
+    def test_unselected_axis_takes_first(self):
+        ax = DatasetAxis(name="depth", params=[5.0, 10.0], strides=[1],
+                         shape=[2])
+        ds = self._ds([100], axes=[ax])
+        gs = expand_granules([ds], 100.0, None)
+        assert len(gs) == 1
+        assert gs[0].namespace == "v#depth=5"
+
+    def test_dedup(self):
+        ds = self._ds([100])
+        gs = expand_granules([ds, ds], 100.0, None)
+        assert len(gs) == 1
+
+
+class TestTilePipeline:
+    def test_landsat_tile_renders(self, mas, archive):
+        # a 3857 tile over both UTM granules on the shared date window
+        req = GeoTileRequest(
+            collection=archive["root"], bands=["LC08_20200110_T1"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=256, height=256,
+            start_time=t(9), end_time=t(13))
+        pipe = TilePipeline(mas)
+        res = pipe.process(req)
+        assert res.namespaces == ["LC08_20200110_T1"]
+        d = res.data["LC08_20200110_T1"]
+        ok = res.valid["LC08_20200110_T1"]
+        assert d.shape == (256, 256)
+        assert ok.sum() > 1000  # tile covered by the granule
+        assert 200 <= d[ok].mean() <= 3000
+
+    def test_warp_matches_direct_read(self, mas, archive):
+        """Pixel-parity spot check: nearest-warped value == the source
+        pixel the reference's truncation picks."""
+        path = archive["paths"][0]
+        with GeoTIFF(path) as g:
+            src = g.read(1)
+            src_gt, src_crs = g.gt, g.crs
+        req = GeoTileRequest(
+            collection=archive["root"], bands=["LC08_20200110_T1"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=64, height=64,
+            start_time=t(10), end_time=t(10))
+        pipe = TilePipeline(mas)
+        res = pipe.process(req)
+        d = res.data["LC08_20200110_T1"]
+        ok = res.valid["LC08_20200110_T1"]
+        from gsky_tpu.ops.warp import coord_grid
+        rows, cols = coord_grid(req.dst_gt(), EPSG3857, 64, 64, src_gt,
+                                src_crs)
+        for y, x in [(10, 10), (32, 40), (60, 5)]:
+            if not ok[y, x]:
+                continue
+            ri = int(math.floor(rows[y, x] + 0.5 + 1e-10))
+            ci = int(math.floor(cols[y, x] + 0.5 + 1e-10))
+            if 0 <= ri < src.shape[0] and 0 <= ci < src.shape[1]:
+                assert d[y, x] == float(src[ri, ci])
+
+    def test_temporal_mosaic_prefers_newest(self, mas, archive):
+        # both scenes overlap; in the overlap the 01-11 scene must win
+        req = GeoTileRequest(
+            collection=archive["root"],
+            bands=["LC08_20200110_T1", "LC08_20200111_T1"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=128, height=128,
+            start_time=t(9), end_time=t(13))
+        pipe = TilePipeline(mas)
+        res = pipe.process(req)
+        assert set(res.namespaces) == {"LC08_20200110_T1",
+                                       "LC08_20200111_T1"}
+
+    def test_ndvi_style_expression(self, mas, archive):
+        req = GeoTileRequest(
+            collection=archive["root"],
+            bands=["ratio = phot_veg / (phot_veg + bare_soil)"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=64, height=64,
+            start_time=t(10), end_time=t(10))
+        res = TilePipeline(mas).process(req)
+        d = res.data["ratio"]
+        ok = res.valid["ratio"]
+        assert ok.any()
+        # fc fixtures: bare_soil = phot_veg * 0.5 -> ratio = 1/1.5
+        np.testing.assert_allclose(d[ok], 2.0 / 3.0, atol=1e-5)
+
+    def test_empty_when_no_time_match(self, mas, archive):
+        req = GeoTileRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=32, height=32,
+            start_time=t(25), end_time=t(26))
+        res = TilePipeline(mas).process(req)
+        assert not res.valid["phot_veg"].any()
+
+    def test_empty_when_disjoint(self, mas, archive):
+        far = transform_bbox(BBox(10, 10, 11, 11), EPSG4326, EPSG3857)
+        req = GeoTileRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            bbox=far, crs=EPSG3857, width=32, height=32,
+            start_time=t(10), end_time=t(10))
+        res = TilePipeline(mas).process(req)
+        assert not res.valid["phot_veg"].any()
+
+    def test_bilinear_smooths(self, mas, archive):
+        req = GeoTileRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=64, height=64,
+            start_time=t(10), end_time=t(10), resample="bilinear")
+        res = TilePipeline(mas).process(req)
+        assert res.valid["phot_veg"].any()
+
+
+class TestDrill:
+    WKT = "POLYGON((148.0 -35.8,148.4 -35.8,148.4 -35.4,148.0 -35.4,148.0 -35.8))"
+
+    def test_exact_drill_netcdf(self, mas, archive):
+        req = GeoDrillRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            geometry_wkt=self.WKT, start_time=t(9), end_time=t(13),
+            approx=False)
+        res = DrillPipeline(mas).process(req)
+        assert len(res.dates) == 3
+        vs = res.values["phot_veg"]
+        assert all(0 <= v <= 100 for v in vs)
+        assert all(c > 0 for c in res.counts["phot_veg"])
+
+    def test_approx_uses_crawler_stats(self, mas, archive):
+        req = GeoDrillRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            geometry_wkt=self.WKT, start_time=t(9), end_time=t(13),
+            approx=True)
+        res = DrillPipeline(mas).process(req)
+        assert len(res.dates) == 3
+        # approx means are whole-file means (45-55 for uniform 0..100)
+        assert all(30 <= v <= 70 for v in res.values["phot_veg"])
+
+    def test_deciles(self, mas, archive):
+        req = GeoDrillRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            geometry_wkt=self.WKT, start_time=t(10), end_time=t(10),
+            approx=False, deciles=3)
+        res = DrillPipeline(mas).process(req)
+        for d in range(1, 4):
+            ns = f"phot_veg_d{d}"
+            assert ns in res.values
+        # quartile ordering
+        assert res.values["phot_veg_d1"][0] <= res.values["phot_veg_d2"][0] \
+            <= res.values["phot_veg_d3"][0]
+
+    def test_drill_expression(self, mas, archive):
+        req = GeoDrillRequest(
+            collection=archive["root"],
+            bands=["total = phot_veg + bare_soil"],
+            geometry_wkt=self.WKT, start_time=t(9), end_time=t(13),
+            approx=False)
+        res = DrillPipeline(mas).process(req)
+        assert "total" in res.values
+        v = res.values["total"][0]
+        assert not math.isnan(v)
+
+    def test_csv(self, mas, archive):
+        req = GeoDrillRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            geometry_wkt=self.WKT, start_time=t(9), end_time=t(13),
+            approx=True)
+        res = DrillPipeline(mas).process(req)
+        csv = drill_csv(res, ["phot_veg"])
+        lines = csv.split("\n")
+        assert len(lines) == 3
+        assert lines[0].startswith("2020-01-10,")
+
+    def test_point_drill(self, mas, archive):
+        req = GeoDrillRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            geometry_wkt="POINT(148.2 -35.6)", start_time=t(10),
+            end_time=t(10), approx=False)
+        res = DrillPipeline(mas).process(req)
+        assert res.dates
+        assert res.counts["phot_veg"][0] == 1
+
+
+class TestExtent:
+    def test_suggests_native_resolution(self, mas, archive):
+        req = GeoTileRequest(
+            collection=archive["root"], bands=["LC08_20200110_T1"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=0, height=0,
+            start_time=t(9), end_time=t(13))
+        w, h = compute_reprojection_extent(mas, req)
+        # 30m pixels over a ~28km tile -> several hundred pixels
+        assert 300 <= w <= 2000
+        assert 300 <= h <= 2000
+
+
+class TestFeatureInfo:
+    def test_click_value(self, mas, archive):
+        req = GeoTileRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=64, height=64,
+            start_time=t(10), end_time=t(10))
+        fi = get_feature_info(TilePipeline(mas), req, 32, 32)
+        assert fi.values["phot_veg"] is not None
+        assert 0 <= fi.values["phot_veg"] <= 100
+        assert any(p.endswith(".nc") for p in fi.files)
+        assert "2020-01-10T00:00:00.000Z" in fi.dates
+
+    def test_out_of_range(self, mas, archive):
+        req = GeoTileRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=64, height=64)
+        with pytest.raises(ValueError):
+            get_feature_info(TilePipeline(mas), req, 100, 5)
+
+
+class TestReviewRegressions:
+    def test_drill_fast_path_untimed_dataset(self, mas, archive):
+        """Untimed dataset with crawler stats must not crash the approx
+        fast path."""
+        from gsky_tpu.index.client import Dataset
+        from gsky_tpu.pipeline.drill import DrillPipeline
+
+        class FakeMAS:
+            def intersects(self, gpath, **kw):
+                return [Dataset(
+                    file_path="/undated.tif", ds_name="/undated.tif",
+                    namespace="v", array_type="Int16", srs="EPSG:4326",
+                    geo_transform=[0, 1, 0, 0, 0, -1], timestamps=[],
+                    timestamps_iso=[],
+                    polygon="POLYGON((0 0,1 0,1 1,0 1,0 0))", nodata=-1.0,
+                    axes=[], means=[42.0], sample_counts=[10])]
+
+        req = GeoDrillRequest(collection="/", bands=["v"],
+                              geometry_wkt="POLYGON((0 0,1 0,1 1,0 1,0 0))",
+                              approx=True)
+        res = DrillPipeline(FakeMAS()).process(req)
+        assert res.values["v"] == [42.0]
+
+    def test_concurrent_store_reads(self, archive):
+        """:memory: store serialises concurrent access."""
+        import threading
+        errs = []
+
+        def q():
+            try:
+                for _ in range(20):
+                    archive["store"].timestamps("/")
+            except Exception as e:
+                errs.append(e)
+        ts = [threading.Thread(target=q) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
