@@ -1,0 +1,3 @@
+from .config import Config, Layer, ServiceConfig, load_config_tree
+
+__all__ = ["Config", "Layer", "ServiceConfig", "load_config_tree"]

@@ -1,0 +1,79 @@
+"""gsky-ows CLI entry point (flag parity with `ows.go:49-57,73-158`)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from aiohttp import web
+
+from ..index import MASClient, MASStore
+from ..index.api import ingest_file
+from .config import ConfigWatcher
+from .metrics import MetricsLogger
+from .ows import OWSServer
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="gsky-ows",
+                                 description="GSKY-TPU OGC web server")
+    ap.add_argument("-port", type=int, default=8080)
+    ap.add_argument("-host", default="0.0.0.0")
+    ap.add_argument("-conf", "-c", dest="conf", default=".",
+                    help="config.json tree root")
+    ap.add_argument("-static", default="",
+                    help="static files directory (Terria client)")
+    ap.add_argument("-log_dir", default="",
+                    help="metrics log directory (default stdout)")
+    ap.add_argument("-temp_dir", default="")
+    ap.add_argument("-verbose", "-v", action="store_true")
+    ap.add_argument("-check_conf", action="store_true",
+                    help="validate configuration and exit")
+    ap.add_argument("-dump_conf", action="store_true",
+                    help="print resolved configuration and exit")
+    ap.add_argument("-local_mas", default="",
+                    help="run an in-process MAS over this crawl TSV/JSON "
+                         "file (single-binary demo mode)")
+    args = ap.parse_args(argv)
+
+    local_store = None
+    if args.local_mas:
+        local_store = MASStore()
+        n = ingest_file(local_store, args.local_mas)
+        print(f"in-process MAS: ingested {n} datasets from {args.local_mas}")
+
+    def mas_factory(addr: str):
+        if local_store is not None:
+            return MASClient(local_store)
+        return MASClient(addr)
+
+    try:
+        watcher = ConfigWatcher(args.conf, mas_factory)
+    except (ValueError, OSError) as e:
+        print(f"configuration error: {e}", file=sys.stderr)
+        return 1
+    if args.check_conf:
+        n = sum(len(c.layers) for c in watcher.configs.values())
+        print(f"OK: {len(watcher.configs)} namespace(s), {n} layer(s)")
+        return 0
+    if args.dump_conf:
+        import dataclasses
+        import json
+        for ns, cfg in watcher.configs.items():
+            print(f"== namespace {ns or '(root)'}")
+            print(json.dumps(dataclasses.asdict(cfg), indent=2,
+                             default=str)[:100000])
+        return 0
+
+    metrics = MetricsLogger(args.log_dir, verbose=args.verbose)
+    server = OWSServer(watcher, mas_factory, metrics,
+                       static_dir=args.static, temp_dir=args.temp_dir)
+    web.run_app(server.app(), host=args.host, port=args.port,
+                print=lambda *a: print(
+                    f"gsky-ows listening on {args.host}:{args.port}"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""Structured per-request metrics logging.
+
+JSON schema parity with `metrics/metrics.go:22-57` + `metrics/log_format.md`:
+``{req_time, req_duration, url{raw_url,host,path,query}, remote_addr,
+remote_host, remote_port, http_status, indexer{duration,url,geometry,
+geometry_area,num_files,num_granules}, rpc{duration,num_tiled_granules,
+bytes_read,user_time,sys_time}}``.  Durations are nanoseconds.  Query
+params outside the reference's allowlist are dropped
+(`metrics/metrics.go:64`).  Sink: stdout or size-rotated gzip files
+(`metrics/logger.go`).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import gzip
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+RESERVED_QUERY_PARAMS = {
+    "bbox", "coverage", "crs", "dptol", "height", "identifier",
+    "identitytol", "layer", "layers", "limit", "namespace", "nseg",
+    "request", "service", "srs", "styles", "time", "until", "version",
+    "width", "wkt",
+}
+
+
+class MetricsCollector:
+    def __init__(self, logger: "MetricsLogger"):
+        self._logger = logger
+        self._t0 = time.time()
+        self.info: Dict = {
+            "req_time": dt.datetime.now(dt.timezone.utc)
+            .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z",
+            "req_duration": 0,
+            "url": {"raw_url": "", "host": "", "path": "", "query": {}},
+            "remote_addr": "",
+            "remote_host": "",
+            "remote_port": "",
+            "http_status": 200,
+            "indexer": {"duration": 0,
+                        "url": {"raw_url": "", "host": "", "path": "",
+                                "query": {}},
+                        "geometry": "", "geometry_area": 0.0,
+                        "num_files": 0, "num_granules": 0},
+            "rpc": {"duration": 0, "num_tiled_granules": 0,
+                    "bytes_read": 0, "user_time": 0, "sys_time": 0},
+        }
+
+    def set_url(self, raw_url: str, path: str, query: Dict[str, str]):
+        self.info["url"] = {
+            "raw_url": raw_url, "host": "", "path": path,
+            "query": {k: v for k, v in query.items()
+                      if k in RESERVED_QUERY_PARAMS},
+        }
+
+    def set_remote(self, addr: str):
+        self.info["remote_addr"] = addr
+        host, _, port = addr.rpartition(":")
+        self.info["remote_host"] = host or addr
+        self.info["remote_port"] = port
+
+    def log(self, status: int = 200):
+        self.info["http_status"] = status
+        self.info["req_duration"] = int((time.time() - self._t0) * 1e9)
+        self._logger.write(self.info)
+
+
+class MetricsLogger:
+    """stdout or rotated gzip file sink (`metrics/logger.go:35-223`),
+    tunables via env GSKY_MAX_LOG_FILE_SIZE / GSKY_MAX_LOG_FILES."""
+
+    def __init__(self, log_dir: str = "", verbose: bool = False):
+        self.log_dir = log_dir
+        self.verbose = verbose
+        self._lock = threading.Lock()
+        self._fp = None
+        self._size = 0
+        self.max_size = int(os.environ.get("GSKY_MAX_LOG_FILE_SIZE",
+                                           50 * 1024 * 1024))
+        self.max_files = int(os.environ.get("GSKY_MAX_LOG_FILES", 10))
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+
+    def collector(self) -> MetricsCollector:
+        return MetricsCollector(self)
+
+    def write(self, info: Dict):
+        line = json.dumps(info, separators=(",", ":"))
+        with self._lock:
+            if not self.log_dir:
+                if self.verbose:
+                    sys.stdout.write(line + "\n")
+                return
+            if self._fp is None or self._size > self.max_size:
+                self._rotate()
+            self._fp.write((line + "\n").encode())
+            self._size += len(line) + 1
+
+    def _rotate(self):
+        if self._fp is not None:
+            self._fp.close()
+            self._gzip_old()
+        stamp = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%dT%H%M%S")
+        self._path = os.path.join(self.log_dir, f"gsky_metrics_{stamp}.log")
+        self._fp = open(self._path, "ab")
+        self._size = 0
+
+    def _gzip_old(self):
+        try:
+            with open(self._path, "rb") as src, \
+                    gzip.open(self._path + ".gz", "wb") as dst:
+                dst.write(src.read())
+            os.remove(self._path)
+        except OSError:
+            pass
+        logs = sorted(f for f in os.listdir(self.log_dir)
+                      if f.endswith(".log.gz"))
+        while len(logs) > self.max_files:
+            try:
+                os.remove(os.path.join(self.log_dir, logs.pop(0)))
+            except OSError:
+                break

@@ -1,0 +1,579 @@
+"""gsky-ows: the OGC front-end server (WMS / WCS / WPS / DAP4).
+
+Route and dispatch parity with `ows.go`: ``/`` serves the static demo
+client, ``/ows`` and ``/ows/<namespace>`` take OGC KVP requests
+dispatched on ``service=`` (or inferred from ``request=``,
+`ows.go:1500-1524`), errors come back as OGC ServiceException XML, and
+every request logs a metrics JSON record.
+
+Compute runs in the tile/drill pipelines (TPU); handlers below do
+request validation, config resolution, scaling/encoding and response
+framing — the same division of labour as `ows.go`'s serveWMS/serveWCS/
+serveWPS.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as dt
+import io
+import json
+import math
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+from aiohttp import web
+
+import jax.numpy as jnp
+
+from ..geo.crs import EPSG3857, EPSG4326, parse_crs
+from ..geo.transform import (BBox, GeoTransform, pixel_resolution, split_bbox,
+                             transform_bbox)
+from ..geo import geometry as geom
+from ..index.client import MASClient
+from ..index.store import fmt_time, parse_time
+from ..io.geotiff import write_geotiff
+from ..io.netcdf import write_netcdf3
+from ..io.png import empty_tile_png, encode_jpeg, encode_png
+from ..ops.palette import gradient_palette, with_nodata_entry
+from ..ops.raster import DTYPE_NP
+from ..ops.scale import scale_params_auto, scale_to_byte
+from ..pipeline import (DrillPipeline, GeoDrillRequest, GeoTileRequest,
+                        TilePipeline)
+from ..pipeline.extent import compute_reprojection_extent
+from ..pipeline.feature_info import get_feature_info
+from ..pipeline.types import AxisSelector, MaskSpec
+from . import templates as T
+from .config import Config, ConfigWatcher, Layer
+from .metrics import MetricsLogger
+from .params import (OWSError, infer_service, normalise_query, parse_wcs,
+                     parse_wms, parse_wps)
+
+
+class OWSServer:
+    def __init__(self, watcher: ConfigWatcher, mas_factory=None,
+                 metrics: Optional[MetricsLogger] = None,
+                 static_dir: str = "", temp_dir: str = ""):
+        self.watcher = watcher
+        self.mas_factory = mas_factory or (lambda addr: MASClient(addr))
+        self.metrics = metrics or MetricsLogger()
+        self.static_dir = static_dir
+        self.temp_dir = temp_dir or tempfile.gettempdir()
+        self._pipelines: Dict[str, TilePipeline] = {}
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _mas(self, cfg: Config) -> MASClient:
+        return self.mas_factory(cfg.service_config.mas_address)
+
+    def _pipeline(self, cfg: Config) -> TilePipeline:
+        key = cfg.service_config.mas_address or cfg.service_config.namespace
+        if key not in self._pipelines:
+            self._pipelines[key] = TilePipeline(self._mas(cfg))
+        return self._pipelines[key]
+
+    def app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_route("*", "/ows", self.handle)
+        app.router.add_route("*", "/ows/{namespace:.*}", self.handle)
+        if self.static_dir and os.path.isdir(self.static_dir):
+            app.router.add_get("/", self._index)
+            app.router.add_static("/", self.static_dir, show_index=False)
+        return app
+
+    async def _index(self, request):
+        index = os.path.join(self.static_dir, "index.html")
+        if os.path.exists(index):
+            return web.FileResponse(index)
+        raise web.HTTPNotFound()
+
+    # -- dispatch (generalHandler, `ows.go:1444-1530`) ----------------------
+
+    async def handle(self, request: web.Request) -> web.Response:
+        collector = self.metrics.collector()
+        q = normalise_query(request.query)
+        ns = request.match_info.get("namespace", "")
+        collector.set_url(str(request.rel_url), request.path, q)
+        peer = request.remote or ""
+        collector.set_remote(request.headers.get(
+            "X-Forwarded-For", peer).split(",")[0].strip())
+        try:
+            cfg = self.watcher.get(ns)
+            if cfg is None:
+                raise OWSError(f"no configuration for namespace {ns!r}",
+                               status=404)
+            svc = infer_service(q)
+            if svc == "WMS":
+                resp = await self.serve_wms(request, cfg, q, collector)
+            elif svc == "WCS":
+                resp = await self.serve_wcs(request, cfg, q, collector)
+            else:
+                resp = await self.serve_wps(request, cfg, q, collector)
+            collector.log(resp.status)
+            return resp
+        except OWSError as e:
+            collector.log(e.status)
+            return _exception_response(e)
+        except asyncio.TimeoutError:
+            collector.log(504)
+            return _exception_response(OWSError("request timed out",
+                                                status=504))
+        except Exception as e:  # pragma: no cover - last resort
+            collector.log(500)
+            return _exception_response(OWSError(f"internal error: {e}",
+                                                status=500))
+
+    # -- WMS (`ows.go:160-566`) ---------------------------------------------
+
+    async def serve_wms(self, request, cfg: Config, q, collector):
+        p = parse_wms(q)
+        req_name = p.request.lower()
+        host = _host_of(request, cfg)
+        ns_path = request.path
+        if req_name == "getcapabilities" or not req_name:
+            return _xml(T.wms_capabilities(cfg, ns_path, host))
+        if req_name == "describelayer":
+            layers = [cfg.layer(n) for n in p.layers]
+            if any(l is None for l in layers):
+                raise OWSError("layer not found", "LayerNotDefined")
+            return _xml(T.wms_describe_layer(layers, ns_path, host))
+        if req_name == "getlegendgraphic":
+            return self._legend(cfg, q)
+        if req_name == "getmap":
+            return await self._getmap(cfg, p, collector)
+        if req_name == "getfeatureinfo":
+            return await self._feature_info(cfg, p)
+        raise OWSError(f"WMS request {p.request!r} not supported",
+                       "OperationNotSupported")
+
+    def _resolve_layer(self, cfg: Config, name: str, styles: List[str],
+                       service: str) -> Tuple[Layer, Layer]:
+        lay = cfg.layer(name)
+        if lay is None:
+            raise OWSError(f"layer {name!r} not found", "LayerNotDefined")
+        if lay.service_disabled(service):
+            raise OWSError(f"{service} disabled for layer {name!r}",
+                           "OperationNotSupported")
+        style = lay
+        for sname in styles:
+            if sname:
+                s = lay.style(sname)
+                if s is None:
+                    raise OWSError(f"style {sname!r} not defined",
+                                   "StyleNotDefined")
+                style = s
+                break
+        if not style.rgb_products and lay.styles:
+            style = lay.styles[0]
+        return lay, style
+
+    def _tile_request(self, cfg: Config, lay: Layer, style: Layer,
+                      p, width: int, height: int,
+                      segments: int) -> GeoTileRequest:
+        times = p.times
+        start = end = None
+        if times:
+            start = times[0]
+            end = times[-1] if len(times) > 1 else None
+        elif lay.effective_end_date:
+            start = parse_time(lay.effective_end_date)
+        if lay.accum and lay.effective_start_date and start is not None:
+            end = end or start
+            start = parse_time(lay.effective_start_date)
+        axes = []
+        for ax in lay.axes_info:
+            val = getattr(p, "axes", {}).get(ax.name, ax.default)
+            if isinstance(val, tuple):  # WCS subset=(lo, hi)
+                lo, hi = val
+                axes.append(AxisSelector(name=ax.name, start=lo,
+                                         end=hi if hi is not None else lo))
+            elif val:
+                try:
+                    v = float(val)
+                    axes.append(AxisSelector(name=ax.name, start=v, end=v))
+                except (TypeError, ValueError):
+                    pass
+        mask = None
+        if style.mask or lay.mask:
+            m = style.mask or lay.mask
+            mask = MaskSpec(id=m.id, value=m.value, bit_tests=m.bit_tests,
+                            data_source=m.data_source, inclusive=m.inclusive)
+        # the layer's own collection wins: styles inherit their parent's
+        # data_source at load time, and overview layers carry their own
+        return GeoTileRequest(
+            collection=lay.data_source or style.data_source,
+            bands=style.rgb_products or lay.rgb_products,
+            bbox=p.bbox, crs=p.crs, width=width, height=height,
+            start_time=start, end_time=end, axes=axes, mask=mask,
+            resample=style.resample or lay.resample,
+            polygon_segments=segments)
+
+    async def _getmap(self, cfg: Config, p, collector):
+        if not p.layers:
+            raise OWSError("no layers requested", "LayerNotDefined")
+        if p.bbox is None or p.crs is None:
+            raise OWSError("bbox/crs required", "MissingParameterValue")
+        lay, style = self._resolve_layer(cfg, p.layers[0], p.styles, "wms")
+        if p.width <= 0 or p.height <= 0:
+            raise OWSError("width/height required", "MissingParameterValue")
+        if p.width > lay.wms_max_width or p.height > lay.wms_max_height:
+            raise OWSError(
+                f"requested size exceeds {lay.wms_max_width}x"
+                f"{lay.wms_max_height}", "InvalidParameterValue")
+
+        # zoom limit -> overview substitution or "zoom in" tile
+        # (`ows.go:437-473`, `utils/wms.go:534-553`)
+        source = lay
+        if lay.zoom_limit > 0:
+            res = pixel_resolution(p.bbox, p.crs, p.width, p.height)
+            if res > lay.zoom_limit:
+                use = _best_overview(lay, res)
+                if use is None:
+                    png = self._placeholder_tile(lay.nodata_legend_path,
+                                                 p.width, p.height)
+                    return _png(png)
+                source = use  # render the overview collection; the style
+                # keeps supplying scaling/palette below
+
+        req = self._tile_request(cfg, source, style, p, p.width, p.height,
+                                 lay.wms_polygon_segments)
+        pipe = self._pipeline(cfg)
+        t0 = time.time()
+        res = await asyncio.wait_for(
+            asyncio.to_thread(_render_with_fusion, pipe, req, lay, cfg,
+                              self),
+            timeout=lay.wms_timeout)
+        collector.info["rpc"]["duration"] = int((time.time() - t0) * 1e9)
+        collector.info["indexer"]["num_granules"] = res.granule_count
+        collector.info["indexer"]["num_files"] = res.file_count
+
+        bands = [res.data[n] for n in res.namespaces if n in res.data]
+        valids = [res.valid[n] for n in res.namespaces if n in res.valid]
+        if not bands:
+            return _png(empty_tile_png(p.width, p.height))
+        scaled = []
+        auto = scale_params_auto(style.offset_value, style.scale_value,
+                                 style.clip_value)
+        for b, v in zip(bands[:4], valids[:4]):
+            sb = scale_to_byte(jnp.asarray(b), jnp.asarray(v),
+                               offset=style.offset_value,
+                               scale=style.scale_value,
+                               clip=style.clip_value,
+                               colour_scale=style.colour_scale,
+                               auto=auto)
+            scaled.append(np.asarray(sb))
+        if p.format.lower() in ("image/jpeg", "image/jpg"):
+            return web.Response(body=encode_jpeg(scaled[:3]),
+                                content_type="image/jpeg")
+        palette = None
+        if len(scaled) == 1 and (style.palette or lay.palette):
+            spec = style.palette or lay.palette
+            palette = with_nodata_entry(
+                gradient_palette(spec.colours, spec.interpolate))
+        return _png(encode_png(scaled, palette))
+
+    async def _feature_info(self, cfg: Config, p):
+        if not p.layers:
+            raise OWSError("no layers requested", "LayerNotDefined")
+        lay, style = self._resolve_layer(cfg, p.layers[0], p.styles, "wms")
+        if p.bbox is None or p.x is None or p.y is None:
+            raise OWSError("bbox/i/j required", "MissingParameterValue")
+        req = self._tile_request(cfg, lay, style, p, p.width or 256,
+                                 p.height or 256, lay.wms_polygon_segments)
+        req = _with_bands(req, lay.feature_info_bands or req.bands)
+        pipe = self._pipeline(cfg)
+        fi = await asyncio.wait_for(
+            asyncio.to_thread(get_feature_info, pipe, req, p.x, p.y),
+            timeout=lay.wms_timeout)
+        props = {k: (v if v is not None else "n/a")
+                 for k, v in fi.values.items()}
+        if lay.feature_info_max_dates != 0:
+            props["available_dates"] = fi.dates[-abs(
+                lay.feature_info_max_dates):]
+        doc = {"type": "FeatureCollection", "features": [{
+            "type": "Feature", "properties": props,
+            "geometry": None}]}
+        return web.json_response(doc)
+
+    def _legend(self, cfg: Config, q):
+        name = q.get("layer") or q.get("layers", "")
+        lay = cfg.layer(name)
+        if lay is None:
+            raise OWSError(f"layer {name!r} not found", "LayerNotDefined")
+        style = lay.style(q.get("style", "") or q.get("styles", "")) or lay
+        path = style.legend_path or lay.legend_path
+        if path and os.path.exists(path):
+            with open(path, "rb") as fp:
+                return _png(fp.read())
+        spec = style.palette or lay.palette
+        if spec is None:
+            raise OWSError("no legend available", status=404)
+        lut = gradient_palette(spec.colours, spec.interpolate)
+        h, w = style.legend_height, style.legend_width
+        img = np.zeros((h, w, 4), np.uint8)
+        ramp = np.linspace(254, 0, h).astype(np.uint8)
+        img[:] = lut[ramp][:, None, :]
+        from ..io.png import encode_rgba_png
+        return _png(encode_rgba_png(img))
+
+    def _placeholder_tile(self, image_path: str, width: int,
+                          height: int) -> bytes:
+        img_bytes = None
+        if image_path and os.path.exists(image_path):
+            with open(image_path, "rb") as fp:
+                img_bytes = fp.read()
+        return empty_tile_png(width, height, img_bytes)
+
+    # -- WCS (`ows.go:568-1221`) --------------------------------------------
+
+    async def serve_wcs(self, request, cfg: Config, q, collector):
+        p = parse_wcs(q)
+        req_name = p.request.lower()
+        host = _host_of(request, cfg)
+        if req_name == "getcapabilities" or not req_name:
+            return _xml(T.wcs_capabilities(cfg, request.path, host))
+        if req_name == "describecoverage":
+            layers = [cfg.layer(n) for n in p.coverages] if p.coverages \
+                else [l for l in cfg.layers if not l.service_disabled("wcs")]
+            if any(l is None for l in layers):
+                raise OWSError("coverage not found", "CoverageNotDefined")
+            return _xml(T.wcs_describe_coverage(layers, host))
+        if req_name == "getcoverage":
+            return await self._getcoverage(cfg, p, collector)
+        raise OWSError(f"WCS request {p.request!r} not supported",
+                       "OperationNotSupported")
+
+    async def _getcoverage(self, cfg: Config, p, collector):
+        if not p.coverages:
+            raise OWSError("no coverage requested", "CoverageNotDefined")
+        lay, style = self._resolve_layer(cfg, p.coverages[0], p.styles,
+                                         "wcs")
+        if p.bbox is None or p.crs is None:
+            raise OWSError("bbox/crs required", "MissingParameterValue")
+        width, height = p.width, p.height
+        pipe = self._pipeline(cfg)
+        base_req = self._tile_request(cfg, lay, style, p, 256, 256,
+                                      lay.wcs_polygon_segments)
+        if width <= 0 or height <= 0:
+            # auto size from source resolution (`ows.go:773-806`)
+            width, height = await asyncio.to_thread(
+                compute_reprojection_extent, pipe.mas, base_req)
+            if width <= 0 or height <= 0:
+                raise OWSError("no data for requested extent",
+                               "CoverageNotDefined")
+        if width > lay.wcs_max_width or height > lay.wcs_max_height:
+            raise OWSError(
+                f"requested size {width}x{height} exceeds "
+                f"{lay.wcs_max_width}x{lay.wcs_max_height}",
+                "InvalidParameterValue")
+
+        fmt = p.format.lower()
+        if fmt not in ("geotiff", "gtiff", "tiff", "netcdf", "nc",
+                       "application/x-netcdf", "image/tiff", "dap4"):
+            raise OWSError(f"format {p.format!r} not supported",
+                           "InvalidFormat")
+
+        # tiled render (`ows.go:815-833,1010-1092`)
+        tiles = split_bbox(p.bbox, width, height, lay.wcs_max_tile_width,
+                           lay.wcs_max_tile_height)
+        exprs = base_req.band_exprs
+        ns_names = list(exprs.expr_names)
+        out = {n: np.zeros((height, width), np.float32) for n in ns_names}
+        valid = {n: np.zeros((height, width), bool) for n in ns_names}
+
+        async def render_tile(tb, ox, oy, tw, th):
+            req = GeoTileRequest(
+                collection=base_req.collection, bands=base_req.bands,
+                bbox=tb, crs=p.crs, width=tw, height=th,
+                start_time=base_req.start_time, end_time=base_req.end_time,
+                axes=base_req.axes, mask=base_req.mask,
+                resample=base_req.resample,
+                polygon_segments=lay.wcs_polygon_segments)
+            res = await asyncio.to_thread(_render_with_fusion, pipe, req,
+                                          lay, cfg, self)
+            for n in ns_names:
+                if n in res.data:
+                    out[n][oy:oy + th, ox:ox + tw] = res.data[n]
+                    valid[n][oy:oy + th, ox:ox + tw] = res.valid[n]
+
+        await asyncio.wait_for(
+            asyncio.gather(*(render_tile(*t) for t in tiles)),
+            timeout=lay.wcs_timeout * max(1, len(tiles)))
+
+        nodata = -9999.0
+        arrays = {}
+        for n in ns_names:
+            a = out[n].copy()
+            a[~valid[n]] = nodata
+            arrays[n] = a
+        gt = GeoTransform.from_bbox(p.bbox, width, height)
+        stamp = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%d%H%M%S")
+        if fmt in ("netcdf", "nc", "application/x-netcdf"):
+            path = os.path.join(self.temp_dir, f"wcs_{stamp}_{id(p)}.nc")
+            xs = gt.x0 + (np.arange(width) + 0.5) * gt.dx
+            ys = gt.y0 + (np.arange(height) + 0.5) * gt.dy
+            await asyncio.to_thread(write_netcdf3, path, arrays, xs, ys,
+                                    p.crs, None, nodata)
+            fname = f"{lay.name}_{stamp}.nc"
+            ctype = "application/x-netcdf"
+        else:
+            path = os.path.join(self.temp_dir, f"wcs_{stamp}_{id(p)}.tif")
+            stack = np.stack([arrays[n] for n in ns_names])
+            await asyncio.to_thread(write_geotiff, path, stack, gt, p.crs,
+                                    nodata)
+            fname = f"{lay.name}_{stamp}.tif"
+            ctype = "image/geotiff"
+        size = os.path.getsize(path)
+        headers = {"Content-Disposition": f'attachment; filename="{fname}"'}
+        if size <= 256 * 1024 * 1024:
+            with open(path, "rb") as fp:
+                body = fp.read()
+            os.remove(path)
+            return web.Response(body=body, content_type=ctype,
+                                headers=headers)
+        # very large outputs stream from disk; reap the temp file later
+        asyncio.get_event_loop().call_later(
+            600, lambda: os.path.exists(path) and os.remove(path))
+        headers["Content-Type"] = ctype
+        return web.FileResponse(path, headers=headers)
+
+    # -- WPS (`ows.go:1223-1441`) -------------------------------------------
+
+    async def serve_wps(self, request, cfg: Config, q, collector):
+        body = await request.read() if request.method == "POST" else None
+        p = parse_wps(q, body if body else None)
+        req_name = (p.request or "").lower()
+        host = _host_of(request, cfg)
+        if req_name == "getcapabilities" or not req_name:
+            return _xml(T.wps_capabilities(cfg, request.path, host))
+        if req_name == "describeprocess":
+            proc = cfg.process(p.identifier)
+            if proc is None:
+                raise OWSError(f"process {p.identifier!r} not found",
+                               "InvalidParameterValue")
+            return _xml(T.wps_describe_process(proc))
+        if req_name != "execute":
+            raise OWSError(f"WPS request {p.request!r} not supported",
+                           "OperationNotSupported")
+
+        proc = cfg.process(p.identifier)
+        if proc is None:
+            raise OWSError(f"process {p.identifier!r} not found",
+                           "InvalidParameterValue")
+        if not p.geometry_json:
+            raise OWSError("geometry input required",
+                           "MissingParameterValue")
+        try:
+            g = geom.from_geojson(p.geometry_json)
+        except (ValueError, KeyError) as e:
+            raise OWSError(f"invalid GeoJSON geometry: {e}")
+        if g.kind not in ("Point", "Polygon", "MultiPolygon"):
+            raise OWSError(
+                f"geometry type {g.kind} not supported; use Point/Polygon/"
+                f"MultiPolygon")
+        if proc.max_area > 0 and g.area() > proc.max_area:
+            raise OWSError(
+                f"geometry area exceeds process limit {proc.max_area}")
+
+        csv_blocks = []
+        for src in proc.data_sources:
+            dreq = GeoDrillRequest(
+                collection=src.data_source, bands=src.rgb_products,
+                geometry_wkt=g.to_wkt(),
+                start_time=p.start_time, end_time=p.end_time,
+                deciles=proc.deciles, approx=proc.approx,
+                band_strides=src.band_strides,
+                pixel_count="pixel_count" in proc.drill_algorithm)
+            dp = DrillPipeline(self._mas(cfg))
+            res = await asyncio.wait_for(
+                asyncio.to_thread(dp.process, dreq),
+                timeout=src.wcs_timeout or 30)
+            from ..pipeline.drill import drill_csv
+            names = list(res.values)
+            csv_blocks.append(drill_csv(res, names))
+        return _xml(T.wps_execute_response(p.identifier, csv_blocks))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _render_with_fusion(pipe: TilePipeline, req: GeoTileRequest, lay: Layer,
+                        cfg: Config, server: OWSServer):
+    """Plain layers render directly; fusion layers (`input_layers`,
+    `processor/tile_pipeline.go:196-324`) render each input layer and
+    compose first-valid in order (earlier inputs win, later fill holes)."""
+    if not lay.input_layers:
+        return pipe.process(req)
+    from ..pipeline.tile import evaluate_expressions
+    data_env: Dict[str, np.ndarray] = {}
+    valid_env: Dict[str, np.ndarray] = {}
+    total_granules = total_files = 0
+    import dataclasses
+    for dep in lay.input_layers:
+        dep_mask = None
+        if dep.mask is not None:
+            dep_mask = MaskSpec(id=dep.mask.id, value=dep.mask.value,
+                                bit_tests=dep.mask.bit_tests,
+                                data_source=dep.mask.data_source,
+                                inclusive=dep.mask.inclusive)
+        dreq = dataclasses.replace(
+            req, collection=dep.data_source, bands=list(dep.rgb_products),
+            mask=dep_mask or req.mask,
+            resample=dep.resample or req.resample, _exprs=None)
+        res = pipe.process(dreq)
+        total_granules += res.granule_count
+        total_files += res.file_count
+        for n in res.namespaces:
+            if n not in data_env:
+                data_env[n] = res.data[n]
+                valid_env[n] = res.valid[n]
+            else:  # later inputs fill holes
+                fill = ~valid_env[n] & res.valid[n]
+                data_env[n] = np.where(fill, res.data[n], data_env[n])
+                valid_env[n] = valid_env[n] | res.valid[n]
+    return evaluate_expressions(req.band_exprs, data_env, valid_env,
+                                req.height, req.width, total_granules,
+                                total_files)
+
+
+def _best_overview(lay: Layer, res: float) -> Optional[Layer]:
+    """`FindLayerBestOverview` (`utils/wms.go:534-553`): coarsest overview
+    whose zoom_limit still admits the request resolution."""
+    best = None
+    for ov in lay.overviews:
+        if ov.zoom_limit <= 0 or res <= ov.zoom_limit:
+            if best is None or ov.zoom_limit > best.zoom_limit:
+                best = ov
+    return best
+
+
+def _with_bands(req: GeoTileRequest, bands) -> GeoTileRequest:
+    import dataclasses
+    return dataclasses.replace(req, bands=list(bands), _exprs=None)
+
+
+def _host_of(request, cfg: Config) -> str:
+    if cfg.service_config.ows_hostname:
+        host = cfg.service_config.ows_hostname
+        if not host.startswith("http"):
+            host = f"http://{host}"
+        return host
+    return f"{request.scheme}://{request.host}"
+
+
+def _xml(doc: str) -> web.Response:
+    return web.Response(text=doc, content_type="text/xml")
+
+
+def _png(data: bytes) -> web.Response:
+    return web.Response(body=data, content_type="image/png")
+
+
+def _exception_response(e: OWSError) -> web.Response:
+    return web.Response(text=T.service_exception(str(e), e.code),
+                        content_type="application/vnd.ogc.se_xml",
+                        status=e.status)

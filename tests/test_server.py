@@ -1,0 +1,454 @@
+"""OWS server tests: full WMS/WCS/WPS request handling over the fixture
+archive through the aiohttp test client."""
+
+import asyncio
+import datetime as dt
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from gsky_tpu.index import MASClient
+from gsky_tpu.io.png import decode_png
+from gsky_tpu.server.config import ConfigWatcher, load_config_tree
+from gsky_tpu.server.metrics import MetricsLogger
+from gsky_tpu.server.ows import OWSServer
+
+from fixtures import make_archive
+
+DATE = "2020-01-10T00:00:00.000Z"
+# fixture granules ~ lon 147.99-148.24, lat -35.19..-35.37 (see
+# tests/test_pipeline.py); bbox in 3857
+BBOX3857 = "16478548,-4211230,16489679,-4198025"
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("srv")
+    arch = make_archive(str(root / "data"))
+    conf_dir = root / "conf"
+    conf_dir.mkdir()
+    config = {
+        "service_config": {"ows_hostname": "", "mas_address": "inproc"},
+        "layers": [
+            {
+                "name": "landsat", "title": "Landsat-ish scenes",
+                "data_source": arch["root"],
+                "rgb_products": ["LC08_20200110_T1"],
+                "time_generator": "mas",
+                "palette": {"interpolate": True, "colours": [
+                    {"R": 0, "G": 0, "B": 128, "A": 255},
+                    {"R": 255, "G": 255, "B": 0, "A": 255}]},
+            },
+            {
+                "name": "frac_cover", "title": "Fractional cover",
+                "data_source": arch["root"],
+                "rgb_products": ["phot_veg", "bare_soil",
+                                 "total = phot_veg + bare_soil"],
+                "time_generator": "mas",
+            },
+            {
+                "name": "hidden_wms", "title": "wcs only",
+                "data_source": arch["root"],
+                "rgb_products": ["phot_veg"],
+                "disable_services": ["wms"],
+                "dates": [DATE],
+            },
+        ],
+        "processes": [{
+            "identifier": "geometryDrill",
+            "title": "Geometry drill",
+            "max_area": 10000,
+            "data_sources": [{
+                "data_source": arch["root"],
+                "rgb_products": ["phot_veg"],
+            }],
+            "approx": False,
+        }],
+    }
+    (conf_dir / "config.json").write_text(json.dumps(config))
+
+    mas_client = MASClient(arch["store"])
+    watcher = ConfigWatcher(str(conf_dir),
+                            mas_factory=lambda addr: mas_client,
+                            install_signal=False)
+    server = OWSServer(watcher, mas_factory=lambda addr: mas_client,
+                       metrics=MetricsLogger())
+    return {"server": server, "arch": arch, "conf": str(conf_dir)}
+
+
+def _get(env, path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        client = TestClient(TestServer(env["server"].app()))
+        await client.start_server()
+        try:
+            resp = await client.get(path)
+            return resp.status, resp.content_type, await resp.read()
+        finally:
+            await client.close()
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+def _post(env, path, data):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        client = TestClient(TestServer(env["server"].app()))
+        await client.start_server()
+        try:
+            resp = await client.post(path, data=data)
+            return resp.status, resp.content_type, await resp.read()
+        finally:
+            await client.close()
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+class TestWMS:
+    def test_capabilities(self, env):
+        status, ctype, body = _get(env, "/ows?service=WMS&request=GetCapabilities")
+        assert status == 200
+        text = body.decode()
+        assert "<WMS_Capabilities" in text
+        assert "<Name>landsat</Name>" in text
+        assert "<Name>frac_cover</Name>" in text
+        assert "hidden_wms" not in text  # wms disabled
+        assert DATE in text  # mas time generator found the dates
+
+    def test_getmap_renders_png(self, env):
+        status, ctype, body = _get(
+            env, f"/ows?service=WMS&request=GetMap&version=1.3.0"
+                 f"&layers=landsat&crs=EPSG:3857&bbox={BBOX3857}"
+                 f"&width=256&height=256&format=image/png&time={DATE}")
+        assert status == 200, body[:300]
+        assert ctype == "image/png"
+        rgba = decode_png(body)
+        assert rgba.shape == (256, 256, 4)
+        # palette applied: valid pixels should be coloured
+        assert (rgba[..., 3] > 0).sum() > 1000
+
+    def test_getmap_no_time_uses_latest(self, env):
+        status, _, body = _get(
+            env, f"/ows?service=WMS&request=GetMap&version=1.3.0"
+                 f"&layers=frac_cover&crs=EPSG:3857&bbox={BBOX3857}"
+                 f"&width=64&height=64&format=image/png")
+        assert status == 200, body[:300]
+
+    def test_getmap_service_inferred(self, env):
+        status, ctype, _ = _get(
+            env, f"/ows?request=GetMap&version=1.3.0&layers=landsat"
+                 f"&crs=EPSG:3857&bbox={BBOX3857}&width=32&height=32"
+                 f"&format=image/png&time={DATE}")
+        assert status == 200
+        assert ctype == "image/png"
+
+    def test_getmap_missing_layer(self, env):
+        status, ctype, body = _get(
+            env, f"/ows?service=WMS&request=GetMap&layers=nope"
+                 f"&crs=EPSG:3857&bbox={BBOX3857}&width=32&height=32")
+        assert status == 400
+        assert b"LayerNotDefined" in body
+
+    def test_getmap_oversize(self, env):
+        status, _, body = _get(
+            env, f"/ows?service=WMS&request=GetMap&layers=landsat"
+                 f"&crs=EPSG:3857&bbox={BBOX3857}&width=9999&height=32"
+                 f"&format=image/png&time={DATE}")
+        assert status == 400
+        assert b"exceeds" in body
+
+    def test_getmap_wms_disabled(self, env):
+        status, _, body = _get(
+            env, f"/ows?service=WMS&request=GetMap&layers=hidden_wms"
+                 f"&crs=EPSG:3857&bbox={BBOX3857}&width=32&height=32")
+        assert status == 400
+        assert b"disabled" in body
+
+    def test_getmap_1_1_1_axis_order(self, env):
+        # 1.1.1 + EPSG:4326: lon,lat order
+        status, _, body = _get(
+            env, "/ows?service=WMS&request=GetMap&version=1.1.1"
+                 "&layers=landsat&srs=EPSG:4326"
+                 "&bbox=148.02,-35.32,148.12,-35.22"
+                 f"&width=64&height=64&format=image/png&time={DATE}")
+        assert status == 200, body[:300]
+        # 1.3.0 + EPSG:4326: lat,lon order (same request, swapped)
+        status2, _, body2 = _get(
+            env, "/ows?service=WMS&request=GetMap&version=1.3.0"
+                 "&layers=landsat&crs=EPSG:4326"
+                 "&bbox=-35.32,148.02,-35.22,148.12"
+                 f"&width=64&height=64&format=image/png&time={DATE}")
+        assert status2 == 200, body2[:300]
+        assert body == body2  # identical tiles
+
+    def test_feature_info(self, env):
+        status, ctype, body = _get(
+            env, f"/ows?service=WMS&request=GetFeatureInfo&version=1.3.0"
+                 f"&layers=frac_cover&crs=EPSG:3857&bbox={BBOX3857}"
+                 f"&width=64&height=64&i=32&j=32&time={DATE}")
+        assert status == 200, body[:300]
+        doc = json.loads(body)
+        assert doc["type"] == "FeatureCollection"
+        props = doc["features"][0]["properties"]
+        assert "phot_veg" in props
+
+    def test_legend_from_palette(self, env):
+        status, ctype, body = _get(
+            env, "/ows?service=WMS&request=GetLegendGraphic&layer=landsat")
+        assert status == 200
+        img = Image.open(io.BytesIO(body))
+        assert img.size == (160, 320)
+
+    def test_describe_layer(self, env):
+        status, _, body = _get(
+            env, "/ows?service=WMS&request=DescribeLayer&layers=landsat")
+        assert status == 200
+        assert b"LayerDescription" in body
+
+    def test_bogus_request(self, env):
+        status, _, body = _get(env, "/ows?service=WMS&request=Frobnicate")
+        assert status == 400
+        assert b"not supported" in body
+
+    def test_unknown_namespace(self, env):
+        status, _, body = _get(
+            env, "/ows/nope?service=WMS&request=GetCapabilities")
+        assert status == 404
+
+
+class TestWCS:
+    def test_capabilities(self, env):
+        status, _, body = _get(env, "/ows?service=WCS&request=GetCapabilities")
+        assert status == 200
+        assert b"WCS_Capabilities" in body
+        assert b"<name>landsat</name>" in body
+
+    def test_describe_coverage(self, env):
+        status, _, body = _get(
+            env, "/ows?service=WCS&request=DescribeCoverage"
+                 "&coverage=frac_cover")
+        assert status == 200
+        assert b"CoverageOffering" in body
+        assert DATE.encode() in body
+
+    def test_getcoverage_geotiff(self, env, tmp_path):
+        status, ctype, body = _get(
+            env, f"/ows?service=WCS&request=GetCoverage&coverage=frac_cover"
+                 f"&crs=EPSG:3857&bbox={BBOX3857}&width=128&height=96"
+                 f"&format=GeoTIFF&time={DATE}")
+        assert status == 200, body[:300]
+        p = tmp_path / "cov.tif"
+        p.write_bytes(body)
+        from gsky_tpu.io.geotiff import GeoTIFF
+        with GeoTIFF(str(p)) as g:
+            assert g.width == 128 and g.height == 96
+            assert g.count == 3  # phot_veg, bare_soil, total
+            assert g.nodata == -9999.0
+            data = g.read(1)
+            assert (data != -9999.0).any()
+
+    def test_getcoverage_netcdf(self, env, tmp_path):
+        status, ctype, body = _get(
+            env, f"/ows?service=WCS&request=GetCoverage&coverage=frac_cover"
+                 f"&crs=EPSG:3857&bbox={BBOX3857}&width=64&height=64"
+                 f"&format=NetCDF&time={DATE}")
+        assert status == 200, body[:300]
+        p = tmp_path / "cov.nc"
+        p.write_bytes(body)
+        from gsky_tpu.io.netcdf import NetCDF
+        with NetCDF(str(p)) as nc:
+            assert "phot_veg" in nc.variables
+            assert nc.variables["phot_veg"].shape == (64, 64)
+
+    def test_getcoverage_bad_format(self, env):
+        status, _, body = _get(
+            env, f"/ows?service=WCS&request=GetCoverage&coverage=frac_cover"
+                 f"&crs=EPSG:3857&bbox={BBOX3857}&width=32&height=32"
+                 f"&format=Zarr")
+        assert status == 400
+        assert b"InvalidFormat" in body
+
+
+class TestWPS:
+    GEOM = json.dumps({"type": "FeatureCollection", "features": [{
+        "type": "Feature", "geometry": {
+            "type": "Polygon",
+            "coordinates": [[[148.0, -36.0], [148.5, -36.0], [148.5, -35.0],
+                             [148.0, -35.0], [148.0, -36.0]]]}}]})
+
+    def test_capabilities(self, env):
+        status, _, body = _get(env, "/ows?service=WPS&request=GetCapabilities")
+        assert status == 200
+        assert b"geometryDrill" in body
+
+    def test_describe_process(self, env):
+        status, _, body = _get(
+            env, "/ows?service=WPS&request=DescribeProcess"
+                 "&identifier=geometryDrill")
+        assert status == 200
+        assert b"ProcessDescription" in body
+
+    def test_execute_kvp(self, env):
+        import urllib.parse
+        geom_q = urllib.parse.quote(self.GEOM)
+        status, _, body = _get(
+            env, f"/ows?service=WPS&request=Execute&identifier=geometryDrill"
+                 f"&datainputs=geometry={geom_q}")
+        assert status == 200, body[:400]
+        text = body.decode()
+        assert "ProcessSucceeded" in text
+        assert "2020-01-10" in text
+
+    def test_execute_xml_post(self, env):
+        xml = f"""<?xml version="1.0" encoding="UTF-8"?>
+<wps:Execute service="WPS" version="1.0.0"
+    xmlns:wps="http://www.opengis.net/wps/1.0.0"
+    xmlns:ows="http://www.opengis.net/ows/1.1">
+  <ows:Identifier>geometryDrill</ows:Identifier>
+  <wps:DataInputs>
+    <wps:Input>
+      <ows:Identifier>geometry</ows:Identifier>
+      <wps:Data><wps:ComplexData mimeType="application/vnd.geo+json">
+        {self.GEOM.replace('<', '&lt;')}
+      </wps:ComplexData></wps:Data>
+    </wps:Input>
+    <wps:Input>
+      <ows:Identifier>start_datetime</ows:Identifier>
+      <wps:Data><wps:LiteralData>2020-01-09T00:00:00.000Z</wps:LiteralData></wps:Data>
+    </wps:Input>
+  </wps:DataInputs>
+</wps:Execute>"""
+        status, _, body = _post(env, "/ows?service=WPS", xml.encode())
+        assert status == 200, body[:400]
+        assert b"ProcessSucceeded" in body
+
+    def test_execute_area_limit(self, env):
+        big = json.dumps({"type": "Polygon", "coordinates": [[
+            [0, -80], [170, -80], [170, 80], [0, 80], [0, -80]]]})
+        import urllib.parse
+        status, _, body = _get(
+            env, f"/ows?service=WPS&request=Execute&identifier=geometryDrill"
+                 f"&datainputs=geometry={urllib.parse.quote(big)}")
+        assert status == 400
+        assert b"area exceeds" in body
+
+    def test_execute_bad_geometry(self, env):
+        status, _, body = _get(
+            env, "/ows?service=WPS&request=Execute&identifier=geometryDrill"
+                 "&datainputs=geometry={bad json}")
+        assert status == 400
+
+
+class TestConfigSystem:
+    def test_tree_namespaces(self, tmp_path):
+        (tmp_path / "config.json").write_text(json.dumps(
+            {"layers": [{"name": "root_layer"}]}))
+        sub = tmp_path / "geoglam"
+        sub.mkdir()
+        (sub / "config.json").write_text(json.dumps(
+            {"layers": [{"name": "sub_layer"}]}))
+        cfgs = load_config_tree(str(tmp_path), load_dates=False)
+        assert set(cfgs) == {"", "geoglam"}
+        assert cfgs[""].layers[0].name == "root_layer"
+        assert cfgs["geoglam"].layers[0].name == "sub_layer"
+
+    def test_date_generators(self, tmp_path):
+        (tmp_path / "config.json").write_text(json.dumps({"layers": [
+            {"name": "reg", "start_isodate": "2020-01-01T00:00:00.000Z",
+             "end_isodate": "2020-01-05T00:00:00.000Z", "step_days": 1,
+             "time_generator": "regular"},
+            {"name": "mon", "start_isodate": "2020-01-01T00:00:00.000Z",
+             "end_isodate": "2020-06-30T00:00:00.000Z",
+             "time_generator": "monthly"},
+            {"name": "chirps", "start_isodate": "2020-01-01T00:00:00.000Z",
+             "end_isodate": "2020-02-25T00:00:00.000Z",
+             "time_generator": "chirps20"},
+        ]}))
+        cfgs = load_config_tree(str(tmp_path))
+        reg, mon, chirps = cfgs[""].layers
+        assert len(reg.dates) == 5
+        assert reg.effective_end_date == "2020-01-05T00:00:00.000Z"
+        assert len(mon.dates) == 6
+        assert chirps.dates[:3] == ["2020-01-01T00:00:00.000Z",
+                                    "2020-01-11T00:00:00.000Z",
+                                    "2020-01-21T00:00:00.000Z"]
+
+    def test_gdoc_heredoc(self, tmp_path):
+        (tmp_path / "config.json").write_text(
+            '{"layers": [{"name": "h", "abstract": $gdoc$line "quoted"\n'
+            'second$gdoc$}]}')
+        cfgs = load_config_tree(str(tmp_path), load_dates=False)
+        assert 'line "quoted"\nsecond' == cfgs[""].layers[0].abstract
+
+    def test_reload(self, tmp_path):
+        (tmp_path / "config.json").write_text(json.dumps(
+            {"layers": [{"name": "a"}]}))
+        w = ConfigWatcher(str(tmp_path), install_signal=False)
+        assert w.get("").layers[0].name == "a"
+        (tmp_path / "config.json").write_text(json.dumps(
+            {"layers": [{"name": "b"}]}))
+        w.reload()
+        assert w.get("").layers[0].name == "b"
+
+
+class TestMetrics:
+    def test_schema(self, env, capsys):
+        ml = env["server"].metrics
+        c = ml.collector()
+        c.set_url("/ows?service=WMS&foo=1&layers=x",
+                  "/ows", {"service": "WMS", "foo": "1", "layers": "x"})
+        c.set_remote("10.0.0.1:1234")
+        c.log(200)
+        info = c.info
+        assert info["http_status"] == 200
+        assert info["url"]["query"] == {"service": "WMS", "layers": "x"}
+        assert info["remote_host"] == "10.0.0.1"
+        assert "indexer" in info and "rpc" in info
+        assert info["req_duration"] > 0
+
+
+class TestServerReviewRegressions:
+    def test_capabilities_with_braces_in_abstract(self, tmp_path):
+        from gsky_tpu.server.config import load_config_file
+        from gsky_tpu.server import templates as T
+        (tmp_path / "config.json").write_text(json.dumps({"layers": [
+            {"name": "x", "abstract": "units in {mm} and {braces}"}]}))
+        cfg = load_config_file(str(tmp_path / "config.json"))
+        doc = T.wms_capabilities(cfg, "/ows", "http://h")
+        assert "{mm}" in doc
+
+    def test_bad_i_j_is_400(self, env):
+        status, _, body = _get(
+            env, f"/ows?service=WMS&request=GetFeatureInfo&layers=frac_cover"
+                 f"&crs=EPSG:3857&bbox={BBOX3857}&width=64&height=64"
+                 f"&i=abc&j=2&time={DATE}")
+        assert status == 400
+        assert b"invalid i" in body
+
+    def test_multi_subset_clauses(self):
+        from multidict import MultiDict
+        from gsky_tpu.server.params import normalise_query, parse_wcs
+        q = normalise_query(MultiDict([("service", "WCS"),
+                                       ("request", "GetCoverage"),
+                                       ("subset", "depth(5,10)"),
+                                       ("subset", "run(2)")]))
+        p = parse_wcs(q)
+        assert p.axes["depth"] == (5.0, 10.0)
+        assert p.axes["run"] == (2.0, 2.0)
+
+    def test_wcs_temp_file_cleaned(self, env):
+        import glob
+        before = set(glob.glob(os.path.join(
+            env["server"].temp_dir, "wcs_*.tif")))
+        status, _, body = _get(
+            env, f"/ows?service=WCS&request=GetCoverage&coverage=frac_cover"
+                 f"&crs=EPSG:3857&bbox={BBOX3857}&width=32&height=32"
+                 f"&format=GeoTIFF&time={DATE}")
+        assert status == 200
+        after = set(glob.glob(os.path.join(
+            env["server"].temp_dir, "wcs_*.tif")))
+        assert after == before  # deleted after the response body was read
