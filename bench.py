@@ -1,0 +1,150 @@
+"""Benchmark: WMS GetMap 256x256 tiles/sec, end-to-end.
+
+Renders a grid of 256x256 EPSG:3857 GetMap tiles over a synthetic
+Landsat-8-style UTM mosaic (overlapping scenes, distinct dates, nodata)
+through the full pipeline — MAS index query, GeoTIFF decode, batched TPU
+warp, newest-wins temporal mosaic, auto min-max byte scaling, palette,
+PNG encode — and reports tiles/sec.
+
+Baseline: the reference's only quantitative trace is a logged GetMap
+`req_duration` of 0.515 s for one 256x256 EPSG:3857 tile on an NCI node
+(`metrics/log_format.md:28-33`), i.e. ~1.94 tiles/s per request stream.
+`vs_baseline` = measured tiles/s / 1.94.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tiles/sec", "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+REF_TILE_SECONDS = 0.515357769  # metrics/log_format.md:28-33
+
+N_SCENES = 4
+SCENE_SIZE = 1536        # 1536x1536 int16 per scene, 30 m pixels
+GRID = 8                 # 8x8 = 64 tiles of 256x256
+WARMUP_TILES = 2
+CONCURRENCY = 8          # request-level concurrency (SURVEY §2.8 P1)
+
+
+def build_archive(root):
+    from gsky_tpu.geo.crs import parse_crs
+    from gsky_tpu.geo.transform import GeoTransform
+    from gsky_tpu.index import MASStore
+    from gsky_tpu.index.crawler import extract
+    from gsky_tpu.io import write_geotiff
+
+    utm = parse_crs("EPSG:32755")
+    rng = np.random.default_rng(42)
+    paths = []
+    for i in range(N_SCENES):
+        gt = GeoTransform(590000.0 + i * SCENE_SIZE * 30 // 3, 30.0, 0.0,
+                          6105000.0 - i * SCENE_SIZE * 30 // 5, 0.0, -30.0)
+        data = rng.uniform(200, 3000, (SCENE_SIZE, SCENE_SIZE)).astype(
+            np.int16)
+        data[: SCENE_SIZE // 8, : SCENE_SIZE // 8] = -999
+        date = f"2020-01-{10 + i:02d}"
+        p = os.path.join(root, f"LC08_{date.replace('-', '')}_T1.tif")
+        write_geotiff(p, data, gt, utm, nodata=-999)
+        paths.append(p)
+    store = MASStore()
+    for p in paths:
+        rec = extract(p)
+        assert not rec.get("error"), rec
+        store.ingest(rec)
+    return store, utm, paths
+
+
+def main():
+    t_setup = time.time()
+    import jax.numpy as jnp
+
+    from gsky_tpu.geo.crs import EPSG3857, EPSG4326, parse_crs
+    from gsky_tpu.geo.transform import BBox, GeoTransform, transform_bbox
+    from gsky_tpu.index import MASClient
+    from gsky_tpu.io.png import encode_png
+    from gsky_tpu.ops.palette import gradient_palette, with_nodata_entry
+    from gsky_tpu.ops.scale import scale_to_byte
+    from gsky_tpu.pipeline import GeoTileRequest, TilePipeline
+
+    tmp = tempfile.mkdtemp(prefix="gsky_bench_")
+    store, utm, paths = build_archive(tmp)
+    mas = MASClient(store)
+    pipe = TilePipeline(mas)
+    lut = with_nodata_entry(gradient_palette(
+        [(0, 0, 120, 255), (0, 180, 60, 255), (250, 250, 90, 255),
+         (180, 40, 10, 255)]))
+
+    # tile grid covering the mosaic's core in EPSG:3857
+    import datetime as dt
+    t0 = dt.datetime(2020, 1, 9, tzinfo=dt.timezone.utc).timestamp()
+    t1 = dt.datetime(2020, 1, 15, tzinfo=dt.timezone.utc).timestamp()
+    span = SCENE_SIZE * 30.0
+    core = BBox(590000.0 + span * 0.2, 6105000.0 - span * 1.1,
+                590000.0 + span * 1.1, 6105000.0 - span * 0.2)
+    # corners via WGS84 into web mercator
+    ll = transform_bbox(core, utm, EPSG4326)
+    merc = transform_bbox(ll, EPSG4326, EPSG3857)
+    dx = merc.width / GRID
+    dy = merc.height / GRID
+    band = "LC08_20200110_T1"
+
+    def tile_req(i, j):
+        bb = BBox(merc.xmin + i * dx, merc.ymin + j * dy,
+                  merc.xmin + (i + 1) * dx, merc.ymin + (j + 1) * dy)
+        return GeoTileRequest(
+            collection=tmp,
+            bands=[f"LC08_20200{110 + k}_T1" for k in range(N_SCENES)],
+            bbox=bb, crs=EPSG3857, width=256, height=256,
+            start_time=t0, end_time=t1)
+
+    def render(req):
+        res = pipe.process(req)
+        bands = [res.data[n] for n in res.namespaces if n in res.data]
+        valids = [res.valid[n] for n in res.namespaces if n in res.valid]
+        # mosaic namespaces into one canvas (newest-wins already per ns;
+        # cross-scene composite = first valid)
+        canvas = bands[0]
+        ok = valids[0]
+        for b, v in zip(bands[1:], valids[1:]):
+            take = v & ~ok
+            canvas = np.where(take, b, canvas)
+            ok = ok | v
+        sb = scale_to_byte(jnp.asarray(canvas), jnp.asarray(ok), auto=True)
+        return encode_png([np.asarray(sb)], lut)
+
+    reqs = [tile_req(i, j) for j in range(GRID) for i in range(GRID)]
+    # warm-up: trigger jit compilation of every shape bucket involved
+    for r in reqs[:WARMUP_TILES]:
+        render(r)
+    setup_s = time.time() - t_setup
+
+    start = time.time()
+    with ThreadPoolExecutor(CONCURRENCY) as ex:
+        pngs = list(ex.map(render, reqs))
+    elapsed = time.time() - start
+    assert all(len(p) > 100 for p in pngs)
+
+    tiles_per_sec = len(reqs) / elapsed
+    result = {
+        "metric": "WMS GetMap tiles/sec (256x256 EPSG:3857, "
+                  f"{N_SCENES}-scene Landsat mosaic, e2e incl. decode+PNG)",
+        "value": round(tiles_per_sec, 2),
+        "unit": "tiles/sec",
+        "vs_baseline": round(tiles_per_sec * REF_TILE_SECONDS, 2),
+        "tiles": len(reqs),
+        "elapsed_s": round(elapsed, 3),
+        "setup_s": round(setup_s, 1),
+        "platform": __import__("jax").devices()[0].platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,19 @@ import numpy as np
 NODATA_BYTE = 255
 
 
+def auto_byte_scale(data, valid, mn, mx, any_valid):
+    """The auto min-max byte mapping given precomputed extrema — shared
+    by the single-device path (jnp.min/max) and the SPMD render step
+    (lax.pmin/pmax over the spatial mesh axis)."""
+    mn = jnp.where(any_valid, mn, 0.0)
+    mx = jnp.where(any_valid, mx, 0.0)
+    mx = jnp.where(mx == mn, mx + 0.1, mx)
+    clip_e = mx - mn
+    v = jnp.maximum(jnp.minimum(data - mn, clip_e), 0.0)
+    b = jnp.clip(jnp.floor(v * (254.0 / clip_e)), 0, 254).astype(jnp.uint8)
+    return jnp.where(valid, b, jnp.uint8(NODATA_BYTE))
+
+
 @functools.partial(jax.jit, static_argnames=("colour_scale", "auto"))
 def scale_to_byte(data, valid, offset=0.0, scale=0.0, clip=0.0,
                   colour_scale: int = 0, auto: bool = False):
@@ -47,13 +60,7 @@ def scale_to_byte(data, valid, offset=0.0, scale=0.0, clip=0.0,
         big = jnp.float32(3.4e38)
         mn = jnp.min(jnp.where(valid, data, big))
         mx = jnp.max(jnp.where(valid, data, -big))
-        any_valid = jnp.any(valid)
-        mn = jnp.where(any_valid, mn, 0.0)
-        mx = jnp.where(any_valid, mx, 0.0)
-        mx = jnp.where(mx == mn, mx + 0.1, mx)
-        offset_e = -mn
-        clip_e = mx - mn
-        scale_e = 254.0 / clip_e
+        return auto_byte_scale(data, valid, mn, mx, jnp.any(valid))
     else:
         offset_e = jnp.float32(offset)
         clip_e = jnp.float32(clip)

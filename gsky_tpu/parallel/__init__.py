@@ -1,0 +1,14 @@
+"""Multi-chip parallelism: device meshes + sharded render/drill steps.
+
+The reference scales by fanning requests over worker machines (NCCL-free
+gRPC fan-out, SURVEY §2.8 P3/P5).  The TPU-native equivalent is SPMD over
+a `jax.sharding.Mesh`: the granule/time axis is data-parallel and the
+output width axis is spatially sharded, with XLA collectives
+(`all_gather`, `pmin`/`pmax`, `psum`) riding ICI for the mosaic combine,
+auto min-max scaling, and drill reductions.
+"""
+
+from .mesh import make_mesh
+from .render import make_sharded_render, make_sharded_drill
+
+__all__ = ["make_mesh", "make_sharded_render", "make_sharded_drill"]

@@ -1,0 +1,51 @@
+"""Device mesh construction for the sharded render path.
+
+Axes:
+  * ``granule`` — data parallel over the time/granule stack (the reference
+    fans one worker RPC per granule, `processor/tile_grpc.go:219-242`;
+    here granules are rows of a device mesh).
+  * ``x`` — spatial sharding over the output width (the reference's
+    WCS tile split across OWS nodes, `ows.go:835-872`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_GRANULE = "granule"
+AXIS_X = "x"
+
+
+def _factor2(n: int) -> Tuple[int, int]:
+    """Near-square factorisation n = a * b with a <= b."""
+    a = int(math.isqrt(n))
+    while a > 1 and n % a:
+        a -= 1
+    return a, n // a
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              shape: Optional[Tuple[int, int]] = None,
+              axis_names: Sequence[str] = (AXIS_GRANULE, AXIS_X)) -> Mesh:
+    """Build a 2-D (granule, x) mesh over the first ``n_devices`` devices.
+
+    ``shape`` overrides the automatic near-square factorisation.
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} devices, have {len(devs)} "
+            f"(set XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+            f"JAX_PLATFORMS=cpu for a virtual mesh)")
+    if shape is None:
+        shape = _factor2(n)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    grid = np.asarray(devs[:n]).reshape(shape)
+    return Mesh(grid, tuple(axis_names))

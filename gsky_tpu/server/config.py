@@ -22,6 +22,7 @@ from __future__ import annotations
 import copy
 import datetime as dt
 import json
+import logging
 import os
 import re
 import signal
@@ -520,7 +521,13 @@ class ConfigWatcher:
                 pass  # not the main thread
 
     def _on_hup(self, *_):
-        self.reload()
+        # a failed reload (malformed / mid-write config.json) must keep
+        # the previous config live, as the reference's WatchConfig does
+        try:
+            self.reload()
+        except Exception as e:
+            logging.getLogger("gsky.config").error(
+                "config reload failed, keeping previous config: %s", e)
 
     def reload(self):
         configs = load_config_tree(self.root, self.mas_factory)

@@ -60,8 +60,15 @@ class MetricsCollector:
 
     def set_remote(self, addr: str):
         self.info["remote_addr"] = addr
-        host, _, port = addr.rpartition(":")
-        self.info["remote_host"] = host or addr
+        host, port = addr, ""
+        if addr.startswith("["):          # [v6]:port
+            host, _, rest = addr.partition("]")
+            host = host[1:]
+            port = rest.lstrip(":")
+        elif addr.count(":") == 1:        # v4:port
+            host, _, port = addr.partition(":")
+        # bare v4 / bare v6: no port
+        self.info["remote_host"] = host
         self.info["remote_port"] = port
 
     def log(self, status: int = 200):
@@ -90,11 +97,12 @@ class MetricsLogger:
         return MetricsCollector(self)
 
     def write(self, info: Dict):
+        if not self.log_dir and not self.verbose:
+            return  # no sink — skip serialization entirely
         line = json.dumps(info, separators=(",", ":"))
         with self._lock:
             if not self.log_dir:
-                if self.verbose:
-                    sys.stdout.write(line + "\n")
+                sys.stdout.write(line + "\n")
                 return
             if self._fp is None or self._size > self.max_size:
                 self._rotate()

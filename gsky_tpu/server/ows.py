@@ -285,9 +285,12 @@ class OWSServer:
                                  p.height or 256, lay.wms_polygon_segments)
         req = _with_bands(req, lay.feature_info_bands or req.bands)
         pipe = self._pipeline(cfg)
-        fi = await asyncio.wait_for(
-            asyncio.to_thread(get_feature_info, pipe, req, p.x, p.y),
-            timeout=lay.wms_timeout)
+        try:
+            fi = await asyncio.wait_for(
+                asyncio.to_thread(get_feature_info, pipe, req, p.x, p.y),
+                timeout=lay.wms_timeout)
+        except ValueError as e:  # i/j outside the request grid
+            raise OWSError(str(e), "InvalidPoint")
         props = {k: (v if v is not None else "n/a")
                  for k, v in fi.values.items()}
         if lay.feature_info_max_dates != 0:

@@ -143,7 +143,7 @@ def parse_wms(q: Dict[str, str]) -> WMSParams:
         if q.get(key):
             try:
                 setattr(p, key, int(float(q[key])))
-            except ValueError:
+            except (ValueError, OverflowError):
                 raise OWSError(f"invalid {key}: {q[key]!r}")
     if q.get("format"):
         p.format = q["format"]
@@ -154,7 +154,7 @@ def parse_wms(q: Dict[str, str]) -> WMSParams:
             if q.get(key):
                 try:
                     setattr(p, attr, int(float(q[key])))
-                except ValueError:
+                except (ValueError, OverflowError):
                     raise OWSError(f"invalid {key}: {q[key]!r}")
     if q.get("info_format"):
         p.info_format = q["info_format"]
@@ -201,7 +201,7 @@ def parse_wcs(q: Dict[str, str]) -> WCSParams:
         if q.get(key):
             try:
                 setattr(p, key, int(float(q[key])))
-            except ValueError:
+            except (ValueError, OverflowError):
                 raise OWSError(f"invalid {key}: {q[key]!r}")
     if q.get("format"):
         p.format = q["format"]
