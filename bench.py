@@ -93,7 +93,6 @@ def main():
     merc = transform_bbox(ll, EPSG4326, EPSG3857)
     dx = merc.width / GRID
     dy = merc.height / GRID
-    band = "LC08_20200110_T1"
 
     def tile_req(i, j):
         bb = BBox(merc.xmin + i * dx, merc.ymin + j * dy,

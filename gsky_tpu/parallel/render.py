@@ -64,7 +64,6 @@ def make_sharded_render(mesh: Mesh, method: str = "near",
     must divide the respective mesh dimensions.
     """
     gather = _METHODS[method]
-    ng = mesh.shape[AXIS_GRANULE]
 
     if expr is None:
         def expr(bands, valids):

@@ -30,10 +30,15 @@ log = logging.getLogger("gsky.tile")
 
 class TilePipeline:
     def __init__(self, mas: MASClient, executor: Optional[WarpExecutor] = None,
-                 decode_workers: int = 8):
+                 decode_workers: int = 8, remote=None):
+        """``remote``: an optional `worker.WorkerClient`; when set, the
+        warp stage fans granules out to worker nodes over gRPC
+        (`processor/tile_grpc.go`) instead of decoding+warping
+        in-process."""
         self.mas = mas
         self.executor = executor or default_executor
         self.decode_workers = decode_workers
+        self.remote = remote
 
     # -- indexing ------------------------------------------------------------
 
@@ -89,10 +94,14 @@ class TilePipeline:
                 ("near", [i for i, m in enumerate(is_mask) if m])):
             if not idxs:
                 continue
-            ws = decode_all([granules[i] for i in idxs], req.bbox, req.crs,
-                            method, self.decode_workers)
-            wr = self.executor.warp_all(ws, req.dst_gt(), req.crs, H, W,
-                                        method)
+            if self.remote is not None:
+                wr = self.remote.warp_many([granules[i] for i in idxs],
+                                           req, method)
+            else:
+                ws = decode_all([granules[i] for i in idxs], req.bbox,
+                                req.crs, method, self.decode_workers)
+                wr = self.executor.warp_all(ws, req.dst_gt(), req.crs, H, W,
+                                            method)
             for k, i in enumerate(idxs):
                 warped[i] = wr[k]
         # group warped granules by base namespace

@@ -125,6 +125,7 @@ class GeoDrillRequest:
     pixel_count: bool = False
     band_strides: int = 1
     approx: bool = True                   # use crawler stats fast path
+    vrt_url: str = ""                     # optional VRT wrapping sources
 
     _exprs: Optional[BandExpressions] = None
 

@@ -62,7 +62,7 @@ class OWSServer:
         self.metrics = metrics or MetricsLogger()
         self.static_dir = static_dir
         self.temp_dir = temp_dir or tempfile.gettempdir()
-        self._pipelines: Dict[str, TilePipeline] = {}
+        self._pipelines: Dict[tuple, TilePipeline] = {}
 
     # -- plumbing -----------------------------------------------------------
 
@@ -70,9 +70,17 @@ class OWSServer:
         return self.mas_factory(cfg.service_config.mas_address)
 
     def _pipeline(self, cfg: Config) -> TilePipeline:
-        key = cfg.service_config.mas_address or cfg.service_config.namespace
+        # keyed on the fields the pipeline is built from, so a SIGHUP
+        # config reload that changes mas_address/worker_nodes takes
+        # effect without a restart (`WatchConfig`, `config.go:1373`)
+        sc = cfg.service_config
+        key = (sc.mas_address or sc.namespace, tuple(sc.worker_nodes))
         if key not in self._pipelines:
-            self._pipelines[key] = TilePipeline(self._mas(cfg))
+            remote = None
+            if sc.worker_nodes:
+                from ..worker import WorkerClient
+                remote = WorkerClient(sc.worker_nodes)
+            self._pipelines[key] = TilePipeline(self._mas(cfg), remote=remote)
         return self._pipelines[key]
 
     def app(self) -> web.Application:
@@ -284,13 +292,13 @@ class OWSServer:
         req = self._tile_request(cfg, lay, style, p, p.width or 256,
                                  p.height or 256, lay.wms_polygon_segments)
         req = _with_bands(req, lay.feature_info_bands or req.bands)
+        if not (0 <= p.x < req.width and 0 <= p.y < req.height):
+            raise OWSError(f"i/j ({p.x},{p.y}) outside "
+                           f"{req.width}x{req.height}", "InvalidPoint")
         pipe = self._pipeline(cfg)
-        try:
-            fi = await asyncio.wait_for(
-                asyncio.to_thread(get_feature_info, pipe, req, p.x, p.y),
-                timeout=lay.wms_timeout)
-        except ValueError as e:  # i/j outside the request grid
-            raise OWSError(str(e), "InvalidPoint")
+        fi = await asyncio.wait_for(
+            asyncio.to_thread(get_feature_info, pipe, req, p.x, p.y),
+            timeout=lay.wms_timeout)
         props = {k: (v if v is not None else "n/a")
                  for k, v in fi.values.items()}
         if lay.feature_info_max_dates != 0:

@@ -1,0 +1,12 @@
+"""The distributed worker boundary (§2.4 of the reference survey).
+
+``gsky-rpc``-equivalent gRPC service + supervised decode-subprocess pool
++ OOM monitor + client-side fan-out.  The compute inside the boundary is
+the TPU executor; the pool isolates codec IO crashes the way the
+reference isolates GDAL (`worker/gdalprocess/`).
+"""
+
+from .client import ConcLimiter, WorkerClient  # noqa: F401
+from .oom import OOMMonitor  # noqa: F401
+from .pool import PoolFullError, ProcessPool  # noqa: F401
+from .server import WorkerService, make_grpc_server  # noqa: F401

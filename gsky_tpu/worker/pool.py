@@ -1,0 +1,238 @@
+"""Supervised decode-subprocess pool.
+
+The fault-tolerance semantics of the reference's worker layer
+(`worker/gdalprocess/pool.go` + `process.go`):
+
+- N subprocesses share one bounded task queue; enqueue on a full queue is
+  rejected immediately (queue cap 200/process, `pool.go:19-25`).
+- A crashed or wedged subprocess is SIGKILLed and replaced; its task is
+  retried up to 5 times (`process.go:189-198`, `pool.go:40-63`).
+- Each subprocess is recycled after ``max_tasks`` tasks, jittered per
+  process so the pool doesn't recycle in lockstep (`pool.go:29-33`,
+  `process.go:154-159`).
+- Children die with the parent (Pdeathsig equivalent via
+  ``prctl(PR_SET_PDEATHSIG)`` in the child preexec, `process.go:63`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import queue
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+from . import gskyrpc_pb2 as pb
+from .ipc import call_subprocess
+
+log = logging.getLogger("gsky.worker.pool")
+
+MAX_RETRIES = 5
+QUEUE_CAP_PER_PROCESS = 200
+
+_PR_SET_PDEATHSIG = 1
+
+
+def _set_pdeathsig():
+    try:
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(_PR_SET_PDEATHSIG, signal.SIGKILL)
+    except Exception:
+        pass
+
+
+class _Task:
+    __slots__ = ("task", "event", "result", "attempts")
+
+    def __init__(self, task: pb.Task):
+        self.task = task
+        self.event = threading.Event()
+        self.result: Optional[pb.Result] = None
+        self.attempts = 0
+
+
+class PoolFullError(RuntimeError):
+    pass
+
+
+class Process:
+    """One supervised subprocess + the worker thread that feeds it."""
+
+    def __init__(self, pool: "ProcessPool", idx: int):
+        self.pool = pool
+        self.idx = idx
+        self.sock_path = os.path.join(
+            pool.tmp_dir, f"gsky_decode_{os.getpid()}_{idx}.sock")
+        # jittered recycle threshold (`pool.go:29-33`)
+        self.max_tasks = pool.max_tasks + (
+            random.randrange(pool.size) if pool.size > 1 else 0)
+        self.proc: Optional[subprocess.Popen] = None
+        self.tasks_done = 0
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=f"gsky-pool-{idx}")
+        self.thread.start()
+
+    # -- child lifecycle -----------------------------------------------------
+
+    def _spawn(self):
+        self.tasks_done = 0
+        try:
+            os.unlink(self.sock_path)
+        except FileNotFoundError:
+            pass
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "gsky_tpu.worker.subproc",
+             "-sock", self.sock_path,
+             "-max_tasks", str(self.max_tasks),
+             "-timeout", str(self.pool.task_timeout)],
+            preexec_fn=_set_pdeathsig,
+            stderr=subprocess.DEVNULL if self.pool.quiet else None)
+        self.proc = proc
+        # give the child time for its first imports (jax is heavy)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and not self.pool.closed:
+            if os.path.exists(self.sock_path):
+                return
+            if proc.poll() is not None:
+                break
+            time.sleep(0.01)
+        if self.pool.closed:
+            return
+        raise RuntimeError(f"decode subprocess {self.idx} failed to start")
+
+    def _kill(self):
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        self.proc = None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    # -- task loop -----------------------------------------------------------
+
+    def _respawn(self) -> bool:
+        """Spawn with the feeder thread kept alive on failure — a slot
+        that can't start a child keeps retrying instead of dying."""
+        try:
+            self._spawn()
+            return True
+        except (RuntimeError, OSError) as e:
+            log.error("subprocess %d spawn failed: %s", self.idx, e)
+            self._kill()
+            time.sleep(1.0)
+            return False
+
+    def _run(self):
+        self._respawn()
+        while not self.pool.closed:
+            if self.proc is None or self.proc.poll() is not None:
+                # crashed, recycled, or never started: replace it
+                if not self._respawn():
+                    continue
+            try:
+                item = self.pool.queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if item is None:
+                break
+            try:
+                res = call_subprocess(
+                    self.sock_path, item.task,
+                    timeout=self.pool.task_timeout + 10.0)
+                item.result = res
+                item.event.set()
+                self.tasks_done += 1
+                if self.tasks_done >= self.max_tasks:
+                    self._kill()
+                    self._respawn()
+            except (ConnectionError, OSError) as e:
+                # crash/wedge: kill + replace + retry (`process.go:189-198`)
+                log.warning("subprocess %d task failed (%s); restarting",
+                            self.idx, e)
+                self._kill()
+                self._respawn()
+                item.attempts += 1
+                if item.attempts >= MAX_RETRIES:
+                    item.result = pb.Result(
+                        error=f"task failed after {item.attempts} attempts")
+                    item.event.set()
+                else:
+                    try:
+                        self.pool.queue.put_nowait(item)
+                    except queue.Full:
+                        item.result = pb.Result(error="queue full on retry")
+                        item.event.set()
+        self._kill()
+
+
+class ProcessPool:
+    """N supervised subprocesses sharing one bounded queue."""
+
+    def __init__(self, size: Optional[int] = None, max_tasks: int = 20000,
+                 task_timeout: float = 120.0, tmp_dir: Optional[str] = None,
+                 quiet: bool = False):
+        self.size = size or max(os.cpu_count() or 2, 2)
+        self.max_tasks = max_tasks
+        self.task_timeout = task_timeout
+        self.tmp_dir = tmp_dir or tempfile.mkdtemp(prefix="gsky_pool_")
+        self.quiet = quiet
+        self.closed = False
+        self.queue: "queue.Queue[Optional[_Task]]" = queue.Queue(
+            maxsize=QUEUE_CAP_PER_PROCESS * self.size)
+        self.processes: List[Process] = [
+            Process(self, i) for i in range(self.size)]
+
+    def submit(self, task: pb.Task) -> pb.Result:
+        """Run one task; raises PoolFullError on backpressure
+        (`pool.go:19-25`)."""
+        if self.closed:
+            raise RuntimeError("pool closed")
+        item = _Task(task)
+        try:
+            self.queue.put_nowait(item)
+        except queue.Full:
+            raise PoolFullError("worker task queue full")
+        # IO timeout is enforced by the subprocess itself + call timeout;
+        # the extra margin covers queueing delay under load.
+        if not item.event.wait(self.task_timeout * MAX_RETRIES + 60.0):
+            return pb.Result(error="task timed out in queue")
+        return item.result
+
+    def child_pids(self) -> List[int]:
+        return [p.pid for p in self.processes if p.pid is not None]
+
+    def close(self):
+        self.closed = True
+        # fail queued tasks immediately so blocked submitters wake up
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item.result = pb.Result(error="pool closed")
+                item.event.set()
+        for _ in self.processes:
+            try:
+                self.queue.put_nowait(None)
+            except queue.Full:
+                pass  # feeders also exit via the closed-flag poll
+        for p in self.processes:
+            p.thread.join(timeout=10)
+            p._kill()

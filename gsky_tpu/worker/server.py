@@ -1,0 +1,208 @@
+"""The worker node: gRPC front door + device executor + decode pool.
+
+Role of the reference's `grpc-server/main.go` (binary ``gsky-rpc``): a
+gRPC service exposing ``rpc Process(Task) returns (Result)`` with
+operations
+
+- ``worker_info`` — answered inline (`grpc-server/main.go:31-33`),
+- ``warp``       — decode in the subprocess pool, then warp on the TPU
+                   executor owned by this process (the reference does the
+                   whole thing in a GDAL subprocess, `warp.go:82-410`),
+- ``drill``      — decode + rasterized-mask reductions on device
+                   (`worker/gdalprocess/drill.go`),
+- ``extent`` / ``info`` — pure IO, delegated to the pool.
+
+The pool gives crash isolation for codec IO; the OOM monitor SIGKILLs the
+fattest child under memory pressure (§5.3 semantics).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures as cf
+import json
+import logging
+import math
+from typing import Optional
+
+import numpy as np
+
+from . import gskyrpc_pb2 as pb
+from .oom import OOMMonitor
+from .pool import PoolFullError, ProcessPool
+from .serialize import granule_from_pb, pack_raster, unpack_raster
+
+log = logging.getLogger("gsky.worker.server")
+
+SERVICE = "gskyrpc.GDAL"
+METHOD = f"/{SERVICE}/Process"
+
+
+class WorkerService:
+    """Op dispatch shared by the gRPC wrapper and in-process tests."""
+
+    def __init__(self, pool: Optional[ProcessPool] = None,
+                 pool_size: Optional[int] = None,
+                 task_timeout: float = 120.0):
+        self.pool = pool or ProcessPool(size=pool_size,
+                                        task_timeout=task_timeout)
+        from ..pipeline.executor import WarpExecutor
+        self.executor = WarpExecutor()
+
+    # -- ops -----------------------------------------------------------------
+
+    def process(self, task: pb.Task) -> pb.Result:
+        op = task.operation
+        try:
+            if op == "worker_info":
+                return self._worker_info()
+            if op == "warp":
+                return self._warp(task)
+            if op == "drill":
+                return self._drill(task)
+            if op in ("extent", "info", "decode"):
+                return self.pool.submit(task)
+            return pb.Result(error=f"unknown operation {op!r}")
+        except PoolFullError as e:
+            return pb.Result(error=f"backpressure: {e}")
+        except Exception as e:
+            log.exception("op %s failed", op)
+            return pb.Result(error=f"{type(e).__name__}: {e}")
+
+    def _worker_info(self) -> pb.Result:
+        import jax
+        r = pb.Result()
+        r.worker.pool_size = self.pool.size
+        r.worker.queue_cap = self.pool.queue.maxsize
+        r.worker.platform = jax.default_backend()
+        return r
+
+    def _warp(self, task: pb.Task) -> pb.Result:
+        from ..geo.crs import parse_crs
+        from ..geo.transform import GeoTransform
+        from ..pipeline.decode import DecodedWindow
+
+        d = task.dst
+        decode = pb.Task()
+        decode.CopyFrom(task)
+        decode.operation = "decode"
+        dres = self.pool.submit(decode)
+        if dres.error:
+            return dres
+        win = unpack_raster(dres)
+        res = pb.Result()
+        if win is None:  # granule doesn't touch the tile -> empty result
+            return res
+        data, valid = win
+        wdw = DecodedWindow(
+            granule=granule_from_pb(task.granule), data=data, valid=valid,
+            window_gt=GeoTransform.from_gdal(list(dres.window_gt)),
+            src_crs=parse_crs(dres.src_srs))
+        dst_gt = GeoTransform.from_gdal(list(d.geo_transform))
+        out = self.executor.warp_all([wdw], dst_gt, parse_crs(d.srs),
+                                     d.height, d.width,
+                                     d.resample or "near")[0]
+        if out is None:
+            return res
+        pack_raster(res, out[0], out[1])
+        b = dst_gt.bbox(d.width, d.height)
+        res.bbox.extend([b.xmin, b.ymin, b.xmax, b.ymax])
+        res.dtype = "Float32"
+        res.metrics.CopyFrom(dres.metrics)
+        return res
+
+    def _drill(self, task: pb.Task) -> pb.Result:
+        from ..geo import geometry as geom
+        from ..index.client import Dataset
+        from ..pipeline.drill import _drill_file
+        from ..pipeline.types import GeoDrillRequest
+
+        g = task.granule
+        sp = task.drill
+        ds = Dataset(
+            file_path=g.path, ds_name=g.ds_name, namespace=g.namespace,
+            array_type=g.array_type or "Float32", srs=g.srs,
+            geo_transform=list(g.geo_transform),
+            timestamps=[], timestamps_iso=[], polygon="",
+            nodata=g.nodata if g.has_nodata else 0.0)
+        req = GeoDrillRequest(
+            collection="", bands=[g.namespace or "b1"],
+            geometry_wkt=sp.geometry_wkt,
+            band_strides=max(int(sp.stride), 1),
+            deciles=9 if sp.deciles else 0,
+            pixel_count=sp.pixel_count,
+            clip_lower=sp.clip_lower if sp.has_clip else -3.0e38,
+            clip_upper=sp.clip_upper if sp.has_clip else 3.0e38,
+            vrt_url=sp.vrt_xml)
+        sel = list(sp.time_indices) or [0]
+        out = _drill_file(ds, sel, geom.from_wkt(sp.geometry_wkt), req)
+        res = pb.Result()
+        if out is None:
+            return res
+        vals, counts, dec = out
+        res.series.means.extend(float(v) if math.isfinite(v) else 0.0
+                                for v in np.asarray(vals).ravel())
+        res.series.counts.extend(int(c) for c in np.asarray(counts).ravel())
+        res.series.deciles.extend(float(v) for v in np.asarray(dec).ravel())
+        return res
+
+    def close(self):
+        self.pool.close()
+
+
+# ---------------------------------------------------------------------------
+# gRPC wiring (generic handler; stubs aren't generated without grpcio-tools)
+# ---------------------------------------------------------------------------
+
+
+def make_grpc_server(service: WorkerService, address: str = "[::]:11429",
+                     max_workers: int = 32, max_msg: int = 64 << 20):
+    import grpc
+
+    handler = grpc.method_handlers_generic_handler(SERVICE, {
+        "Process": grpc.unary_unary_rpc_method_handler(
+            lambda req, ctx: service.process(req),
+            request_deserializer=pb.Task.FromString,
+            response_serializer=pb.Result.SerializeToString),
+    })
+    server = grpc.server(
+        cf.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_receive_message_length", max_msg),
+                 ("grpc.max_send_message_length", max_msg),
+                 ("grpc.so_reuseport", 1)])
+    server.add_generic_rpc_handlers((handler,))
+    server.add_insecure_port(address)
+    return server
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="gsky-rpc")
+    ap.add_argument("-p", "--port", type=int, default=11429)
+    ap.add_argument("-n", "--pool", type=int, default=0,
+                    help="decode pool size (default: cpu count)")
+    ap.add_argument("-max_tasks", type=int, default=20000)
+    ap.add_argument("-timeout", type=float, default=120.0)
+    ap.add_argument("-oom_threshold", type=int, default=1536,
+                    help="MemAvailable floor in MiB (0 disables)")
+    a = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    svc = WorkerService(pool_size=a.pool or None, task_timeout=a.timeout)
+    monitor = None
+    if a.oom_threshold:
+        monitor = OOMMonitor(svc.pool.child_pids,
+                             threshold_bytes=a.oom_threshold << 20)
+        monitor.start()
+    server = make_grpc_server(svc, f"[::]:{a.port}")
+    server.start()
+    log.info("gsky-rpc listening on :%d (pool=%d)", a.port, svc.pool.size)
+    try:
+        server.wait_for_termination()
+    finally:
+        if monitor:
+            monitor.stop()
+        svc.close()
+
+
+if __name__ == "__main__":
+    main()

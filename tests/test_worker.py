@@ -1,0 +1,269 @@
+"""Worker boundary tests: IPC framing, serialization, subprocess pool
+supervision (crash restart, recycle, backpressure), OOM monitor, and an
+end-to-end gRPC warp/drill/extent/info against the synthetic archive —
+the in-process parity check the reference never had (SURVEY §4)."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gsky_tpu.geo.crs import EPSG3857, EPSG4326, parse_crs
+from gsky_tpu.geo.transform import BBox, GeoTransform, transform_bbox
+from gsky_tpu.index.client import MASClient
+from gsky_tpu.pipeline.tile import TilePipeline
+from gsky_tpu.pipeline.types import GeoTileRequest, Granule
+from gsky_tpu.worker import gskyrpc_pb2 as pb
+from gsky_tpu.worker.oom import OOMMonitor
+from gsky_tpu.worker.pool import PoolFullError, ProcessPool
+from gsky_tpu.worker.serialize import (granule_from_pb, granule_to_pb,
+                                       pack_raster, unpack_raster)
+from gsky_tpu.worker.server import WorkerService, make_grpc_server
+
+from fixtures import make_archive
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+
+
+def test_granule_roundtrip():
+    g = Granule(path="/a.tif", ds_name="a.tif", namespace="red#t=1",
+                base_namespace="red", band=3, time_index=None,
+                timestamp=1577836800.0, srs="EPSG:32755",
+                geo_transform=[590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0],
+                nodata=-999.0, array_type="Int16", is_netcdf=False)
+    g2 = granule_from_pb(granule_to_pb(g))
+    assert g2 == g
+
+
+def test_granule_nodata_none_roundtrip():
+    g = Granule(path="p", ds_name="d", namespace="n", base_namespace="n",
+                band=1, time_index=2, timestamp=0.0, srs="EPSG:4326",
+                geo_transform=[0, 1, 0, 0, 0, -1], nodata=None,
+                array_type="Float32", is_netcdf=True, var_name="v")
+    g2 = granule_from_pb(granule_to_pb(g))
+    assert g2.nodata is None
+    assert g2.time_index == 2 and g2.var_name == "v"
+
+
+def test_raster_pack_roundtrip():
+    rng = np.random.default_rng(5)
+    data = rng.normal(size=(37, 53)).astype(np.float32)
+    valid = rng.uniform(size=(37, 53)) > 0.3
+    res = pb.Result()
+    pack_raster(res, data, valid)
+    out = unpack_raster(res)
+    assert out is not None
+    np.testing.assert_array_equal(out[0], data)
+    np.testing.assert_array_equal(out[1], valid)
+
+
+# ---------------------------------------------------------------------------
+# process pool supervision
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pool():
+    p = ProcessPool(size=2, task_timeout=30.0, quiet=True)
+    yield p
+    p.close()
+
+
+@pytest.fixture(scope="module")
+def archive(tmp_path_factory):
+    return make_archive(str(tmp_path_factory.mktemp("arch")), scenes=2,
+                        size=256)
+
+
+NS = "LC08_20200110_T1"
+TILE_BBOX = transform_bbox(BBox(148.02, -35.32, 148.12, -35.22),
+                           EPSG4326, EPSG3857)
+
+
+def _tif_dataset(archive):
+    mas = MASClient(archive["store"])
+    dss = mas.intersects(archive["root"], namespaces=NS)
+    return next(d for d in dss if d.file_path.endswith(".tif"))
+
+
+def _decode_task(archive, width=64, height=64) -> pb.Task:
+    ds = _tif_dataset(archive)
+    g = Granule(path=ds.file_path, ds_name=ds.ds_name, namespace=NS,
+                base_namespace=NS, band=1, time_index=None,
+                timestamp=ds.timestamps[0] if ds.timestamps else 0.0,
+                srs=ds.srs, geo_transform=ds.geo_transform,
+                nodata=ds.nodata, array_type=ds.array_type)
+    gt = GeoTransform.from_gdal(ds.geo_transform)
+    task = pb.Task(operation="decode")
+    task.granule.CopyFrom(granule_to_pb(g))
+    task.dst.srs = ds.srs
+    task.dst.geo_transform.extend(gt.to_gdal())
+    task.dst.width = width
+    task.dst.height = height
+    task.dst.resample = "near"
+    return task
+
+
+def test_pool_decode(pool, archive):
+    res = pool.submit(_decode_task(archive))
+    assert not res.error
+    out = unpack_raster(res)
+    assert out is not None
+    assert out[0].shape[0] > 0
+    assert res.metrics.bytes_read > 0
+    assert len(res.window_gt) == 6
+
+
+def test_pool_survives_child_crash(pool, archive):
+    """SIGKILL a child mid-life; the pool must replace it and keep
+    serving (`pool.go:40-63`)."""
+    pids = [p for p in pool.child_pids()]
+    assert len(pids) == 2
+    os.kill(pids[0], signal.SIGKILL)
+    deadline = time.time() + 15
+    ok = False
+    while time.time() < deadline:
+        res = pool.submit(_decode_task(archive))
+        if not res.error and unpack_raster(res) is not None:
+            ok = True
+            break
+        time.sleep(0.2)
+    assert ok, "pool did not recover from child crash"
+    # eventually a fresh pid appears
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        now = set(pool.child_pids())
+        if pids[0] not in now and len(now) == 2:
+            break
+        time.sleep(0.1)
+    assert pids[0] not in set(pool.child_pids())
+
+
+def test_pool_unknown_op(pool):
+    res = pool.submit(pb.Task(operation="no_such_op"))
+    assert "unknown operation" in res.error
+
+
+def test_pool_backpressure_rejects():
+    """A full task queue rejects immediately (`pool.go:19-25`) — built
+    without live subprocesses so the queue genuinely can't drain."""
+    import queue as queue_mod
+
+    p = ProcessPool.__new__(ProcessPool)
+    p.closed = False
+    p.queue = queue_mod.Queue(maxsize=1)
+    p.task_timeout = 1.0
+    p.queue.put_nowait(object())  # occupy the only slot
+    with pytest.raises(PoolFullError):
+        p.submit(pb.Task(operation="decode"))
+
+
+# ---------------------------------------------------------------------------
+# OOM monitor
+# ---------------------------------------------------------------------------
+
+
+def test_oom_monitor_kills_biggest(tmp_path):
+    meminfo = tmp_path / "meminfo"
+    meminfo.write_text("MemTotal: 1000 kB\nMemAvailable: 100 kB\n")
+    killed = []
+    mon = OOMMonitor(child_pids=lambda: [os.getpid()],
+                     threshold_bytes=10 << 20,
+                     meminfo_path=str(meminfo),
+                     kill=killed.append)
+    pid = mon.check_once()
+    assert pid == os.getpid()
+    assert killed == [os.getpid()]
+
+
+def test_oom_monitor_noop_above_threshold(tmp_path):
+    meminfo = tmp_path / "meminfo"
+    meminfo.write_text("MemAvailable: 8000000 kB\n")
+    mon = OOMMonitor(child_pids=lambda: [os.getpid()],
+                     threshold_bytes=1 << 20, meminfo_path=str(meminfo),
+                     kill=lambda pid: (_ for _ in ()).throw(AssertionError))
+    assert mon.check_once() is None
+
+
+def test_oom_poll_interval_adapts(tmp_path):
+    mon = OOMMonitor(child_pids=lambda: [], threshold_bytes=0,
+                     meminfo_path="/proc/meminfo")
+    i1 = mon.poll_interval(1 << 30)
+    time.sleep(0.01)
+    # memory dropping fast -> shorter interval
+    i2 = mon.poll_interval((1 << 30) - (512 << 20))
+    assert i2 <= i1
+
+
+# ---------------------------------------------------------------------------
+# gRPC end-to-end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def grpc_worker(pool):
+    svc = WorkerService(pool=pool)
+    server = make_grpc_server(svc, "127.0.0.1:0")
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield f"127.0.0.1:{port}"
+    server.stop(grace=None)
+
+
+def test_grpc_worker_info(grpc_worker):
+    from gsky_tpu.worker import WorkerClient
+    c = WorkerClient([grpc_worker])
+    infos = c.worker_info()
+    assert len(infos) == 1
+    assert infos[0].pool_size == 2
+    assert infos[0].platform
+    c.close()
+
+
+def test_grpc_remote_pipeline_matches_local(grpc_worker, archive):
+    """The remote warp path must agree with the in-process path — the
+    CPU-vs-remote parity test SURVEY §4 calls for."""
+    from gsky_tpu.worker import WorkerClient
+    mas = MASClient(archive["store"])
+    req = GeoTileRequest(
+        collection=archive["root"], bands=[NS],
+        bbox=TILE_BBOX, crs=EPSG3857, width=128, height=128,
+        start_time=1578000000.0 - 90 * 86400,
+        end_time=1578700000.0)
+    local = TilePipeline(mas).process(req)
+    remote = TilePipeline(mas, remote=WorkerClient([grpc_worker])).process(req)
+    assert local.namespaces == remote.namespaces
+    for ns in local.namespaces:
+        np.testing.assert_array_equal(local.valid[ns], remote.valid[ns])
+        np.testing.assert_allclose(local.data[ns], remote.data[ns],
+                                   rtol=1e-6)
+
+
+def test_grpc_info_op(grpc_worker, archive):
+    from gsky_tpu.worker import WorkerClient
+    c = WorkerClient([grpc_worker])
+    tif = next(p for p in archive["paths"] if p.endswith(".tif"))
+    info = json.loads(c.info(tif))
+    assert info["filename"] == tif
+    assert info["geo_metadata"]
+    c.close()
+
+
+def test_grpc_extent_op(grpc_worker, archive):
+    from gsky_tpu.worker import WorkerClient
+    c = WorkerClient([grpc_worker])
+    ds = _tif_dataset(archive)
+    g = Granule(path=ds.file_path, ds_name=ds.ds_name, namespace=NS,
+                base_namespace=NS, band=1, time_index=None,
+                timestamp=0.0, srs=ds.srs, geo_transform=ds.geo_transform,
+                nodata=ds.nodata, array_type=ds.array_type)
+    w, h = c.extent(g, EPSG3857)
+    assert w > 0 and h > 0
+    c.close()
