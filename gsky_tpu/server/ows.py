@@ -46,6 +46,7 @@ from ..pipeline import (DrillPipeline, GeoDrillRequest, GeoTileRequest,
 from ..pipeline.extent import compute_reprojection_extent
 from ..pipeline.feature_info import get_feature_info
 from ..pipeline.types import AxisSelector, MaskSpec
+from . import dap4
 from . import templates as T
 from .config import Config, ConfigWatcher, Layer
 from .metrics import MetricsLogger
@@ -62,7 +63,7 @@ class OWSServer:
         self.metrics = metrics or MetricsLogger()
         self.static_dir = static_dir
         self.temp_dir = temp_dir or tempfile.gettempdir()
-        self._pipelines: Dict[tuple, TilePipeline] = {}
+        self._pipelines: Dict[str, Tuple[tuple, TilePipeline]] = {}
 
     # -- plumbing -----------------------------------------------------------
 
@@ -70,18 +71,24 @@ class OWSServer:
         return self.mas_factory(cfg.service_config.mas_address)
 
     def _pipeline(self, cfg: Config) -> TilePipeline:
-        # keyed on the fields the pipeline is built from, so a SIGHUP
-        # config reload that changes mas_address/worker_nodes takes
-        # effect without a restart (`WatchConfig`, `config.go:1373`)
+        # one pipeline per namespace, rebuilt (and the old WorkerClient
+        # closed) when a SIGHUP reload changes mas_address/worker_nodes
+        # (`WatchConfig`, `config.go:1373`)
         sc = cfg.service_config
-        key = (sc.mas_address or sc.namespace, tuple(sc.worker_nodes))
-        if key not in self._pipelines:
-            remote = None
-            if sc.worker_nodes:
-                from ..worker import WorkerClient
-                remote = WorkerClient(sc.worker_nodes)
-            self._pipelines[key] = TilePipeline(self._mas(cfg), remote=remote)
-        return self._pipelines[key]
+        nskey = sc.namespace or sc.mas_address
+        settings = (sc.mas_address, tuple(sc.worker_nodes))
+        cur = self._pipelines.get(nskey)
+        if cur is not None and cur[0] == settings:
+            return cur[1]
+        if cur is not None and cur[1].remote is not None:
+            cur[1].remote.close()
+        remote = None
+        if sc.worker_nodes:
+            from ..worker import WorkerClient
+            remote = WorkerClient(sc.worker_nodes)
+        pipe = TilePipeline(self._mas(cfg), remote=remote)
+        self._pipelines[nskey] = (settings, pipe)
+        return pipe
 
     def app(self) -> web.Application:
         app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -113,13 +120,16 @@ class OWSServer:
             if cfg is None:
                 raise OWSError(f"no configuration for namespace {ns!r}",
                                status=404)
-            svc = infer_service(q)
-            if svc == "WMS":
-                resp = await self.serve_wms(request, cfg, q, collector)
-            elif svc == "WCS":
-                resp = await self.serve_wcs(request, cfg, q, collector)
+            if "dap4.ce" in q:
+                resp = await self.serve_dap(request, cfg, q, collector)
             else:
-                resp = await self.serve_wps(request, cfg, q, collector)
+                svc = infer_service(q)
+                if svc == "WMS":
+                    resp = await self.serve_wms(request, cfg, q, collector)
+                elif svc == "WCS":
+                    resp = await self.serve_wcs(request, cfg, q, collector)
+                else:
+                    resp = await self.serve_wps(request, cfg, q, collector)
             collector.log(resp.status)
             return resp
         except OWSError as e:
@@ -193,6 +203,21 @@ class OWSServer:
             start = parse_time(lay.effective_start_date)
         axes = []
         for ax in lay.axes_info:
+            idx_sels = getattr(p, "axis_idx", {}).get(ax.name)
+            if idx_sels:
+                # DAP4 index selection `[start:step:end]` (`dap.go:123-131`)
+                for (s, e, st, is_range, is_all) in idx_sels:
+                    if is_all:
+                        axes.append(AxisSelector(name=ax.name, idx_start=0,
+                                                 aggregate=0))
+                    elif not is_range:
+                        axes.append(AxisSelector(name=ax.name, idx_start=s,
+                                                 idx_end=s, aggregate=0))
+                    else:
+                        axes.append(AxisSelector(
+                            name=ax.name, idx_start=s or 0, idx_end=e,
+                            idx_step=st or 1, aggregate=0))
+                continue
             val = getattr(p, "axes", {}).get(ax.name, ax.default)
             if isinstance(val, tuple):  # WCS subset=(lo, hi)
                 lo, hi = val
@@ -338,6 +363,19 @@ class OWSServer:
                 img_bytes = fp.read()
         return empty_tile_png(width, height, img_bytes)
 
+    # -- DAP4 (`dap.go:13-36`) ----------------------------------------------
+
+    async def serve_dap(self, request, cfg: Config, q, collector):
+        """``dap4.ce`` constraint expression -> WCS GetCoverage with
+        dap4 output."""
+        try:
+            ce = dap4.parse_constraint_expr(q["dap4.ce"])
+        except ValueError as e:
+            raise OWSError(f"Failed to parse dap4.ce: {e}",
+                           "InvalidParameterValue")
+        p = dap4.dap_to_wcs(ce, cfg)
+        return await self._getcoverage(cfg, p, collector)
+
     # -- WCS (`ows.go:568-1221`) --------------------------------------------
 
     async def serve_wcs(self, request, cfg: Config, q, collector):
@@ -368,6 +406,9 @@ class OWSServer:
         pipe = self._pipeline(cfg)
         base_req = self._tile_request(cfg, lay, style, p, 256, 256,
                                       lay.wcs_polygon_segments)
+        if getattr(p, "bands_override", None):
+            # DAP4 CEs name the variables to fetch (`dap.go:137-143`)
+            base_req = _with_bands(base_req, p.bands_override)
         if width <= 0 or height <= 0:
             # auto size from source resolution (`ows.go:773-806`)
             width, height = await asyncio.to_thread(
@@ -422,6 +463,10 @@ class OWSServer:
             arrays[n] = a
         gt = GeoTransform.from_bbox(p.bbox, width, height)
         stamp = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%d%H%M%S")
+        if fmt == "dap4":
+            body = await asyncio.to_thread(dap4.encode_dap4, ns_names,
+                                           arrays)
+            return web.Response(body=body, content_type=dap4.CONTENT_TYPE)
         if fmt in ("netcdf", "nc", "application/x-netcdf"):
             path = os.path.join(self.temp_dir, f"wcs_{stamp}_{id(p)}.nc")
             xs = gt.x0 + (np.arange(width) + 0.5) * gt.dx

@@ -178,6 +178,11 @@ class WCSParams:
     styles: List[str] = field(default_factory=list)
     axes: Dict[str, Tuple[Optional[float], Optional[float]]] = \
         field(default_factory=dict)
+    # index-based axis selection from DAP4 CEs: name ->
+    # [(start, end, step, is_range, is_all), ...]
+    axis_idx: Dict[str, List[Tuple]] = field(default_factory=dict)
+    # DAP4 bridge: variables named in the CE replace the layer bands
+    bands_override: List[str] = field(default_factory=list)
 
 
 def parse_wcs(q: Dict[str, str]) -> WCSParams:

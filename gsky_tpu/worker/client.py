@@ -127,9 +127,15 @@ class WorkerClient:
 
         out = list(self._fanout.map(one, granules))
         if failures:
-            # outage visibility: a dead fleet must not look like "no data"
             log.warning("%d/%d warp RPCs failed (first: %s)",
                         len(failures), len(granules), failures[0])
+            # outage visibility: a dead fleet must not look like "no
+            # data" — per-granule failures degrade to empty granules,
+            # total failure becomes an error response upstream
+            if len(failures) == len(granules):
+                raise RuntimeError(
+                    f"all {len(granules)} warp RPCs failed "
+                    f"(first: {failures[0]})")
         return out
 
     def extent(self, granule: Granule, dst_crs: CRS) -> Tuple[int, int]:

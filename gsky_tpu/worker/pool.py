@@ -70,9 +70,14 @@ class Process:
         self.idx = idx
         self.sock_path = os.path.join(
             pool.tmp_dir, f"gsky_decode_{os.getpid()}_{idx}.sock")
-        # jittered recycle threshold (`pool.go:29-33`)
+        # jittered recycle threshold, proportional to the recycle period
+        # so a pool draining one shared queue doesn't restart in
+        # lockstep (the reference jitters by pool size, `pool.go:29-33`;
+        # our children block ~tens of seconds on startup imports, so the
+        # spread must be much wider than a few tasks)
         self.max_tasks = pool.max_tasks + (
-            random.randrange(pool.size) if pool.size > 1 else 0)
+            random.randrange(max(pool.size, pool.max_tasks // 10))
+            if pool.size > 1 else 0)
         self.proc: Optional[subprocess.Popen] = None
         self.tasks_done = 0
         self.thread = threading.Thread(target=self._run, daemon=True,

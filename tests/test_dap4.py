@@ -1,0 +1,238 @@
+"""DAP4 subsystem: constraint-expression parser grammar, chunk framing,
+DMR/encoder output, and the /ows?dap4.ce= endpoint."""
+
+import asyncio
+import datetime as dt
+import json
+import math
+import struct
+
+import numpy as np
+import pytest
+
+from gsky_tpu.server import dap4
+from gsky_tpu.server.params import OWSError
+
+from fixtures import make_archive
+
+
+# ---------------------------------------------------------------------------
+# CE parser
+# ---------------------------------------------------------------------------
+
+
+class TestCEParser:
+    def test_simple_variable(self):
+        ce = dap4.parse_constraint_expr("dataset{var1}")
+        assert ce.dataset == "dataset"
+        assert len(ce.var_params) == 1
+        assert ce.var_params[0].name == "var1"
+        assert not ce.var_params[0].is_axis
+
+    def test_multiple_vars_and_axis(self):
+        ce = dap4.parse_constraint_expr("ds{a;b;t[0:2]}")
+        assert [v.name for v in ce.var_params] == ["a", "b", "t"]
+        assert ce.var_params[2].is_axis
+        sel = ce.var_params[2].idx_selectors[0]
+        assert (sel.start, sel.end, sel.is_range) == (0, 2, True)
+
+    def test_selector_forms(self):
+        ce = dap4.parse_constraint_expr("ds{t[]};ignored".split(";")[0])
+        assert ce.var_params[0].idx_selectors[0].is_all
+        ce = dap4.parse_constraint_expr("ds{t[5]}")
+        sel = ce.var_params[0].idx_selectors[0]
+        assert sel.start == 5 and not sel.is_range
+        ce = dap4.parse_constraint_expr("ds{t[1:2:9]}")
+        sel = ce.var_params[0].idx_selectors[0]
+        assert (sel.start, sel.step, sel.end) == (1, 2, 9)
+
+    def test_filters_value_range(self):
+        ce = dap4.parse_constraint_expr("ds{v} | 1 < x < 10, y >= -35")
+        byname = {v.name: v for v in ce.var_params}
+        assert byname["x"].val_start == 1 and byname["x"].val_end == 10
+        assert byname["y"].val_start == -35
+        assert byname["y"].val_end == math.inf
+
+    def test_filter_reverse_range(self):
+        ce = dap4.parse_constraint_expr("ds{v} | 10 > x > 1")
+        x = [v for v in ce.var_params if v.name == "x"][0]
+        assert x.val_start == 1 and x.val_end == 10
+
+    def test_filter_iso_time(self):
+        ce = dap4.parse_constraint_expr(
+            "ds{v} | time >= 2020-01-10T00:00:00.000Z")
+        tv = [v for v in ce.var_params if v.name == "time"][0]
+        want = dt.datetime(2020, 1, 10, tzinfo=dt.timezone.utc).timestamp()
+        assert tv.val_start == want
+
+    @pytest.mark.parametrize("bad", [
+        "noselector", "{v}", "ds{v", "ds{v;v}", "ds{1bad}",
+        "ds{t[-1]}", "ds{t[1:2:3:4]}", "ds{v} | x", "ds{v} | 1 < x > 2",
+        "ds{v} | 5 < x < 1", "ds{v}|a|b",
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError):
+            dap4.parse_constraint_expr(bad)
+
+
+# ---------------------------------------------------------------------------
+# chunk framing + encoder
+# ---------------------------------------------------------------------------
+
+
+def _read_chunks(buf: bytes):
+    out = []
+    off = 0
+    while off < len(buf):
+        flags = buf[off]
+        (n,) = struct.unpack(">I", b"\x00" + buf[off + 1:off + 4])
+        out.append((flags, buf[off + 4:off + 4 + n]))
+        off += 4 + n
+        if flags & dap4.LAST_CHUNK:
+            break
+    return out, off
+
+
+class TestEncoder:
+    def test_chunk_roundtrip(self):
+        c = dap4._chunk(b"hello")
+        assert c[0] == dap4.LITTLE_ENDIAN_CHUNK | dap4.NOCHECKSUM_CHUNK
+        chunks, _ = _read_chunks(c + dap4.last_chunk())
+        assert chunks[0][1] == b"hello"
+        assert chunks[-1][0] & dap4.LAST_CHUNK
+
+    def test_split_dimensions(self):
+        vars_, axes, vals = dap4.split_dimensions(
+            ["veg#level=1", "veg#level=2", "soil#level=1"])
+        assert vars_ == ["veg", "soil"]
+        assert axes == ["level"]
+        assert vals["level"] == [1.0, 2.0]
+
+    def test_split_dimensions_sanitises_names(self):
+        vars_, _, _ = dap4.split_dimensions(["2bad name"])
+        assert vars_ == ["var1"]
+
+    def test_encode_roundtrip(self):
+        h, w = 7, 9
+        a = np.arange(h * w, dtype=np.float32).reshape(h, w)
+        b = a * 2
+        body = dap4.encode_dap4(["va", "vb"], {"va": a, "vb": b})
+        chunks, consumed = _read_chunks(body)
+        assert consumed == len(body)
+        dmr = chunks[0][1].decode()
+        assert '<Float32 name="va">' in dmr
+        assert f'<Dimension name="y" size="{h}"/>' in dmr
+        assert "_DAP4_Little_Endian" in dmr
+        got_a = np.frombuffer(chunks[1][1], "<f4").reshape(h, w)
+        got_b = np.frombuffer(chunks[2][1], "<f4").reshape(h, w)
+        np.testing.assert_array_equal(got_a, a)
+        np.testing.assert_array_equal(got_b, b)
+        assert chunks[-1][0] & dap4.LAST_CHUNK
+
+    def test_encode_axis_values_chunk(self):
+        a = np.zeros((2, 2), np.float32)
+        names = ["v#t=100", "v#t=200"]
+        body = dap4.encode_dap4(names, {n: a for n in names})
+        chunks, _ = _read_chunks(body)
+        dmr = chunks[0][1].decode()
+        assert '<Dimension name="t" size="2"/>' in dmr
+        axis = np.frombuffer(chunks[1][1], "<f8")
+        np.testing.assert_array_equal(axis, [100.0, 200.0])
+        # two data chunks follow the axis chunk
+        assert len(chunks) == 5
+
+    def test_large_band_splits_chunks(self):
+        a = np.zeros((1, dap4.MAX_CHUNK // 4 + 10), np.float32)
+        body = dap4.encode_dap4(["v"], {"v": a})
+        chunks, _ = _read_chunks(body)
+        data_chunks = [c for f, c in chunks[1:-1]]
+        assert len(data_chunks) == 2
+        assert sum(len(c) for c in data_chunks) == a.nbytes
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    from gsky_tpu.index.client import MASClient
+    from gsky_tpu.server.config import ConfigWatcher
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    root = tmp_path_factory.mktemp("dap")
+    arch = make_archive(str(root / "data"))
+    conf = root / "conf"
+    conf.mkdir()
+    (conf / "config.json").write_text(json.dumps({
+        "service_config": {"ows_hostname": "", "mas_address": "inproc"},
+        "layers": [{
+            "name": "frac_cover", "title": "fc",
+            "data_source": arch["root"],
+            "rgb_products": ["phot_veg"],
+            "time_generator": "mas",
+            "default_geo_bbox": [147.5, -36.5, 149.5, -34.5],
+            "default_geo_size": [64, 64],
+        }, {
+            "name": "no_dap", "title": "dap disabled",
+            "data_source": arch["root"],
+            "rgb_products": ["phot_veg"],
+            "disable_services": ["dap4"],
+            "time_generator": "mas",
+        }],
+    }))
+    mas_client = MASClient(arch["store"])
+    watcher = ConfigWatcher(str(conf), mas_factory=lambda a: mas_client,
+                            install_signal=False)
+    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                       metrics=MetricsLogger())
+    return {"server": server}
+
+
+def _get(env, path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        client = TestClient(TestServer(env["server"].app()))
+        await client.start_server()
+        try:
+            resp = await client.get(path)
+            return resp.status, resp.content_type, await resp.read()
+        finally:
+            await client.close()
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+class TestDapEndpoint:
+    def test_dap_fetch(self, env):
+        ce = ("frac_cover{phot_veg} | 148 < x < 148.5, -35.5 < y < -35, "
+              "time >= 2020-01-10T00:00:00.000Z")
+        status, ctype, body = _get(
+            env, "/ows?dap4.ce=" + ce.replace(" ", "%20"))
+        assert status == 200, body[:300]
+        assert ctype == dap4.CONTENT_TYPE
+        chunks, consumed = _read_chunks(body)
+        assert consumed == len(body)
+        dmr = chunks[0][1].decode()
+        assert '<Float32 name="phot_veg">' in dmr
+        data = np.frombuffer(chunks[1][1], "<f4")
+        assert data.size == 64 * 64
+        valid = data[data > -9000]
+        assert valid.size > 0 and 0 <= valid.mean() <= 100
+
+    def test_dap_bad_ce(self, env):
+        status, _, body = _get(env, "/ows?dap4.ce=garbage")
+        assert status == 400
+        assert b"dap4.ce" in body
+
+    def test_dap_unknown_dataset(self, env):
+        status, _, body = _get(env, "/ows?dap4.ce=nope{v}")
+        assert status == 400
+        assert b"not found" in body
+
+    def test_dap_disabled_layer(self, env):
+        status, _, body = _get(env, "/ows?dap4.ce=no_dap{phot_veg}")
+        assert status in (400, 501)
+        assert b"disabled" in body
