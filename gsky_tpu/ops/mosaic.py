@@ -99,25 +99,44 @@ def compute_bit_mask(data, mask_value: Optional[str],
     return out
 
 
-def mosaic_stack_host(rasters, nodata_masks, timestamps,
-                      exclude_masks=None, weights=None):
-    """Host-side convenience: order granule arrays by mosaic priority and
-    run the device reduction.
+def mosaic_stack(rasters, nodata_masks, timestamps,
+                 exclude_masks=None, weights=None):
+    """Order granule arrays by mosaic priority and run the device
+    reduction; inputs may be jax or numpy arrays and the result STAYS ON
+    DEVICE (the tile pipeline keeps every stage device-resident so a tile
+    costs one upload + one final download).
 
-    rasters: list of (H, W) f32 numpy arrays (already warped to the canvas
+    rasters: list of (H, W) f32 arrays (already warped to the canvas
     grid); nodata_masks: list of (H, W) bool (True = valid);
     exclude_masks: optional list of (H, W) bool (True = excluded by mask
     band); weights: optional per-granule weights -> weighted fusion blend.
     """
     order = priority_order(timestamps)
-    stack = jnp.asarray(np.stack([rasters[i] for i in order]))
-    valid = np.stack([nodata_masks[i] for i in order])
+    stack = jnp.stack([jnp.asarray(rasters[i]) for i in order])
+    valid = jnp.stack([jnp.asarray(nodata_masks[i]) for i in order])
     if exclude_masks is not None:
-        valid = valid & ~np.stack([exclude_masks[i] for i in order])
-    valid = jnp.asarray(valid)
+        valid = valid & ~jnp.stack(
+            [jnp.asarray(exclude_masks[i]) for i in order])
+    # pow2-pad the granule axis with invalid layers so the jitted
+    # reduction compiles for a bounded set of T shapes
+    T = stack.shape[0]
+    Tp = 1
+    while Tp < T:
+        Tp *= 2
+    if Tp != T:
+        pad = [(0, Tp - T)] + [(0, 0)] * (stack.ndim - 1)
+        stack = jnp.pad(stack, pad)
+        valid = jnp.pad(valid, pad, constant_values=False)
     if weights is not None:
-        w = jnp.asarray(np.asarray([weights[i] for i in order], np.float32))
-        out, ok = mosaic_weighted(stack, valid, w)
-    else:
-        out, ok = mosaic_first_valid(stack, valid)
+        w = np.zeros(Tp, np.float32)
+        w[:T] = [weights[i] for i in order]
+        return mosaic_weighted(stack, valid, jnp.asarray(w))
+    return mosaic_first_valid(stack, valid)
+
+
+def mosaic_stack_host(rasters, nodata_masks, timestamps,
+                      exclude_masks=None, weights=None):
+    """`mosaic_stack` with the result pulled back to host numpy."""
+    out, ok = mosaic_stack(rasters, nodata_masks, timestamps,
+                           exclude_masks, weights)
     return np.asarray(out), np.asarray(ok)

@@ -76,6 +76,19 @@ def scale_to_byte(data, valid, offset=0.0, scale=0.0, clip=0.0,
     return jnp.where(valid, b, jnp.uint8(NODATA_BYTE))
 
 
+@functools.partial(jax.jit, static_argnames=("colour_scale", "auto"))
+def compose_scale_byte(stack, valid, offset=0.0, scale=0.0, clip=0.0,
+                       colour_scale: int = 0, auto: bool = False):
+    """Fused first-valid composite over the leading namespace axis +
+    byte scaling: stack (N, H, W) f32, valid (N, H, W) bool -> uint8
+    (H, W).  One device dispatch from per-namespace canvases to the
+    PNG-ready byte tile."""
+    idx = jnp.argmax(valid, axis=0)
+    data = jnp.take_along_axis(stack, idx[None], axis=0)[0]
+    ok = jnp.any(valid, axis=0)
+    return scale_to_byte(data, ok, offset, scale, clip, colour_scale, auto)
+
+
 def scale_params_auto(offset, scale, clip) -> bool:
     """The reference's auto-minmax trigger (`raster_scaler.go:46`)."""
     return offset == 0.0 and scale == 0.0 and clip == 0.0

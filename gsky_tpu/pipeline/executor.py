@@ -20,7 +20,7 @@ import numpy as np
 
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
-from ..ops.warp import warp_gather_batch
+from ..ops.warp import warp_gather_batch, warp_mosaic_batch
 from .decode import DecodedWindow
 
 # padded source-window shape buckets (H and W independently bucketed)
@@ -32,6 +32,15 @@ def _bucket(n: int) -> int:
         if n <= b:
             return b
     return int(math.ceil(n / 4096) * 4096)
+
+
+def _bucket_pow2(n: int, lo: int = 1) -> int:
+    """Next power of two >= n (batch-count and namespace-count padding so
+    jit specialisations stay bounded)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
 
 
 class WarpExecutor:
@@ -89,11 +98,14 @@ class WarpExecutor:
             buckets.setdefault((_bucket(h), _bucket(w)), []).append(job)
 
         for (bh, bw), batch in buckets.items():
-            B = len(batch)
+            B = _bucket_pow2(len(batch))  # pow2 pad: bounded jit variants
             src = np.zeros((B, bh, bw), np.float32)
             valid = np.zeros((B, bh, bw), bool)
-            rows = np.stack([j[2] for j in batch])
-            cols = np.stack([j[3] for j in batch])
+            rows = np.full((B, height, width), -1e6, np.float32)
+            cols = np.full((B, height, width), -1e6, np.float32)
+            for k, j in enumerate(batch):
+                rows[k] = j[2]
+                cols[k] = j[3]
             for k, (_, wdw, _, _) in enumerate(batch):
                 h, w = wdw.data.shape
                 src[k, :h, :w] = wdw.data
@@ -101,11 +113,51 @@ class WarpExecutor:
             out, ok = warp_gather_batch(
                 jnp.asarray(src), jnp.asarray(valid),
                 jnp.asarray(rows), jnp.asarray(cols), method)
-            out = np.asarray(out)
-            ok = np.asarray(ok)
+            # results stay ON DEVICE (lazy per-granule slices); downstream
+            # mosaic/expr/scale stages consume them without a host round
+            # trip — critical when the device sits behind a network tunnel
+            # where every sync costs tens of ms
             for k, (i, _, _, _) in enumerate(batch):
                 results[i] = (out[k], ok[k])
         return results
+
+
+    def warp_mosaic(self, windows: Sequence[DecodedWindow],
+                    ns_ids: Sequence[int], prios: Sequence[float],
+                    dst_gt: GeoTransform, dst_crs: CRS,
+                    height: int, width: int, n_ns: int,
+                    method: str = "near"):
+        """Fused fast path: warp every window AND mosaic per namespace in
+        one device dispatch (3 uploads, 1 execution, 0 downloads — results
+        stay on device).  All windows are padded into a single
+        (B, sh, sw) bucket; B and n_ns are power-of-two padded.
+
+        Returns (canvases (n_ns_pad, H, W) f32 jax, valids bool jax) —
+        callers slice the first ``n_ns`` entries.
+        """
+        jobs = []
+        for wdw in windows:
+            sx, sy = self._dst_geo_coords(dst_gt, dst_crs, height, width,
+                                          wdw.src_crs)
+            col, row = wdw.window_gt.geo_to_pixel(sx, sy, np)
+            jobs.append((wdw, (row - 0.5).astype(np.float32),
+                         (col - 0.5).astype(np.float32)))
+        bh = _bucket(max(j[0].data.shape[0] for j in jobs))
+        bw = _bucket(max(j[0].data.shape[1] for j in jobs))
+        B = _bucket_pow2(len(jobs))
+        src = np.full((B, bh, bw), np.nan, np.float32)
+        coords = np.full((2, B, height, width), -1e6, np.float32)
+        meta = np.full((2, B), -1.0, np.float32)
+        for k, (wdw, rows, cols) in enumerate(jobs):
+            h, w = wdw.data.shape
+            src[k, :h, :w] = np.where(wdw.valid, wdw.data, np.nan)
+            coords[0, k] = rows
+            coords[1, k] = cols
+            meta[0, k] = prios[k]
+            meta[1, k] = ns_ids[k]
+        return warp_mosaic_batch(jnp.asarray(src), jnp.asarray(coords),
+                                 jnp.asarray(meta), method,
+                                 _bucket_pow2(n_ns))
 
 
 # module-level default executor (compile cache shared across requests)

@@ -72,6 +72,41 @@ class TilePipeline:
 
     # -- full render ---------------------------------------------------------
 
+    def _render_fused(self, req: GeoTileRequest,
+                      granules: List[Granule]) -> TileResult:
+        """Single-dispatch fast path (no mask band, local executor):
+        decode -> fused warp+per-namespace mosaic
+        (`ops.warp.warp_mosaic_batch`) -> expressions.  Minimises device
+        round trips: one upload set, one execution, results stay on
+        device until encode."""
+        exprs = req.band_exprs
+        H, W = req.height, req.width
+        ws = decode_all(granules, req.bbox, req.crs, req.resample,
+                        self.decode_workers)
+        live = [(g, w) for g, w in zip(granules, ws) if w is not None]
+        if not live:
+            return _empty_result(exprs, H, W)
+        ns_names: List[str] = []
+        ns_index: Dict[str, int] = {}
+        for g, _ in live:
+            if g.namespace not in ns_index:
+                ns_index[g.namespace] = len(ns_names)
+                ns_names.append(g.namespace)
+        ns_ids = [ns_index[g.namespace] for g, _ in live]
+        order = M.priority_order([g.timestamp for g, _ in live])
+        prio = [0.0] * len(live)
+        for rank, i in enumerate(order):
+            prio[i] = float(len(live) - rank)
+        canv, vals = self.executor.warp_mosaic(
+            [w for _, w in live], ns_ids, prio, req.dst_gt(), req.crs,
+            H, W, len(ns_names), req.resample)
+        data_env = {n: canv[i] for i, n in enumerate(ns_names)}
+        valid_env = {n: vals[i] for i, n in enumerate(ns_names)}
+        return evaluate_expressions(
+            exprs, data_env, valid_env, H, W,
+            granule_count=len(granules),
+            file_count=len({g.path for g in granules}))
+
     def process(self, req: GeoTileRequest) -> TileResult:
         granules = self.index(req)
         return self.render(req, granules)
@@ -83,6 +118,8 @@ class TilePipeline:
             return _empty_result(exprs, H, W)
 
         mask_id = req.mask.id if req.mask is not None else None
+        if mask_id is None and self.remote is None:
+            return self._render_fused(req, granules)
         # mask bands always resample nearest: interpolating bitfields is
         # meaningless (the reference's warp kernel is nearest-only anyway)
         is_mask = [mask_id is not None and g.base_namespace == mask_id
@@ -112,10 +149,11 @@ class TilePipeline:
                 continue
             data, ok = wr
             if mask_id is not None and g.base_namespace == mask_id:
-                excl = np.asarray(M.compute_bit_mask(
+                import jax.numpy as jnp
+                excl = M.compute_bit_mask(
                     _restore_int(data, g.array_type),
-                    req.mask.value or None, req.mask.bit_tests))
-                excl = np.where(ok, excl, False)
+                    req.mask.value or None, req.mask.bit_tests)
+                excl = jnp.where(jnp.asarray(ok), excl, False)
                 if req.mask.inclusive:
                     excl = ~excl & ok
                 prev = mask_by_stamp.get(g.timestamp)
@@ -135,7 +173,7 @@ class TilePipeline:
                 excl = mask_by_stamp.get(g.timestamp)
                 valids.append(ok & ~excl if excl is not None else ok)
             stamps = [g.timestamp for g, _, _ in items]
-            out, okm = M.mosaic_stack_host(rasters, valids, stamps)
+            out, okm = M.mosaic_stack(rasters, valids, stamps)
             data_env[ns] = out
             valid_env[ns] = okm
 
@@ -184,9 +222,11 @@ def evaluate_expressions(exprs: BandExpressions,
             out_data[name] = data_env[k].astype(np.float32)
             out_valid[name] = valid_env[k]
         else:
+            # stays on device: TileResult arrays are pulled to host only
+            # at encode time (one sync per response)
             o, ok = ce.eval_masked(env, venv)
-            out_data[name] = np.asarray(o, np.float32)
-            out_valid[name] = np.asarray(ok)
+            out_data[name] = o.astype(jnp.float32)
+            out_valid[name] = ok
         names.append(name)
 
     # axis-expanded outputs with no expression (`var#axis=value` pass

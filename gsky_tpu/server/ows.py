@@ -448,8 +448,9 @@ class OWSServer:
                                           lay, cfg, self)
             for n in ns_names:
                 if n in res.data:
-                    out[n][oy:oy + th, ox:ox + tw] = res.data[n]
-                    valid[n][oy:oy + th, ox:ox + tw] = res.valid[n]
+                    out[n][oy:oy + th, ox:ox + tw] = np.asarray(res.data[n])
+                    valid[n][oy:oy + th, ox:ox + tw] = \
+                        np.asarray(res.valid[n])
 
         await asyncio.wait_for(
             asyncio.gather(*(render_tile(*t) for t in tiles)),
@@ -587,10 +588,12 @@ def _render_with_fusion(pipe: TilePipeline, req: GeoTileRequest, lay: Layer,
             if n not in data_env:
                 data_env[n] = res.data[n]
                 valid_env[n] = res.valid[n]
-            else:  # later inputs fill holes
-                fill = ~valid_env[n] & res.valid[n]
-                data_env[n] = np.where(fill, res.data[n], data_env[n])
-                valid_env[n] = valid_env[n] | res.valid[n]
+            else:  # later inputs fill holes (device-resident)
+                fill = ~jnp.asarray(valid_env[n]) & jnp.asarray(res.valid[n])
+                data_env[n] = jnp.where(fill, jnp.asarray(res.data[n]),
+                                        jnp.asarray(data_env[n]))
+                valid_env[n] = jnp.asarray(valid_env[n]) \
+                    | jnp.asarray(res.valid[n])
     return evaluate_expressions(req.band_exprs, data_env, valid_env,
                                 req.height, req.width, total_granules,
                                 total_files)

@@ -104,7 +104,7 @@ class WorkerService:
                                      d.resample or "near")[0]
         if out is None:
             return res
-        pack_raster(res, out[0], out[1])
+        pack_raster(res, np.asarray(out[0]), np.asarray(out[1]))
         b = dst_gt.bbox(d.width, d.height)
         res.bbox.extend([b.xmin, b.ymin, b.xmax, b.ymax])
         res.dtype = "Float32"
