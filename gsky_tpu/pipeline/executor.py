@@ -21,7 +21,7 @@ import numpy as np
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
 from ..ops.warp import (warp_gather_batch, warp_mosaic_batch,
-                        warp_scenes_batch)
+                        warp_scenes_ctrl)
 from .decode import DecodedWindow
 
 # padded source-window shape buckets (H and W independently bucketed)
@@ -65,6 +65,35 @@ class WarpExecutor:
             return hit
         c = np.arange(width, dtype=np.float64) + 0.5
         r = np.arange(height, dtype=np.float64) + 0.5
+        C, R = np.meshgrid(c, r)
+        x, y = dst_gt.pixel_to_geo(C, R, np)
+        sx, sy = dst_crs.transform_to(src_crs, x, y, np)
+        sx = np.asarray(sx, np.float64)
+        sy = np.asarray(sy, np.float64)
+        with self._lock:
+            if len(self._geo_cache) > 256:
+                self._geo_cache.clear()
+            self._geo_cache[key] = (sx, sy)
+        return sx, sy
+
+    def _ctrl_geo_coords(self, dst_gt: GeoTransform, dst_crs: CRS,
+                         height: int, width: int, src_crs: CRS,
+                         step: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Sparse control-point grid: dst pixel centres at every
+        ``step``-th row/col projected into src CRS (f64, host).  The
+        dense grid is reconstructed on device (`ops.warp._bilerp_grid`),
+        GDAL-approx-transformer style, so only ~2 KB of coordinates are
+        uploaded per tile."""
+        key = ("ctrl", dst_gt.to_gdal(), dst_crs, height, width, src_crs,
+               step)
+        with self._lock:
+            hit = self._geo_cache.get(key)
+        if hit is not None:
+            return hit
+        gh = (height - 1 + step - 1) // step + 1
+        gw = (width - 1 + step - 1) // step + 1
+        c = np.arange(gw, dtype=np.float64) * step + 0.5
+        r = np.arange(gh, dtype=np.float64) * step + 0.5
         C, R = np.meshgrid(c, r)
         x, y = dst_gt.pixel_to_geo(C, R, np)
         sx, sy = dst_crs.transform_to(src_crs, x, y, np)
@@ -188,10 +217,11 @@ class WarpExecutor:
                or s.dtype != s0.dtype for s in scenes[1:]):
             return None
 
-        sx, sy = self._dst_geo_coords(dst_gt, dst_crs, height, width,
-                                      s0.crs)
+        step = 16
+        sx, sy = self._ctrl_geo_coords(dst_gt, dst_crs, height, width,
+                                       s0.crs, step)
         ox, oy = s0.gt.x0, s0.gt.y0
-        sxy = np.stack([sx - ox, sy - oy]).astype(np.float32)
+        ctrl = np.stack([sx - ox, sy - oy]).astype(np.float32)
 
         B = _bucket_pow2(len(scenes))
         params = np.zeros((B, 11), np.float64)
@@ -220,9 +250,10 @@ class WarpExecutor:
                 if len(self._stack_cache) > 32:
                     self._stack_cache.clear()
                 self._stack_cache[skey] = stack
-        return warp_scenes_batch(stack, jnp.asarray(sxy),
-                                 jnp.asarray(params.astype(np.float32)),
-                                 method, _bucket_pow2(n_ns))
+        return warp_scenes_ctrl(stack, jnp.asarray(ctrl),
+                                jnp.asarray(params.astype(np.float32)),
+                                method, _bucket_pow2(n_ns),
+                                (height, width), step)
 
 
 # module-level default executor (compile cache shared across requests)
