@@ -104,16 +104,17 @@ def main():
             start_time=t0, end_time=t1)
 
     def render(req):
-        res = pipe.process(req)
-        bands = [jnp.asarray(res.data[n]) for n in res.namespaces
-                 if n in res.data]
-        valids = [jnp.asarray(res.valid[n]) for n in res.namespaces
-                  if n in res.valid]
-        # cross-scene composite (first valid) + auto byte scale in one
-        # fused dispatch; the ONLY host pull per tile is the final uint8
-        # canvas feeding the PNG encoder
-        sb = compose_scale_byte(jnp.stack(bands), jnp.stack(valids),
-                                auto=True)
+        # one-dispatch path: index -> fused warp+mosaic+composite+scale
+        # on device -> single 64 KB pull feeding the PNG encoder
+        sb = pipe.render_composite_byte(req, auto=True)
+        if sb is None:  # fused path unavailable -> modular pipeline
+            res = pipe.process(req)
+            bands = [jnp.asarray(res.data[n]) for n in res.namespaces
+                     if n in res.data]
+            valids = [jnp.asarray(res.valid[n]) for n in res.namespaces
+                      if n in res.valid]
+            sb = compose_scale_byte(jnp.stack(bands), jnp.stack(valids),
+                                    auto=True)
         return encode_png([np.asarray(sb)], lut)
 
     reqs = [tile_req(i, j) for j in range(GRID) for i in range(GRID)]

@@ -296,6 +296,46 @@ def warp_scenes_ctrl(stack, ctrl, params, method: str = "near",
     return _warp_scenes_core(stack, sx, sy, params, method, n_ns)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "auto", "colour_scale"))
+def render_scenes_ctrl(stack, ctrl, params, scale_params,
+                       method: str = "near", n_ns: int = 1,
+                       out_hw: Tuple[int, int] = (256, 256),
+                       step: int = 16, auto: bool = True,
+                       colour_scale: int = 0):
+    """The WHOLE GetMap tile in one dispatch: control-grid coords ->
+    warp -> per-namespace newest-wins mosaic -> first-valid composite
+    across namespaces -> byte scaling.  Returns the PNG-ready uint8
+    (h, w) tile (255 = nodata), so a request costs three small uploads,
+    one execution and one 64 KB download — the shape that wins when
+    device round trips, not FLOPs, bound throughput.
+
+    scale_params: (3,) f32 [offset, scale, clip] (ignored when auto).
+    """
+    from .scale import auto_byte_scale, scale_to_byte
+    h, w = out_hw
+    sx = _bilerp_grid(ctrl[0], h, w, step)
+    sy = _bilerp_grid(ctrl[1], h, w, step)
+    canv, vals = _warp_scenes_core(stack, sx, sy, params, method, n_ns)
+    idx = jnp.argmax(vals, axis=0)
+    data = jnp.take_along_axis(canv, idx[None], axis=0)[0]
+    ok = jnp.any(vals, axis=0)
+    if auto:
+        if colour_scale == 1:
+            logged = jnp.log10(data)
+            bad = ~jnp.isfinite(logged)
+            data = jnp.where(bad, 0.0, logged)
+            ok = ok & ~bad
+        big = jnp.float32(3.4e38)
+        mn = jnp.min(jnp.where(ok, data, big))
+        mx = jnp.max(jnp.where(ok, data, -big))
+        return auto_byte_scale(data, ok, mn, mx, jnp.any(ok))
+    return scale_to_byte(data, ok, scale_params[0], scale_params[1],
+                         scale_params[2], colour_scale=colour_scale,
+                         auto=False)
+
+
 @functools.partial(jax.jit, static_argnames=("method", "n_ns"))
 def warp_scenes_batch(stack, sxy, params, method: str = "near",
                       n_ns: int = 1):

@@ -20,8 +20,8 @@ import numpy as np
 
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
-from ..ops.warp import (warp_gather_batch, warp_mosaic_batch,
-                        warp_scenes_ctrl)
+from ..ops.warp import (render_scenes_ctrl, warp_gather_batch,
+                        warp_mosaic_batch, warp_scenes_ctrl)
 from .decode import DecodedWindow
 
 # padded source-window shape buckets (H and W independently bucketed)
@@ -204,6 +204,36 @@ class WarpExecutor:
         set is not uniform enough (mixed CRS/dtype/bucket) or a scene is
         uncacheable — callers fall back to the window path.
         """
+        made = self._scene_inputs(granules, ns_ids, prios, dst_gt,
+                                  dst_crs, height, width, cache)
+        if made is None:
+            return None
+        stack, ctrl, params, step = made
+        return warp_scenes_ctrl(stack, ctrl, params, method,
+                                _bucket_pow2(n_ns), (height, width), step)
+
+    def render_byte_scenes(self, granules, ns_ids: Sequence[int],
+                           prios: Sequence[float], dst_gt: GeoTransform,
+                           dst_crs: CRS, height: int, width: int,
+                           n_ns: int, method: str = "near",
+                           offset: float = 0.0, scale: float = 0.0,
+                           clip: float = 0.0, colour_scale: int = 0,
+                           auto: bool = True, cache=None):
+        """Whole-tile fast path: one dispatch from cached scenes to the
+        PNG-ready uint8 composite (`ops.warp.render_scenes_ctrl`).
+        Returns a device uint8 (H, W) array or None (fallback)."""
+        made = self._scene_inputs(granules, ns_ids, prios, dst_gt,
+                                  dst_crs, height, width, cache)
+        if made is None:
+            return None
+        stack, ctrl, params, step = made
+        sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
+        return render_scenes_ctrl(stack, ctrl, params, sp, method,
+                                  _bucket_pow2(n_ns), (height, width),
+                                  step, auto, colour_scale)
+
+    def _scene_inputs(self, granules, ns_ids, prios, dst_gt, dst_crs,
+                      height, width, cache=None):
         from .scene_cache import default_scene_cache
         cache = cache or default_scene_cache
         scenes = []
@@ -250,10 +280,8 @@ class WarpExecutor:
                 if len(self._stack_cache) > 32:
                     self._stack_cache.clear()
                 self._stack_cache[skey] = stack
-        return warp_scenes_ctrl(stack, jnp.asarray(ctrl),
-                                jnp.asarray(params.astype(np.float32)),
-                                method, _bucket_pow2(n_ns),
-                                (height, width), step)
+        return (stack, jnp.asarray(ctrl),
+                jnp.asarray(params.astype(np.float32)), step)
 
 
 # module-level default executor (compile cache shared across requests)

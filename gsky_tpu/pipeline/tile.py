@@ -119,6 +119,41 @@ class TilePipeline:
             granule_count=len(granules),
             file_count=len({g.path for g in granules}))
 
+    def render_composite_byte(self, req: GeoTileRequest,
+                              offset: float = 0.0, scale: float = 0.0,
+                              clip: float = 0.0, colour_scale: int = 0,
+                              auto: bool = True):
+        """One-dispatch GetMap: index -> fused scene warp + mosaic +
+        first-valid composite + byte scaling on device; returns the
+        PNG-ready uint8 (H, W) jax array (255 = nodata), or None when
+        the request doesn't qualify for the fused path (mask band,
+        remote workers, non-trivial band expressions, uncacheable
+        scenes) — callers then use `process()` + `ops.scale`.
+        """
+        if self.remote is not None or req.mask is not None:
+            return None
+        exprs = req.band_exprs
+        if any(ce._ast[0] != "var" for ce in exprs.expressions):
+            return None
+        granules = self.index(req)
+        if not granules:
+            return None
+        ns_names: List[str] = []
+        ns_index: Dict[str, int] = {}
+        for g in granules:
+            if g.namespace not in ns_index:
+                ns_index[g.namespace] = len(ns_names)
+                ns_names.append(g.namespace)
+        ns_ids = [ns_index[g.namespace] for g in granules]
+        order = M.priority_order([g.timestamp for g in granules])
+        prio = [0.0] * len(granules)
+        for rank, i in enumerate(order):
+            prio[i] = float(len(granules) - rank)
+        return self.executor.render_byte_scenes(
+            granules, ns_ids, prio, req.dst_gt(), req.crs,
+            req.height, req.width, len(ns_names), req.resample,
+            offset, scale, clip, colour_scale, auto)
+
     def process(self, req: GeoTileRequest) -> TileResult:
         granules = self.index(req)
         return self.render(req, granules)
