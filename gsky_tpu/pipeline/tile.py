@@ -122,7 +122,8 @@ class TilePipeline:
     def render_composite_byte(self, req: GeoTileRequest,
                               offset: float = 0.0, scale: float = 0.0,
                               clip: float = 0.0, colour_scale: int = 0,
-                              auto: bool = True):
+                              auto: bool = True,
+                              stats: Optional[Dict[str, int]] = None):
         """One-dispatch GetMap: index -> fused scene warp + mosaic +
         first-valid composite + byte scaling on device; returns the
         PNG-ready uint8 (H, W) jax array (255 = nodata), or None when
@@ -138,6 +139,9 @@ class TilePipeline:
         granules = self.index(req)
         if not granules:
             return None
+        if stats is not None:
+            stats["granules"] = len(granules)
+            stats["files"] = len({g.path for g in granules})
         ns_names: List[str] = []
         ns_index: Dict[str, int] = {}
         for g in granules:
