@@ -18,6 +18,7 @@ import asyncio
 import datetime as dt
 import io
 import json
+import logging
 import math
 import os
 import tempfile
@@ -35,7 +36,7 @@ from ..geo.transform import (BBox, GeoTransform, pixel_resolution, split_bbox,
 from ..geo import geometry as geom
 from ..index.client import MASClient
 from ..index.store import fmt_time, parse_time
-from ..io.geotiff import write_geotiff
+from ..io.geotiff import GeoTIFF, write_geotiff
 from ..io.netcdf import write_netcdf3
 from ..io.png import empty_tile_png, encode_jpeg, encode_png
 from ..ops.palette import gradient_palette, with_nodata_entry
@@ -48,6 +49,8 @@ from ..pipeline.feature_info import get_feature_info
 from ..pipeline.types import AxisSelector, MaskSpec
 from . import dap4
 from . import templates as T
+
+log = logging.getLogger("gsky.ows")
 from .config import Config, ConfigWatcher, Layer
 from .metrics import MetricsLogger
 from .params import (OWSError, infer_service, normalise_query, parse_wcs,
@@ -411,11 +414,14 @@ class OWSServer:
                 raise OWSError("coverage not found", "CoverageNotDefined")
             return _xml(T.wcs_describe_coverage(layers, host))
         if req_name == "getcoverage":
-            return await self._getcoverage(cfg, p, collector)
+            return await self._getcoverage(
+                cfg, p, collector, q=q, path=request.path,
+                is_shard=bool(q.get("wshard")))
         raise OWSError(f"WCS request {p.request!r} not supported",
                        "OperationNotSupported")
 
-    async def _getcoverage(self, cfg: Config, p, collector):
+    async def _getcoverage(self, cfg: Config, p, collector, q=None,
+                           path: str = "/ows", is_shard: bool = False):
         if not p.coverages:
             raise OWSError("no coverage requested", "CoverageNotDefined")
         lay, style = self._resolve_layer(cfg, p.coverages[0], p.styles,
@@ -472,11 +478,77 @@ class OWSServer:
                     valid[n][oy:oy + th, ox:ox + tw] = \
                         np.asarray(res.valid[n])
 
-        await asyncio.wait_for(
-            asyncio.gather(*(render_tile(*t) for t in tiles)),
-            timeout=lay.wcs_timeout * max(1, len(tiles)))
-
         nodata = -9999.0
+        # OWS-cluster scale-out (`ows.go:835-872,930-995,1094-1150`):
+        # partition the output into contiguous tile-row bands, render
+        # band 0 locally and re-enter GetCoverage on peer nodes for the
+        # rest (wshard=1 guards recursion); peer GeoTIFFs merge into the
+        # master canvas, and a failed peer's band falls back to local
+        # rendering.
+        nodes = cfg.service_config.ows_cluster_nodes
+        local_tiles = list(tiles)
+        remote_jobs = []
+        if q is not None and not is_shard and len(nodes) > 1 \
+                and len(tiles) >= 2 * len(nodes):
+            row_starts = sorted({t[2] for t in tiles})
+            per = max(1, -(-len(row_starts) // len(nodes)))
+            groups = [row_starts[i * per:(i + 1) * per]
+                      for i in range(len(nodes))]
+            local_rows = set(groups[0])
+            local_tiles = [t for t in tiles if t[2] in local_rows]
+            resy = (p.bbox.ymax - p.bbox.ymin) / height
+            for node, grp in zip(nodes[1:], groups[1:]):
+                if not grp:
+                    continue
+                tiles_in = [t for t in tiles if t[2] in set(grp)]
+                y0px = grp[0]
+                y1px = max(t[2] + t[4] for t in tiles_in)
+                bb = BBox(p.bbox.xmin, p.bbox.ymax - y1px * resy,
+                          p.bbox.xmax, p.bbox.ymax - y0px * resy)
+                remote_jobs.append((node, tiles_in, bb, y0px, y1px))
+
+        async def fetch_shard(node, tiles_in, bb, y0px, y1px):
+            try:
+                import aiohttp
+                params = {k: str(v) for k, v in q.items()}
+                params.update({
+                    "service": "WCS", "request": "GetCoverage",
+                    "bbox": f"{bb.xmin},{bb.ymin},{bb.xmax},{bb.ymax}",
+                    "width": str(width), "height": str(y1px - y0px),
+                    "format": "geotiff", "wshard": "1"})
+                url = node if "://" in node else f"http://{node}"
+                url = url.rstrip("/") + path
+                tmo = aiohttp.ClientTimeout(
+                    total=lay.wcs_timeout * max(1, len(tiles_in)))
+                async with aiohttp.ClientSession(timeout=tmo) as s:
+                    async with s.get(url, params=params) as resp:
+                        if resp.status != 200:
+                            raise RuntimeError(
+                                f"shard node {node}: HTTP {resp.status}")
+                        body = await resp.read()
+                spath = os.path.join(
+                    self.temp_dir, f"shard_{y0px}_{id(bb)}.tif")
+                with open(spath, "wb") as fp:
+                    fp.write(body)
+                try:
+                    tif = GeoTIFF(spath)
+                    for bi, n in enumerate(ns_names):
+                        a = np.asarray(tif.read(bi + 1), np.float32)
+                        v = a != nodata
+                        out[n][y0px:y1px, :] = a
+                        valid[n][y0px:y1px, :] = v
+                    tif.close()
+                finally:
+                    os.remove(spath)
+            except Exception:
+                log.exception("WCS shard via %s failed; rendering locally",
+                              node)
+                await asyncio.gather(*(render_tile(*t) for t in tiles_in))
+
+        await asyncio.wait_for(
+            asyncio.gather(*(render_tile(*t) for t in local_tiles),
+                           *(fetch_shard(*j) for j in remote_jobs)),
+            timeout=lay.wcs_timeout * max(1, len(tiles)))
         arrays = {}
         for n in ns_names:
             a = out[n].copy()
