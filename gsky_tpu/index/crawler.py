@@ -158,6 +158,131 @@ def extract_netcdf(path: str, approx_stats: bool = False) -> Dict:
     return {"filename": path, "file_type": "NetCDF", "geo_metadata": geo_md}
 
 
+# ---------------------------------------------------------------------------
+# eo-datasets YAML extractors (`crawl/extractor/info_yaml.go:53-250`)
+# ---------------------------------------------------------------------------
+
+# ARD band storage types (`info_yaml.go:getBandDataType`), expressed as
+# rules rather than the reference's 40-case switch
+_ARD_FLOAT_BANDS = {
+    "solar_zenith", "solar_azimuth", "satellite_azimuth", "satellite_view",
+    "relative_slope", "relative_azimuth", "timedelta", "exiting",
+    "incident", "azimuthal_exiting", "azimuthal_incident",
+}
+
+
+def _ard_band_dtype(ns: str) -> str:
+    if ns.endswith("_contiguity") or ns in ("fmask", "terrain_shadow"):
+        return "Byte"
+    if ns.startswith(("nbar_", "nbart_")):
+        return "Int16"
+    if ns in _ARD_FLOAT_BANDS:
+        return "Float32"
+    return "Byte"
+
+
+def _yaml_srs(srs: str) -> Dict[str, str]:
+    """proj_wkt/proj4 for a YAML spatial reference (EPSG code or WKT)."""
+    try:
+        from ..geo.crs import parse_crs
+        crs = parse_crs(srs)
+        return {"proj_wkt": crs.to_wkt(), "proj4": crs.to_proj4()}
+    except Exception:
+        # keep the raw string: MAS only round-trips it to workers
+        return {"proj_wkt": srs, "proj4": ""}
+
+
+def _coords_to_wkt(rings) -> str:
+    pts = ", ".join(f"{float(c[0])} {float(c[1])}" for c in rings[0])
+    return f"POLYGON (({pts}))"
+
+
+def _parse_yaml_time(s: str) -> Optional[str]:
+    s = s.strip().replace(" ", "T").rstrip("Z")
+    if "." in s:
+        s = s.split(".")[0]
+    try:
+        d = dt.datetime.fromisoformat(s).replace(tzinfo=dt.timezone.utc)
+        return d.strftime(ISO)
+    except ValueError:
+        return None
+
+
+def extract_sentinel2_yaml(path: str) -> Dict:
+    """eo-datasets ARD YAML (`info_yaml.go:63-158`): per-band granule
+    paths + geotransforms under ``image.bands``, footprint under
+    ``grid_spatial.projection.valid_data``."""
+    import yaml
+    with open(path) as fp:
+        md = yaml.safe_load(fp)
+    base = os.path.dirname(os.path.abspath(path))
+    ts = _parse_yaml_time(str(md["extent"]["center_dt"]))
+    proj = md["grid_spatial"]["projection"]
+    srs = _yaml_srs(str(proj["spatial_reference"]))
+    polygon = _coords_to_wkt(proj["valid_data"]["coordinates"])
+    geo_md = []
+    for ns, band in (md.get("image", {}).get("bands") or {}).items():
+        info = band.get("info") or {}
+        geo_md.append({
+            "ds_name": os.path.join(base, band["path"]),
+            "namespace": sanitize_namespace(ns),
+            "array_type": _ard_band_dtype(ns),
+            "geotransform": [float(v) for v in
+                             (info.get("geotransform") or [0] * 6)],
+            "x_size": int(info.get("width") or 0),
+            "y_size": int(info.get("height") or 0),
+            "polygon": polygon,
+            "timestamps": [ts] if ts else [],
+            "band": 1,
+            **srs,
+        })
+    return {"filename": os.path.abspath(path),
+            "file_type": str((md.get("format") or {}).get("name") or ""),
+            "geo_metadata": geo_md}
+
+
+def extract_landsat_yaml(path: str) -> Dict:
+    """eo-datasets Landsat YAML (`info_yaml.go:160-250`): band paths
+    under ``measurements``, footprint under ``geometry``, timestamp
+    under ``properties.datetime``."""
+    import yaml
+    with open(path) as fp:
+        md = yaml.safe_load(fp)
+    base = os.path.dirname(os.path.abspath(path))
+    srs = _yaml_srs(str(md.get("crs") or ""))
+    polygon = ""
+    if md.get("geometry"):
+        polygon = _coords_to_wkt(md["geometry"]["coordinates"])
+    ts = None
+    props = md.get("properties") or {}
+    if props.get("datetime"):
+        ts = _parse_yaml_time(str(props["datetime"]))
+    geo_md = []
+    for ns, m in (md.get("measurements") or {}).items():
+        geo_md.append({
+            "ds_name": os.path.join(base, m["path"]),
+            "namespace": sanitize_namespace(ns),
+            "array_type": "Int16",
+            "geotransform": [0.0] * 6,
+            "x_size": 0,
+            "y_size": 0,
+            "polygon": polygon,
+            "timestamps": [ts] if ts else [],
+            "band": 1,
+            **srs,
+        })
+    return {"filename": os.path.abspath(path), "file_type": "GTiff",
+            "geo_metadata": geo_md}
+
+
+def extract_yaml(path: str, family: str) -> Dict:
+    if family == "sentinel2":
+        return extract_sentinel2_yaml(path)
+    if family == "landsat":
+        return extract_landsat_yaml(path)
+    raise ValueError(f"unsupported yaml family: {family}")
+
+
 def extract(path: str, approx_stats: bool = False) -> Dict:
     path = os.path.abspath(path)  # MAS scopes queries by path prefix
     low = path.lower()
@@ -189,6 +314,10 @@ def main(argv=None):
                     help="compute approximate band statistics")
     ap.add_argument("-fmt", choices=("json", "tsv"), default="tsv",
                     help="output format (tsv matches crawl_pipeline.sh)")
+    ap.add_argument("-sentinel2_yaml", default="",
+                    help="glob matching Sentinel-2 eo-datasets YAML files")
+    ap.add_argument("-landsat_yaml", default="",
+                    help="glob matching Landsat eo-datasets YAML files")
     args = ap.parse_args(argv)
 
     paths: List[str] = []
@@ -196,17 +325,35 @@ def main(argv=None):
         if p == "-":
             paths += [line.strip() for line in sys.stdin if line.strip()]
         elif os.path.isdir(p):
+            exts = [".tif", ".tiff", ".nc", ".nc4"]
+            if args.sentinel2_yaml or args.landsat_yaml:
+                exts += [".yaml", ".yml"]
             for root, _, files in os.walk(p):
                 paths += [os.path.join(root, f) for f in files
-                          if f.lower().endswith((".tif", ".tiff", ".nc",
-                                                 ".nc4"))]
+                          if f.lower().endswith(tuple(exts))]
         else:
             paths.append(p)
     if not paths:
         ap.error("no input files")
 
+    import fnmatch
+
+    def run_one(p: str) -> Dict:
+        base = os.path.basename(p)
+        try:
+            if args.sentinel2_yaml and fnmatch.fnmatch(
+                    base, args.sentinel2_yaml):
+                return extract_yaml(p, "sentinel2")
+            if args.landsat_yaml and fnmatch.fnmatch(
+                    base, args.landsat_yaml):
+                return extract_yaml(p, "landsat")
+        except Exception as e:
+            return {"filename": os.path.abspath(p), "file_type": "",
+                    "error": str(e), "geo_metadata": []}
+        return extract(p, args.approx)
+
     with cf.ThreadPoolExecutor(args.conc) as ex:
-        for rec in ex.map(lambda p: extract(p, args.approx), paths):
+        for rec in ex.map(run_one, paths):
             if args.fmt == "tsv":
                 sys.stdout.write(
                     f"{rec['filename']}\tgdal\t{json.dumps(rec)}\n")

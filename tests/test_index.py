@@ -211,3 +211,97 @@ class TestClientFacade:
         n = ingest_file(store, p)
         assert n == 1
         assert store.list_files() == [rec["filename"]]
+
+
+class TestYamlExtractors:
+    """eo-datasets YAML crawl (`crawl/extractor/info_yaml.go:53-250`)."""
+
+    S2_YAML = """
+format:
+  name: GeoTIFF
+extent:
+  center_dt: 2020-01-10T00:05:18Z
+grid_spatial:
+  projection:
+    spatial_reference: EPSG:32755
+    valid_data:
+      coordinates:
+        - - ["600000", "6100000"]
+          - ["650000", "6100000"]
+          - ["650000", "6050000"]
+          - ["600000", "6050000"]
+          - ["600000", "6100000"]
+image:
+  bands:
+    nbart_red:
+      path: band04.tif
+      info:
+        geotransform: [600000, 10, 0, 6100000, 0, -10]
+        width: 5000
+        height: 5000
+    fmask:
+      path: qa/fmask.tif
+      info:
+        geotransform: [600000, 20, 0, 6100000, 0, -20]
+        width: 2500
+        height: 2500
+"""
+
+    LS_YAML = """
+crs: EPSG:32655
+geometry:
+  coordinates:
+    - - [600000.0, 6100000.0]
+      - [650000.0, 6100000.0]
+      - [650000.0, 6050000.0]
+      - [600000.0, 6050000.0]
+      - [600000.0, 6100000.0]
+properties:
+  datetime: 2020-01-10 00:05:18.500000
+measurements:
+  red:
+    path: LC08_B4.TIF
+  nir:
+    path: LC08_B5.TIF
+"""
+
+    def test_sentinel2(self, tmp_path):
+        from gsky_tpu.index.crawler import extract_yaml
+        p = tmp_path / "ARD-METADATA.yaml"
+        p.write_text(self.S2_YAML)
+        rec = extract_yaml(str(p), "sentinel2")
+        assert rec["file_type"] == "GeoTIFF"
+        by_ns = {d["namespace"]: d for d in rec["geo_metadata"]}
+        assert set(by_ns) == {"nbart_red", "fmask"}
+        red = by_ns["nbart_red"]
+        assert red["array_type"] == "Int16"
+        assert by_ns["fmask"]["array_type"] == "Byte"
+        assert red["ds_name"] == str(tmp_path / "band04.tif")
+        assert by_ns["fmask"]["ds_name"] == str(tmp_path / "qa/fmask.tif")
+        assert red["geotransform"] == [600000, 10, 0, 6100000, 0, -10]
+        assert red["x_size"] == 5000
+        assert red["timestamps"] == ["2020-01-10T00:05:18.000Z"]
+        assert red["polygon"].startswith("POLYGON ((600000")
+        assert "32755" in red["proj_wkt"] or "UTM" in red["proj_wkt"]
+
+    def test_landsat(self, tmp_path):
+        from gsky_tpu.index.crawler import extract_yaml
+        p = tmp_path / "LC08_odc-metadata.yaml"
+        p.write_text(self.LS_YAML)
+        rec = extract_yaml(str(p), "landsat")
+        by_ns = {d["namespace"]: d for d in rec["geo_metadata"]}
+        assert set(by_ns) == {"red", "nir"}
+        assert by_ns["red"]["array_type"] == "Int16"
+        assert by_ns["red"]["ds_name"] == str(tmp_path / "LC08_B4.TIF")
+        assert by_ns["red"]["timestamps"] == ["2020-01-10T00:05:18.000Z"]
+        assert by_ns["nir"]["polygon"].startswith("POLYGON ((600000")
+
+    def test_cli_dispatch(self, tmp_path, capsys):
+        from gsky_tpu.index.crawler import main
+        p = tmp_path / "ARD-METADATA.yaml"
+        p.write_text(self.S2_YAML)
+        assert main(["-fmt", "json", "-sentinel2_yaml", "ARD-*.yaml",
+                     str(p)]) == 0
+        rec = json.loads(capsys.readouterr().out.strip())
+        assert {d["namespace"] for d in rec["geo_metadata"]} == \
+            {"nbart_red", "fmask"}
