@@ -131,6 +131,10 @@ def mosaic_stack(rasters, nodata_masks, timestamps,
         w = np.zeros(Tp, np.float32)
         w[:T] = [weights[i] for i in order]
         return mosaic_weighted(stack, valid, jnp.asarray(w))
+    if stack.ndim == 3:
+        from .pallas_tpu import mosaic_first_valid_pallas, use_pallas
+        if use_pallas():
+            return mosaic_first_valid_pallas(stack, valid)
     return mosaic_first_valid(stack, valid)
 
 

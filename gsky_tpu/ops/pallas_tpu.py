@@ -1,0 +1,162 @@
+"""Pallas TPU kernels for the bandwidth-bound reduction hot ops.
+
+Two of the pipeline's hot loops are pure streaming reductions — the
+temporal mosaic (`processor/tile_merger.go:38-225`) and the drill masked
+statistics (`worker/gdalprocess/drill.go:128-220`).  XLA already fuses
+these well, but hand-tiled Pallas kernels keep every intermediate in
+VMEM (no materialised `where` temporaries in HBM) and give explicit
+control over block shapes, which matters once granule stacks grow to
+hundreds of timesteps:
+
+- `mosaic_first_valid_pallas`: first-valid-wins scan over the (priority
+  sorted) granule axis, one VMEM-resident spatial block at a time.
+- `masked_stats_pallas`: per-band masked + clipped sum/count over the
+  flattened polygon window, accumulated across pixel chunks in VMEM.
+
+Both match their XLA counterparts bit-for-bit (see
+`tests/test_pallas.py`, which runs them in interpreter mode on CPU);
+`use_pallas()` gates dispatch to real TPU backends (ops fall back to the
+jnp implementations elsewhere).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent on some CPU-only installs)
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAVE_PLTPU = False
+
+# spatial block for the mosaic scan (f32 min tile is (8, 128))
+_BLK_H = 128
+_BLK_W = 128
+# pixel chunk for the stats accumulation
+_CHUNK = 2048
+
+
+def use_pallas() -> bool:
+    """True when the pallas kernels should run for real (TPU backend and
+    not disabled via GSKY_PALLAS=0)."""
+    if os.environ.get("GSKY_PALLAS", "1") == "0" or not _HAVE_PLTPU:
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
+# ---------------------------------------------------------------------------
+# mosaic: first valid along the (priority-sorted) granule axis
+# ---------------------------------------------------------------------------
+
+def _mosaic_kernel(stack_ref, valid_ref, out_ref, ok_ref):
+    # T is a static block dim -> unrolled scan (dynamic leading-axis
+    # indexing inside fori_loop trips the Mosaic compiler on v5e)
+    T = stack_ref.shape[0]
+    out = jnp.zeros(out_ref.shape, out_ref.dtype)
+    done = jnp.zeros(out_ref.shape, jnp.bool_)
+    for t in range(T):
+        x = stack_ref[t]
+        v = valid_ref[t] != 0
+        take = v & ~done
+        out = jnp.where(take, x, out)
+        done = done | v
+    out_ref[:] = out
+    ok_ref[:] = done.astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def mosaic_first_valid_pallas(stack, valid, interpret: bool = False):
+    """stack (T, H, W) f32 in priority order, valid (T, H, W) bool/int8.
+    Returns (out (H, W) f32, ok (H, W) bool) — same contract as
+    `ops.mosaic.mosaic_first_valid` for 2D canvases.  H and W are padded
+    to block multiples internally."""
+    T, H, W = stack.shape
+    Hp = -(-H // _BLK_H) * _BLK_H
+    Wp = -(-W // _BLK_W) * _BLK_W
+    stack = jnp.pad(stack.astype(jnp.float32),
+                    ((0, 0), (0, Hp - H), (0, Wp - W)))
+    valid8 = jnp.pad(valid.astype(jnp.int8),
+                     ((0, 0), (0, Hp - H), (0, Wp - W)))
+    grid = (Hp // _BLK_H, Wp // _BLK_W)
+    out, ok = pl.pallas_call(
+        _mosaic_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((T, _BLK_H, _BLK_W), lambda i, j: (0, i, j)),
+            pl.BlockSpec((T, _BLK_H, _BLK_W), lambda i, j: (0, i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLK_H, _BLK_W), lambda i, j: (i, j)),
+            pl.BlockSpec((_BLK_H, _BLK_W), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Hp, Wp), jnp.float32),
+            jax.ShapeDtypeStruct((Hp, Wp), jnp.int8),
+        ],
+        interpret=interpret,
+    )(stack, valid8)
+    return out[:H, :W], ok[:H, :W] != 0
+
+
+# ---------------------------------------------------------------------------
+# drill: masked + clipped per-band sum/count
+# ---------------------------------------------------------------------------
+
+def _stats_kernel(data_ref, valid_ref, clip_ref, sum_ref, cnt_ref):
+    j = pl.program_id(0)
+    x = data_ref[:]
+    v = valid_ref[:] != 0
+    inclip = v & (x >= clip_ref[0]) & (x <= clip_ref[1])
+
+    @pl.when(j == 0)
+    def _init():
+        sum_ref[:] = jnp.zeros(sum_ref.shape, sum_ref.dtype)
+        cnt_ref[:] = jnp.zeros(cnt_ref.shape, cnt_ref.dtype)
+
+    sum_ref[:] += jnp.where(inclip, x, 0.0)
+    cnt_ref[:] += inclip.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_stats_pallas(data, valid, clip_lower=-3.0e38, clip_upper=3.0e38,
+                        interpret: bool = False):
+    """data (B, N) f32, valid (B, N) bool -> (sums (B,), counts (B,)) of
+    valid pixels within [clip_lower, clip_upper].  The pixel axis is
+    streamed through VMEM in chunks; the (B, chunk) partial accumulator
+    is reduced at the end (one tiny XLA sum)."""
+    B, N = data.shape
+    Np = -(-N // _CHUNK) * _CHUNK
+    data = jnp.pad(data.astype(jnp.float32), ((0, 0), (0, Np - N)))
+    valid8 = jnp.pad(valid.astype(jnp.int8), ((0, 0), (0, Np - N)))
+    clip = jnp.asarray([clip_lower, clip_upper], jnp.float32)
+    psum, pcnt = pl.pallas_call(
+        _stats_kernel,
+        grid=(Np // _CHUNK,),
+        in_specs=[
+            pl.BlockSpec((B, _CHUNK), lambda j: (0, j)),
+            pl.BlockSpec((B, _CHUNK), lambda j: (0, j)),
+            pl.BlockSpec(memory_space=getattr(pltpu, "SMEM", None))
+            if _HAVE_PLTPU and not interpret else
+            pl.BlockSpec((2,), lambda j: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((B, _CHUNK), lambda j: (0, 0)),
+            pl.BlockSpec((B, _CHUNK), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, _CHUNK), jnp.float32),
+            jax.ShapeDtypeStruct((B, _CHUNK), jnp.int32),
+        ],
+        interpret=interpret,
+    )(data, valid8, clip)
+    return jnp.sum(psum, axis=-1), jnp.sum(pcnt, axis=-1)
