@@ -318,6 +318,11 @@ def main(argv=None):
                     help="glob matching Sentinel-2 eo-datasets YAML files")
     ap.add_argument("-landsat_yaml", default="",
                     help="glob matching Landsat eo-datasets YAML files")
+    ap.add_argument("-rpc", default="",
+                    help="comma-separated worker addresses: extract via "
+                         "the workers' 'info' op instead of in-process "
+                         "(the online info pipeline, "
+                         "processor/info_pipeline.go)")
     args = ap.parse_args(argv)
 
     paths: List[str] = []
@@ -338,6 +343,11 @@ def main(argv=None):
 
     import fnmatch
 
+    rpc_client = None
+    if args.rpc:
+        from ..worker.client import WorkerClient
+        rpc_client = WorkerClient(args.rpc.split(","))
+
     def run_one(p: str) -> Dict:
         base = os.path.basename(p)
         try:
@@ -347,6 +357,8 @@ def main(argv=None):
             if args.landsat_yaml and fnmatch.fnmatch(
                     base, args.landsat_yaml):
                 return extract_yaml(p, "landsat")
+            if rpc_client is not None:
+                return json.loads(rpc_client.info(os.path.abspath(p)))
         except Exception as e:
             return {"filename": os.path.abspath(p), "file_type": "",
                     "error": str(e), "geo_metadata": []}

@@ -275,3 +275,14 @@ def test_grpc_extent_op(grpc_worker, archive):
     w, h = c.extent(g, EPSG3857)
     assert w > 0 and h > 0
     c.close()
+
+
+def test_crawler_rpc_mode(grpc_worker, archive, capsys):
+    """The online info pipeline (`processor/info_pipeline.go`): crawl
+    extraction routed through the workers' 'info' op."""
+    from gsky_tpu.index.crawler import main
+    tif = next(p for p in archive["paths"] if p.endswith(".tif"))
+    assert main(["-fmt", "json", "-rpc", grpc_worker, tif]) == 0
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["filename"] == tif
+    assert rec["geo_metadata"]
