@@ -49,6 +49,9 @@ class MetricsCollector:
                         "num_files": 0, "num_granules": 0},
             "rpc": {"duration": 0, "num_tiled_granules": 0,
                     "bytes_read": 0, "user_time": 0, "sys_time": 0},
+            # beyond the reference schema (SURVEY §5.1): time spent
+            # blocked on the accelerator result, and the jax platform
+            "device": {"duration": 0, "platform": ""},
         }
 
     def set_url(self, raw_url: str, path: str, query: Dict[str, str]):

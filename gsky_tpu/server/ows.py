@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import datetime as dt
+import functools
 import io
 import json
 import logging
@@ -51,6 +52,12 @@ from . import dap4
 from . import templates as T
 
 log = logging.getLogger("gsky.ows")
+
+
+@functools.lru_cache(maxsize=1)
+def _jax_platform() -> str:
+    import jax
+    return jax.default_backend()
 from .config import Config, ConfigWatcher, Layer
 from .metrics import MetricsLogger
 from .params import (OWSError, infer_service, normalise_query, parse_wcs,
@@ -293,7 +300,11 @@ class OWSServer:
                                   auto, stats),
                 timeout=lay.wms_timeout)
             if sb is not None:
-                scaled = [np.asarray(sb)]
+                td = time.time()
+                scaled = [np.asarray(sb)]  # the one device pull
+                collector.info["device"]["duration"] = \
+                    int((time.time() - td) * 1e9)
+                collector.info["device"]["platform"] = _jax_platform()
                 collector.info["indexer"]["num_granules"] = \
                     stats.get("granules", 0)
                 collector.info["indexer"]["num_files"] = \
