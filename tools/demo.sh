@@ -65,7 +65,7 @@ $PY -m gsky_tpu.worker.server -p 11429 &
 sleep 2
 
 echo "[demo] starting gsky-ows :8080 (conf $DEMO/conf)"
-$PY -m gsky_tpu.server.main -port 8080 -conf "$DEMO/conf" &
+$PY -m gsky_tpu.server.main -port 8080 -conf "$DEMO/conf" -static "$ROOT/static" &
 sleep 3
 
 echo "[demo] waiting for gsky-ows to come up"
