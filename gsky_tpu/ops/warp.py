@@ -296,23 +296,9 @@ def warp_scenes_ctrl(stack, ctrl, params, method: str = "near",
     return _warp_scenes_core(stack, sx, sy, params, method, n_ns)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("method", "n_ns", "out_hw", "step",
-                                    "auto", "colour_scale"))
-def render_scenes_ctrl(stack, ctrl, params, scale_params,
-                       method: str = "near", n_ns: int = 1,
-                       out_hw: Tuple[int, int] = (256, 256),
-                       step: int = 16, auto: bool = True,
-                       colour_scale: int = 0):
-    """The WHOLE GetMap tile in one dispatch: control-grid coords ->
-    warp -> per-namespace newest-wins mosaic -> first-valid composite
-    across namespaces -> byte scaling.  Returns the PNG-ready uint8
-    (h, w) tile (255 = nodata), so a request costs three small uploads,
-    one execution and one 64 KB download — the shape that wins when
-    device round trips, not FLOPs, bound throughput.
-
-    scale_params: (3,) f32 [offset, scale, clip] (ignored when auto).
-    """
+def _render_scenes_core(stack, ctrl, params, scale_params, method: str,
+                        n_ns: int, out_hw: Tuple[int, int], step: int,
+                        auto: bool, colour_scale: int):
     from .scale import auto_byte_scale, scale_to_byte
     h, w = out_hw
     sx = _bilerp_grid(ctrl[0], h, w, step)
@@ -334,6 +320,46 @@ def render_scenes_ctrl(stack, ctrl, params, scale_params,
     return scale_to_byte(data, ok, scale_params[0], scale_params[1],
                          scale_params[2], colour_scale=colour_scale,
                          auto=False)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "auto", "colour_scale"))
+def render_scenes_ctrl(stack, ctrl, params, scale_params,
+                       method: str = "near", n_ns: int = 1,
+                       out_hw: Tuple[int, int] = (256, 256),
+                       step: int = 16, auto: bool = True,
+                       colour_scale: int = 0):
+    """The WHOLE GetMap tile in one dispatch: control-grid coords ->
+    warp -> per-namespace newest-wins mosaic -> first-valid composite
+    across namespaces -> byte scaling.  Returns the PNG-ready uint8
+    (h, w) tile (255 = nodata), so a request costs three small uploads,
+    one execution and one 64 KB download — the shape that wins when
+    device round trips, not FLOPs, bound throughput.
+
+    scale_params: (3,) f32 [offset, scale, clip] (ignored when auto).
+    """
+    return _render_scenes_core(stack, ctrl, params, scale_params, method,
+                               n_ns, out_hw, step, auto, colour_scale)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "auto", "colour_scale"))
+def render_scenes_ctrl_many(stack, ctrls, params, scale_params,
+                            method: str = "near", n_ns: int = 1,
+                            out_hw: Tuple[int, int] = (256, 256),
+                            step: int = 16, auto: bool = True,
+                            colour_scale: int = 0):
+    """N whole GetMap tiles over one SHARED scene stack in one dispatch
+    (`pipeline.batcher.RenderBatcher` coalesces concurrent requests):
+    ctrls (N, 2, gh, gw), params (N, B, 11), scale_params (N, 3) ->
+    uint8 (N, h, w).  The device-stream round trips that bound
+    single-tile throughput are amortised N ways."""
+    return jax.vmap(
+        lambda c, p, sp: _render_scenes_core(
+            stack, c, p, sp, method, n_ns, out_hw, step, auto,
+            colour_scale))(ctrls, params, scale_params)
 
 
 @functools.partial(jax.jit, static_argnames=("method", "n_ns"))

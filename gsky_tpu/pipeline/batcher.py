@@ -1,0 +1,106 @@
+"""Cross-request render batching — SURVEY §2.8 P1's "async server in
+front of a batching TPU executor", realised.
+
+Measured on a tunneled v5e, a fused single-tile render costs ~5 serial
+device-stream operations (uploads, execution, pull) at ~2.5 ms each;
+request concurrency cannot overlap them because the device stream is one
+queue.  This batcher coalesces concurrent tile renders that share a
+scene stack + static config into ONE vmapped dispatch
+(`ops.warp.render_scenes_ctrl_many`), amortising the round trips N ways.
+
+A request waits at most ``max_wait_s`` (default 3 ms) for companions.
+Batches are padded to one fixed size so each key compiles exactly once.
+
+**Default OFF** (`GSKY_RENDER_BATCH=1` enables): batching trades
+transfer granularity for round-trip count, which wins when the
+host<->device link is latency-bound (PCIe-attached TPU: ~10 us
+round trips) but loses when it is bandwidth-bound — over the tunneled
+dev link (~10 MB/s, ~90 ms/MB) a padded 16-tile pull moves more bytes
+than the tiles it serves, measured 4x slower end-to-end.  The
+single-tile fused path already saturates that link.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.warp import render_scenes_ctrl_many
+
+_MAX_BATCH = 16
+
+
+def batching_enabled() -> bool:
+    return os.environ.get("GSKY_RENDER_BATCH", "0") == "1"
+
+
+class RenderBatcher:
+    def __init__(self, max_batch: int = _MAX_BATCH,
+                 max_wait_s: float = 0.003):
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self._lock = threading.Lock()
+        # key -> (stack, [(ctrl, params, sp, Future), ...])
+        self._groups: Dict[tuple, Tuple[object, List]] = {}
+
+    def render(self, key: tuple, stack, ctrl, params, sp,
+               statics: tuple) -> np.ndarray:
+        """Submit one tile; blocks until its batch executes.  ``key``
+        must capture everything that makes tiles batchable together:
+        the scene-stack identity plus all static kernel parameters.
+        Returns the uint8 (H, W) tile as host numpy."""
+        fut: Future = Future()
+        flush_now = None
+        with self._lock:
+            entry = self._groups.get(key)
+            if entry is None:
+                self._groups[key] = (stack, [(ctrl, params, sp, fut)])
+                timer = threading.Timer(self.max_wait_s,
+                                        self._flush_key, (key, statics))
+                timer.daemon = True
+                timer.start()
+            else:
+                entry[1].append((ctrl, params, sp, fut))
+                if len(entry[1]) >= self.max_batch:
+                    flush_now = self._groups.pop(key)
+        if flush_now is not None:
+            self._execute(flush_now, statics)
+        return fut.result()
+
+    def _flush_key(self, key: tuple, statics: tuple):
+        with self._lock:
+            entry = self._groups.pop(key, None)
+        if entry is not None:
+            self._execute(entry, statics)
+
+    def _execute(self, entry, statics: tuple):
+        stack, items = entry
+        method, n_ns, out_hw, step, auto, colour_scale = statics
+        try:
+            N = len(items)
+            # ALWAYS pad to the fixed max batch: exactly one jit
+            # specialisation per key (variable batch sizes would
+            # recompile mid-traffic), and padded lanes cost only device
+            # compute (~15 us/lane), not round trips
+            Np = self.max_batch
+            ctrls = np.stack([it[0] for it in items]
+                             + [items[0][0]] * (Np - N))
+            params = np.stack([it[1] for it in items]
+                              + [items[0][1]] * (Np - N))
+            sps = np.stack([it[2] for it in items]
+                           + [items[0][2]] * (Np - N))
+            out = np.asarray(render_scenes_ctrl_many(
+                stack, jnp.asarray(ctrls), jnp.asarray(params),
+                jnp.asarray(sps), method, n_ns, out_hw, step, auto,
+                colour_scale))
+            for i, (_, _, _, fut) in enumerate(items):
+                fut.set_result(out[i])
+        except Exception as e:  # pragma: no cover - propagate to callers
+            for _, _, _, fut in items:
+                if not fut.done():
+                    fut.set_exception(e)

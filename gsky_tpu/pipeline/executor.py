@@ -51,6 +51,8 @@ class WarpExecutor:
         self._geo_cache: Dict[tuple, Tuple[np.ndarray, np.ndarray]] = {}
         self._stack_cache: Dict[tuple, object] = {}
         self._lock = threading.Lock()
+        from .batcher import RenderBatcher
+        self._batcher = RenderBatcher()
 
     def _dst_geo_coords(self, dst_gt: GeoTransform, dst_crs: CRS,
                         height: int, width: int,
@@ -209,7 +211,8 @@ class WarpExecutor:
         if made is None:
             return None
         stack, ctrl, params, step = made
-        return warp_scenes_ctrl(stack, ctrl, params, method,
+        return warp_scenes_ctrl(stack, jnp.asarray(ctrl),
+                                jnp.asarray(params), method,
                                 _bucket_pow2(n_ns), (height, width), step)
 
     def render_byte_scenes(self, granules, ns_ids: Sequence[int],
@@ -219,18 +222,26 @@ class WarpExecutor:
                            offset: float = 0.0, scale: float = 0.0,
                            clip: float = 0.0, colour_scale: int = 0,
                            auto: bool = True, cache=None):
-        """Whole-tile fast path: one dispatch from cached scenes to the
-        PNG-ready uint8 composite (`ops.warp.render_scenes_ctrl`).
-        Returns a device uint8 (H, W) array or None (fallback)."""
+        """Whole-tile fast path: cached scenes -> PNG-ready uint8
+        composite, coalesced with concurrent companion requests into one
+        vmapped dispatch (`pipeline.batcher.RenderBatcher`).  Returns a
+        host uint8 (H, W) array or None (fallback)."""
         made = self._scene_inputs(granules, ns_ids, prios, dst_gt,
                                   dst_crs, height, width, cache)
         if made is None:
             return None
         stack, ctrl, params, step = made
-        sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
-        return render_scenes_ctrl(stack, ctrl, params, sp, method,
-                                  _bucket_pow2(n_ns), (height, width),
-                                  step, auto, colour_scale)
+        sp = np.array([offset, scale, clip], np.float32)
+        statics = (method, _bucket_pow2(n_ns), (height, width), step,
+                   auto, colour_scale)
+        from .batcher import batching_enabled
+        if batching_enabled():
+            key = (id(stack),) + statics
+            return self._batcher.render(key, stack, ctrl, params, sp,
+                                        statics)
+        return render_scenes_ctrl(stack, jnp.asarray(ctrl),
+                                  jnp.asarray(params), jnp.asarray(sp),
+                                  *statics)
 
     def _scene_inputs(self, granules, ns_ids, prios, dst_gt, dst_crs,
                       height, width, cache=None):
@@ -280,8 +291,7 @@ class WarpExecutor:
                 if len(self._stack_cache) > 32:
                     self._stack_cache.clear()
                 self._stack_cache[skey] = stack
-        return (stack, jnp.asarray(ctrl),
-                jnp.asarray(params.astype(np.float32)), step)
+        return stack, ctrl, params.astype(np.float32), step
 
 
 # module-level default executor (compile cache shared across requests)

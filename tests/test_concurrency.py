@@ -132,3 +132,30 @@ def test_mas_store_concurrent_queries(archive):
     for t in threads:
         t.join(60)
     assert not errors, errors
+
+
+def test_batched_render_matches_unbatched(archive, monkeypatch):
+    """GSKY_RENDER_BATCH=1 coalesces concurrent fused renders into one
+    vmapped dispatch; results must equal the unbatched path."""
+    pipe = TilePipeline(MASClient(archive["store"]))
+    reqs = [_req(archive, s) for s in (0.0, 0.005, 0.01, 0.015)]
+    plain = [np.asarray(pipe.render_composite_byte(r, auto=True))
+             for r in reqs]
+    assert all(p is not None for p in plain)
+
+    monkeypatch.setenv("GSKY_RENDER_BATCH", "1")
+    out = [None] * 8
+
+    def worker(i):
+        out[i] = np.asarray(
+            pipe.render_composite_byte(reqs[i % len(reqs)], auto=True))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    for i, o in enumerate(out):
+        assert o is not None
+        np.testing.assert_array_equal(o, plain[i % len(reqs)])
