@@ -42,8 +42,35 @@ def _combine_priority(partials, pvalids):
     return out, ok
 
 
+def _combine_priority_ring(part, pok, axis_name: str, axis_size: int):
+    """Ring-reduce the shard partials instead of `all_gather`ing them:
+    each chip keeps one partial canvas + the shard rank of its
+    contributing granule per pixel, and in ``G-1`` `ppermute` steps
+    folds in its neighbour's candidate, keeping the lower rank (= newer
+    granule).  Memory is O(1) in the number of shards where the gather
+    variant materialises the full (G, ..., h, w) stack — the difference
+    between fitting and not fitting very long granule stacks in HBM.
+    The collectives ride ICI neighbour links, the cheapest pattern on a
+    TPU torus (cf. ring collectives in the scaling playbook).
+    """
+    me = jax.lax.axis_index(axis_name)
+    inf = jnp.float32(jnp.inf)
+    rank = jnp.where(pok, me.astype(jnp.float32), inf)
+    data, best = part, rank
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    cand_d, cand_r = part, rank
+    for _ in range(axis_size - 1):
+        cand_d = jax.lax.ppermute(cand_d, axis_name, perm)
+        cand_r = jax.lax.ppermute(cand_r, axis_name, perm)
+        take = cand_r < best
+        data = jnp.where(take, cand_d, data)
+        best = jnp.where(take, cand_r, best)
+    return data, best < inf
+
+
 def make_sharded_render(mesh: Mesh, method: str = "near",
-                        expr: Optional[Callable] = None) -> Callable:
+                        expr: Optional[Callable] = None,
+                        combine: str = "gather") -> Callable:
     """Build a jitted SPMD render step.
 
     The returned fn has signature
@@ -62,7 +89,14 @@ def make_sharded_render(mesh: Mesh, method: str = "near",
 
     Shardings: T over the ``granule`` mesh axis, w over ``x``.  T and w
     must divide the respective mesh dimensions.
+
+    ``combine``: how per-shard mosaic partials merge across the granule
+    axis — "gather" (`all_gather`, one hop, O(G) memory) or "ring"
+    (`ppermute` ring reduction, G-1 neighbour hops, O(1) memory; use for
+    granule stacks whose gathered partials would not fit HBM).
     """
+    if combine not in ("gather", "ring"):
+        raise ValueError(f"combine must be 'gather' or 'ring': {combine}")
     gather = _METHODS[method]
 
     if expr is None:
@@ -81,9 +115,14 @@ def make_sharded_render(mesh: Mesh, method: str = "near",
         pok = jnp.any(ok, axis=0)
         # combine shard partials: shard g holds granules [g*Tl, (g+1)*Tl)
         # of the priority-ordered stack, so shard order == priority order
-        parts = jax.lax.all_gather(part, AXIS_GRANULE)          # (G, NS, h, wl)
-        poks = jax.lax.all_gather(pok, AXIS_GRANULE)
-        canvas, cok = _combine_priority(parts, poks)            # (NS, h, wl)
+        if combine == "ring":
+            canvas, cok = _combine_priority_ring(
+                part, pok, AXIS_GRANULE,
+                mesh.shape[AXIS_GRANULE])                       # (NS, h, wl)
+        else:
+            parts = jax.lax.all_gather(part, AXIS_GRANULE)      # (G, NS, h, wl)
+            poks = jax.lax.all_gather(pok, AXIS_GRANULE)
+            canvas, cok = _combine_priority(parts, poks)        # (NS, h, wl)
         data, dok = expr(canvas, cok)                           # (h, wl)
         # auto min-max scaling needs global extrema across the x strips
         big = jnp.float32(3.4e38)

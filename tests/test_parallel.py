@@ -56,6 +56,16 @@ class TestShardedRender:
         assert got.shape == want.shape == (32, 64, 4)
         np.testing.assert_array_equal(got, want)
 
+    def test_ring_combine_matches_gather(self, mesh):
+        """ppermute ring reduction of the shard partials (O(1) memory)
+        must produce the same canvas as the all_gather combine."""
+        src, valid, rows, cols, lut = _scene()
+        got = np.asarray(make_sharded_render(mesh, combine="ring")(
+            src, valid, rows, cols, lut))
+        want = np.asarray(make_sharded_render(mesh, combine="gather")(
+            src, valid, rows, cols, lut))
+        np.testing.assert_array_equal(got, want)
+
     def test_expr_hook(self, mesh):
         src, valid, rows, cols, lut = _scene()
 
