@@ -103,3 +103,16 @@ class TestShardedDrill:
             if m.any():
                 np.testing.assert_allclose(means[t], data[t][m].mean(),
                                            rtol=1e-5)
+
+
+def test_global_mesh_host_major_layout():
+    """global_mesh keeps the x axis within a host (ICI) and spans hosts
+    along granule (DCN) — on one host that is a (1, n_local) mesh."""
+    from gsky_tpu.parallel.distributed import global_mesh
+    import jax
+    m = global_mesh()
+    n = len(jax.devices())
+    per = max(1, jax.local_device_count())
+    assert m.shape["granule"] == max(1, n // per)
+    assert m.shape["x"] == per
+    assert m.shape["granule"] * m.shape["x"] == n
