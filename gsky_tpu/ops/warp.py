@@ -346,6 +346,46 @@ def render_scenes_ctrl(stack, ctrl, params, scale_params,
 @functools.partial(jax.jit,
                    static_argnames=("method", "n_ns", "out_hw", "step",
                                     "auto", "colour_scale"))
+def render_scenes_bands_ctrl(stack, ctrl, params, scale_params, out_sel,
+                             method: str = "near", n_ns: int = 1,
+                             out_hw: Tuple[int, int] = (256, 256),
+                             step: int = 16, auto: bool = True,
+                             colour_scale: int = 0):
+    """Multi-band variant of `render_scenes_ctrl` for RGB(A) styles:
+    instead of compositing namespaces it emits one scaled uint8 plane
+    per selected namespace — out_sel (n_out,) int32 indexes the mosaic
+    canvases (expression order -> namespace id).  Auto mode scales each
+    band by its own min-max, matching the modular per-band path.
+    Returns uint8 (n_out, h, w)."""
+    from .scale import auto_byte_scale, scale_to_byte
+    h, w = out_hw
+    sx = _bilerp_grid(ctrl[0], h, w, step)
+    sy = _bilerp_grid(ctrl[1], h, w, step)
+    canv, vals = _warp_scenes_core(stack, sx, sy, params, method, n_ns)
+    data = canv[out_sel]
+    ok = vals[out_sel]
+    if auto:
+        if colour_scale == 1:
+            logged = jnp.log10(data)
+            bad = ~jnp.isfinite(logged)
+            data = jnp.where(bad, 0.0, logged)
+            ok = ok & ~bad
+        big = jnp.float32(3.4e38)
+
+        def per_band(d, o):
+            mn = jnp.min(jnp.where(o, d, big))
+            mx = jnp.max(jnp.where(o, d, -big))
+            return auto_byte_scale(d, o, mn, mx, jnp.any(o))
+
+        return jax.vmap(per_band)(data, ok)
+    return scale_to_byte(data, ok, scale_params[0], scale_params[1],
+                         scale_params[2], colour_scale=colour_scale,
+                         auto=False)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "auto", "colour_scale"))
 def render_scenes_ctrl_many(stack, ctrls, params, scale_params,
                             method: str = "near", n_ns: int = 1,
                             out_hw: Tuple[int, int] = (256, 256),

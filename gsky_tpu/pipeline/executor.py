@@ -20,8 +20,9 @@ import numpy as np
 
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
-from ..ops.warp import (render_scenes_ctrl, warp_gather_batch,
-                        warp_mosaic_batch, warp_scenes_ctrl)
+from ..ops.warp import (render_scenes_bands_ctrl, render_scenes_ctrl,
+                        warp_gather_batch, warp_mosaic_batch,
+                        warp_scenes_ctrl)
 from .decode import DecodedWindow
 
 # padded source-window shape buckets (H and W independently bucketed)
@@ -242,6 +243,30 @@ class WarpExecutor:
         return render_scenes_ctrl(stack, jnp.asarray(ctrl),
                                   jnp.asarray(params), jnp.asarray(sp),
                                   *statics)
+
+    def render_bands_byte(self, granules, ns_ids: Sequence[int],
+                          prios: Sequence[float], dst_gt: GeoTransform,
+                          dst_crs: CRS, height: int, width: int,
+                          n_ns: int, out_sel: Sequence[int],
+                          method: str = "near", offset: float = 0.0,
+                          scale: float = 0.0, clip: float = 0.0,
+                          colour_scale: int = 0, auto: bool = True,
+                          cache=None):
+        """Multi-band fused fast path (RGB styles): one dispatch from
+        cached scenes to per-band uint8 planes
+        (`ops.warp.render_scenes_bands_ctrl`).  Returns a device uint8
+        (n_out, H, W) array or None (fallback)."""
+        made = self._scene_inputs(granules, ns_ids, prios, dst_gt,
+                                  dst_crs, height, width, cache)
+        if made is None:
+            return None
+        stack, ctrl, params, step = made
+        sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
+        sel = jnp.asarray(np.asarray(out_sel, np.int32))
+        return render_scenes_bands_ctrl(
+            stack, jnp.asarray(ctrl), jnp.asarray(params), sp, sel,
+            method, _bucket_pow2(n_ns), (height, width), step, auto,
+            colour_scale)
 
     def _scene_inputs(self, granules, ns_ids, prios, dst_gt, dst_crs,
                       height, width, cache=None):

@@ -158,6 +158,55 @@ class TilePipeline:
             req.height, req.width, len(ns_names), req.resample,
             offset, scale, clip, colour_scale, auto)
 
+    def render_bands_byte(self, req: GeoTileRequest,
+                          offset: float = 0.0, scale: float = 0.0,
+                          clip: float = 0.0, colour_scale: int = 0,
+                          auto: bool = True,
+                          stats: Optional[Dict[str, int]] = None):
+        """One-dispatch multi-band GetMap (RGB styles): index -> fused
+        scene warp + per-namespace mosaic + per-band byte scaling on
+        device; returns uint8 (n_bands, H, W) in expression order, or
+        None when the request doesn't qualify (mask band, remote
+        workers, non-trivial expressions, unmatched namespaces,
+        uncacheable scenes)."""
+        if self.remote is not None or req.mask is not None:
+            return None
+        exprs = req.band_exprs
+        if not exprs.expressions or \
+                any(ce._ast[0] != "var" for ce in exprs.expressions):
+            return None
+        granules = self.index(req)
+        if not granules:
+            return None
+        if stats is not None:
+            stats["granules"] = len(granules)
+            stats["files"] = len({g.path for g in granules})
+        ns_names: List[str] = []
+        ns_index: Dict[str, int] = {}
+        for g in granules:
+            if g.namespace not in ns_index:
+                ns_index[g.namespace] = len(ns_names)
+                ns_names.append(g.namespace)
+        out_sel = []
+        for ce in exprs.expressions:
+            var = ce.variables[0]
+            if var in ns_index:
+                out_sel.append(ns_index[var])
+                continue
+            cands = [k for k in ns_index if k.split("#")[0] == var]
+            if len(cands) != 1:
+                return None
+            out_sel.append(ns_index[cands[0]])
+        ns_ids = [ns_index[g.namespace] for g in granules]
+        order = M.priority_order([g.timestamp for g in granules])
+        prio = [0.0] * len(granules)
+        for rank, i in enumerate(order):
+            prio[i] = float(len(granules) - rank)
+        return self.executor.render_bands_byte(
+            granules, ns_ids, prio, req.dst_gt(), req.crs,
+            req.height, req.width, len(ns_names), out_sel, req.resample,
+            offset, scale, clip, colour_scale, auto)
+
     def process(self, req: GeoTileRequest) -> TileResult:
         granules = self.index(req)
         return self.render(req, granules)

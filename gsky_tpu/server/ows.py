@@ -288,20 +288,33 @@ class OWSServer:
         auto = scale_params_auto(style.offset_value, style.scale_value,
                                  style.clip_value)
         scaled = None
-        if not lay.input_layers and len(req.band_exprs.expr_names) == 1:
+        n_exprs = len(req.band_exprs.expr_names)
+        if not lay.input_layers and 1 <= n_exprs <= 4:
             # single-dispatch fast path: fused warp+mosaic+scale on
-            # device, one 64 KB pull (the modular path below costs
-            # several device round trips per request)
+            # device, one pull (the modular path below costs several
+            # device round trips per request); single-band styles
+            # composite, RGB styles emit per-band planes
             stats: Dict[str, int] = {}
-            sb = await asyncio.wait_for(
-                asyncio.to_thread(pipe.render_composite_byte, req,
-                                  style.offset_value, style.scale_value,
-                                  style.clip_value, style.colour_scale,
-                                  auto, stats),
-                timeout=lay.wms_timeout)
+            if n_exprs == 1:
+                sb = await asyncio.wait_for(
+                    asyncio.to_thread(pipe.render_composite_byte, req,
+                                      style.offset_value,
+                                      style.scale_value,
+                                      style.clip_value,
+                                      style.colour_scale, auto, stats),
+                    timeout=lay.wms_timeout)
+            else:
+                sb = await asyncio.wait_for(
+                    asyncio.to_thread(pipe.render_bands_byte, req,
+                                      style.offset_value,
+                                      style.scale_value,
+                                      style.clip_value,
+                                      style.colour_scale, auto, stats),
+                    timeout=lay.wms_timeout)
             if sb is not None:
                 td = time.time()
-                scaled = [np.asarray(sb)]  # the one device pull
+                arr = np.asarray(sb)  # the one device pull
+                scaled = [arr] if arr.ndim == 2 else list(arr)
                 collector.info["device"]["duration"] = \
                     int((time.time() - td) * 1e9)
                 collector.info["device"]["platform"] = _jax_platform()

@@ -320,3 +320,41 @@ class TestReviewRegressions:
         [t.start() for t in ts]
         [t.join() for t in ts]
         assert not errs
+
+
+class TestFusedBandsRender:
+    def test_matches_modular_path(self, archive):
+        """render_bands_byte (one fused dispatch) must equal the modular
+        process() + per-band scale_to_byte path for plain RGB styles."""
+        import jax.numpy as jnp
+        from gsky_tpu.ops.scale import scale_to_byte
+
+        mas = MASClient(archive["store"])
+        pipe = TilePipeline(mas)
+        req = GeoTileRequest(
+            collection=archive["root"],
+            bands=["phot_veg", "bare_soil"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=128, height=128,
+            start_time=1578000000.0 - 90 * 86400,
+            end_time=1578700000.0)
+        out = pipe.render_bands_byte(req, auto=True)
+        assert out is not None
+        out = np.asarray(out)
+        assert out.shape == (2, 128, 128)
+
+        res = pipe.process(req)
+        for i, ns in enumerate(["phot_veg", "bare_soil"]):
+            want = np.asarray(scale_to_byte(
+                jnp.asarray(res.data[ns]), jnp.asarray(res.valid[ns]),
+                auto=True))
+            mism = np.mean(out[i] != want)
+            # approx-transform nearest flips allowed on boundary pixels
+            assert mism < 0.02, f"{ns}: {mism:.1%} differ"
+
+    def test_rejects_expressions(self, archive):
+        pipe = TilePipeline(MASClient(archive["store"]))
+        req = GeoTileRequest(
+            collection=archive["root"],
+            bands=["total = phot_veg + bare_soil"],
+            bbox=TILE_BBOX, crs=EPSG3857, width=64, height=64)
+        assert pipe.render_bands_byte(req) is None
