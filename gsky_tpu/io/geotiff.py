@@ -493,94 +493,159 @@ class GeoTIFF:
 _SAMPLE_FMT = {"u": 1, "i": 2, "f": 3}
 
 
-def write_geotiff(path: str, data: np.ndarray, gt: GeoTransform, crs: CRS,
-                  nodata: Optional[float] = None, tile_size: int = 256,
-                  compress: bool = True):
-    """Write a (H, W) or (bands, H, W) array as a tiled GeoTIFF.
+class GeoTIFFWriter:
+    """Streaming tiled GeoTIFF writer.
 
-    Chunky interleave, deflate compression, GeoKeys from the CRS's EPSG
-    code (or proj4 citation fallback), GDAL_NODATA tag.
+    Tiles append to disk in any order as they are rendered (RAM stays
+    O(tile)); the IFD is written at close().  This is the rebuild's
+    answer to the reference's incremental WCS output flush
+    (`ows.go:695,1088-1091` + `utils/ogc_encoders.go:277-538`): very
+    large GetCoverage exports stream to the temp file instead of
+    accumulating whole-coverage arrays in memory.  Unwritten tiles
+    resolve to a shared nodata-filled block.  Thread-safe.
     """
-    if data.ndim == 2:
-        data = data[None]
-    bands, H, W = data.shape
-    dt = data.dtype
-    e = "<"
-    ts = tile_size
-    tiles_x = (W + ts - 1) // ts
-    tiles_y = (H + ts - 1) // ts
 
-    tile_blobs: List[bytes] = []
-    for ty in range(tiles_y):
-        for tx in range(tiles_x):
-            block = np.zeros((ts, ts, bands), dtype=dt)
-            r1 = min((ty + 1) * ts, H)
-            c1 = min((tx + 1) * ts, W)
-            sub = data[:, ty * ts:r1, tx * ts:c1]
-            block[:r1 - ty * ts, :c1 - tx * ts, :] = np.transpose(sub, (1, 2, 0))
-            raw = block.astype(dt.newbyteorder(e)).tobytes()
-            tile_blobs.append(zlib.compress(raw, 6) if compress else raw)
+    def __init__(self, path: str, bands: int, height: int, width: int,
+                 dtype, gt: GeoTransform, crs: CRS,
+                 nodata: Optional[float] = None, tile_size: int = 256,
+                 compress: bool = True):
+        import threading
+        self.path = path
+        self.bands = bands
+        self.height = height
+        self.width = width
+        self.dtype = np.dtype(dtype)
+        self.gt = gt
+        self.crs = crs
+        self.nodata = nodata
+        self.tile_size = tile_size
+        self.compress = compress
+        self.tiles_x = (width + tile_size - 1) // tile_size
+        self.tiles_y = (height + tile_size - 1) // tile_size
+        self._lock = threading.Lock()
+        self._tiles: dict = {}      # (ty, tx) -> (offset, nbytes)
+        self._fp = open(path, "wb")
+        self._fp.write(b"II*\0\0\0\0\0")   # IFD offset patched at close
+        self._pos = 8
+        self._closed = False
 
-    # geo keys
-    geo_keys = []
-    if crs.is_geographic:
-        geo_keys += [(1024, 0, 1, 2), (1025, 0, 1, 1),
-                     (2048, 0, 1, crs.epsg or 4326)]
-    elif crs.epsg:
-        geo_keys += [(1024, 0, 1, 1), (1025, 0, 1, 1),
-                     (3072, 0, 1, crs.epsg)]
-    else:
-        geo_keys += [(1024, 0, 1, 1), (1025, 0, 1, 1), (3072, 0, 1, 32767)]
-    ascii_params = "" if (crs.epsg or crs.is_geographic) else crs.to_proj4() + "|"
-    if ascii_params:
-        geo_keys.append((3073, T_GEO_ASCII, len(ascii_params), 0))
-    geo_dir = [1, 1, 0, len(geo_keys)]
-    for k in geo_keys:
-        geo_dir += list(k)
+    def _encode_block(self, block: np.ndarray) -> bytes:
+        ts = self.tile_size
+        full = np.full((ts, ts, self.bands),
+                       self.nodata if self.nodata is not None else 0,
+                       dtype=self.dtype)
+        h, w = block.shape[1], block.shape[2]
+        full[:h, :w, :] = np.transpose(block, (1, 2, 0))
+        raw = full.astype(self.dtype.newbyteorder("<")).tobytes()
+        return zlib.compress(raw, 6) if self.compress else raw
 
-    fmt_code = _SAMPLE_FMT[dt.kind]
-    tags: List[Tuple[int, int, Sequence]] = [
-        (T_WIDTH, 3, [W]),
-        (T_HEIGHT, 3, [H]),
-        (T_BITS, 3, [dt.itemsize * 8] * bands),
-        (T_COMPRESSION, 3, [COMP_DEFLATE if compress else COMP_NONE]),
-        (T_PHOTOMETRIC, 3, [1]),
-        (T_SAMPLES, 3, [bands]),
-        (T_PLANAR, 3, [1]),
-        (T_TILE_W, 3, [ts]),
-        (T_TILE_H, 3, [ts]),
-        (T_SAMPLE_FORMAT, 3, [fmt_code] * bands),
-        (T_GEO_DIR, 3, geo_dir),
-    ]
-    if gt.is_north_up and gt.dy < 0:
-        tags.append((T_MODEL_PIXEL_SCALE, 12, [gt.dx, -gt.dy, 0.0]))
-        tags.append((T_MODEL_TIEPOINT, 12, [0.0, 0.0, 0.0, gt.x0, gt.y0, 0.0]))
-    else:
-        # south-up or rotated: the full affine ModelTransformation matrix
-        tags.append((T_MODEL_TRANSFORM, 12,
-                     [gt.dx, gt.rx, 0.0, gt.x0,
-                      gt.ry, gt.dy, 0.0, gt.y0,
-                      0.0, 0.0, 0.0, 0.0,
-                      0.0, 0.0, 0.0, 1.0]))
-    if ascii_params:
-        tags.append((T_GEO_ASCII, 2, ascii_params))
-    if nodata is not None:
-        nd = str(int(nodata)) if float(nodata).is_integer() else repr(float(nodata))
-        tags.append((T_GDAL_NODATA, 2, nd))
+    def write_tile(self, tx: int, ty: int, block: np.ndarray) -> None:
+        """block: (bands, th, tw) in storage dtype; edge tiles may be
+        smaller than tile_size (padded with nodata)."""
+        blob = self._encode_block(np.asarray(block, self.dtype))
+        with self._lock:
+            off = self._pos
+            self._fp.write(blob)
+            self._pos += len(blob)
+            self._tiles[(ty, tx)] = (off, len(blob))
 
-    with open(path, "wb") as fp:
-        fp.write(b"II*\0")
-        # layout: header(8) -> tile data -> out-of-line tag data -> IFD
-        pos = 8
-        tile_offsets = []
-        for blob in tile_blobs:
-            tile_offsets.append(pos)
-            pos += len(blob)
-        tags.append((T_TILE_OFFSETS, 4, tile_offsets))
-        tags.append((T_TILE_COUNTS, 4, [len(b) for b in tile_blobs]))
+    def write_region(self, x0: int, y0: int, data: np.ndarray) -> None:
+        """Write a tile-aligned region (bands, h, w) at pixel (x0, y0);
+        (x0, y0) must lie on a tile boundary."""
+        ts = self.tile_size
+        _, h, w = data.shape
+        for ty in range(y0 // ts, (y0 + h + ts - 1) // ts):
+            for tx in range(x0 // ts, (x0 + w + ts - 1) // ts):
+                r0 = ty * ts - y0
+                c0 = tx * ts - x0
+                sub = data[:, max(r0, 0):r0 + ts, max(c0, 0):c0 + ts]
+                if sub.shape[1] and sub.shape[2]:
+                    self.write_tile(tx, ty, sub)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        e = "<"
+        fp = self._fp
+        # shared nodata blob for never-written tiles
+        missing = [k for ty in range(self.tiles_y)
+                   for tx in range(self.tiles_x)
+                   if (k := (ty, tx)) not in self._tiles]
+        if missing:
+            blob = self._encode_block(
+                np.full((self.bands, 1, 1),
+                        self.nodata if self.nodata is not None else 0,
+                        self.dtype))
+            off = self._pos
+            fp.write(blob)
+            self._pos += len(blob)
+            for k in missing:
+                self._tiles[k] = (off, len(blob))
+
+        dt = self.dtype
+        gt_ = self.gt
+        crs = self.crs
+        geo_keys = []
+        if crs.is_geographic:
+            geo_keys += [(1024, 0, 1, 2), (1025, 0, 1, 1),
+                         (2048, 0, 1, crs.epsg or 4326)]
+        elif crs.epsg:
+            geo_keys += [(1024, 0, 1, 1), (1025, 0, 1, 1),
+                         (3072, 0, 1, crs.epsg)]
+        else:
+            geo_keys += [(1024, 0, 1, 1), (1025, 0, 1, 1),
+                         (3072, 0, 1, 32767)]
+        ascii_params = "" if (crs.epsg or crs.is_geographic) \
+            else crs.to_proj4() + "|"
+        if ascii_params:
+            geo_keys.append((3073, T_GEO_ASCII, len(ascii_params), 0))
+        geo_dir = [1, 1, 0, len(geo_keys)]
+        for k in geo_keys:
+            geo_dir += list(k)
+
+        fmt_code = _SAMPLE_FMT[dt.kind]
+        bands = self.bands
+        tags: List[Tuple[int, int, Sequence]] = [
+            (T_WIDTH, 3, [self.width]),
+            (T_HEIGHT, 3, [self.height]),
+            (T_BITS, 3, [dt.itemsize * 8] * bands),
+            (T_COMPRESSION, 3,
+             [COMP_DEFLATE if self.compress else COMP_NONE]),
+            (T_PHOTOMETRIC, 3, [1]),
+            (T_SAMPLES, 3, [bands]),
+            (T_PLANAR, 3, [1]),
+            (T_TILE_W, 3, [self.tile_size]),
+            (T_TILE_H, 3, [self.tile_size]),
+            (T_SAMPLE_FORMAT, 3, [fmt_code] * bands),
+            (T_GEO_DIR, 3, geo_dir),
+        ]
+        if gt_.is_north_up and gt_.dy < 0:
+            tags.append((T_MODEL_PIXEL_SCALE, 12, [gt_.dx, -gt_.dy, 0.0]))
+            tags.append((T_MODEL_TIEPOINT, 12,
+                         [0.0, 0.0, 0.0, gt_.x0, gt_.y0, 0.0]))
+        else:
+            tags.append((T_MODEL_TRANSFORM, 12,
+                         [gt_.dx, gt_.rx, 0.0, gt_.x0,
+                          gt_.ry, gt_.dy, 0.0, gt_.y0,
+                          0.0, 0.0, 0.0, 0.0,
+                          0.0, 0.0, 0.0, 1.0]))
+        if ascii_params:
+            tags.append((T_GEO_ASCII, 2, ascii_params))
+        if self.nodata is not None:
+            nd = str(int(self.nodata)) \
+                if float(self.nodata).is_integer() \
+                else repr(float(self.nodata))
+            tags.append((T_GDAL_NODATA, 2, nd))
+        order = [(ty, tx) for ty in range(self.tiles_y)
+                 for tx in range(self.tiles_x)]
+        tags.append((T_TILE_OFFSETS, 4,
+                     [self._tiles[k][0] for k in order]))
+        tags.append((T_TILE_COUNTS, 4,
+                     [self._tiles[k][1] for k in order]))
         tags.sort(key=lambda t: t[0])
 
-        # out-of-line data
+        pos = self._pos
         blobs2 = []
         entries = []
         for tag, typ, vals in tags:
@@ -592,23 +657,54 @@ def write_geotiff(path: str, data: np.ndarray, gt: GeoTransform, crs: CRS,
                 data_b = struct.pack(e + fmtc * len(vals), *vals)
                 cnt = len(vals)
             if len(data_b) <= 4:
-                entries.append((tag, typ, cnt, data_b.ljust(4, b"\0"), None))
+                entries.append((tag, typ, cnt, data_b.ljust(4, b"\0"),
+                                None))
             else:
                 entries.append((tag, typ, cnt, None, data_b))
         ool_pos = pos
         for i, (tag, typ, cnt, inline, data_b) in enumerate(entries):
             if data_b is not None:
-                entries[i] = (tag, typ, cnt, struct.pack(e + "I", ool_pos), None)
+                entries[i] = (tag, typ, cnt,
+                              struct.pack(e + "I", ool_pos), None)
                 blobs2.append(data_b)
                 ool_pos += len(data_b)
         ifd_off = ool_pos
-        fp.seek(4)
-        fp.write(struct.pack(e + "I", ifd_off))
-        for blob in tile_blobs:
-            fp.write(blob)
         for b2 in blobs2:
             fp.write(b2)
         fp.write(struct.pack(e + "H", len(entries)))
         for tag, typ, cnt, inline, _ in entries:
             fp.write(struct.pack(e + "HHI", tag, typ, cnt) + inline)
         fp.write(struct.pack(e + "I", 0))
+        fp.seek(4)
+        fp.write(struct.pack(e + "I", ifd_off))
+        fp.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_geotiff(path: str, data, gt: GeoTransform, crs: CRS,
+                  nodata: Optional[float] = None, tile_size: int = 256,
+                  compress: bool = True):
+    """Write a (H, W) or (bands, H, W) array (or sequence of 2D bands)
+    as a tiled GeoTIFF via the streaming writer."""
+    if isinstance(data, np.ndarray) and data.ndim == 2:
+        data = data[None]
+    bands = len(data)
+    H, W = data[0].shape
+    dt = np.result_type(*[np.asarray(b).dtype for b in data]) \
+        if not isinstance(data, np.ndarray) else data.dtype
+    w = GeoTIFFWriter(path, bands, H, W, dt, gt, crs, nodata=nodata,
+                      tile_size=tile_size, compress=compress)
+    ts = tile_size
+    for ty in range(w.tiles_y):
+        for tx in range(w.tiles_x):
+            r1 = min((ty + 1) * ts, H)
+            c1 = min((tx + 1) * ts, W)
+            block = np.stack([np.asarray(b)[ty * ts:r1, tx * ts:c1]
+                              for b in data]).astype(dt)
+            w.write_tile(tx, ty, block)
+    w.close()

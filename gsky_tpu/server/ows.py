@@ -53,6 +53,10 @@ from . import templates as T
 
 log = logging.getLogger("gsky.ows")
 
+# GetCoverage outputs beyond this many pixels stream tiles to disk via
+# GeoTIFFWriter instead of accumulating whole-coverage arrays in RAM
+WCS_STREAM_PIXELS = 16 << 20
+
 
 @functools.lru_cache(maxsize=1)
 def _jax_platform() -> str:
@@ -483,8 +487,28 @@ class OWSServer:
                            lay.wcs_max_tile_height)
         exprs = base_req.band_exprs
         ns_names = list(exprs.expr_names)
-        out = {n: np.zeros((height, width), np.float32) for n in ns_names}
-        valid = {n: np.zeros((height, width), bool) for n in ns_names}
+        # very large GeoTIFF exports stream tiles straight to disk
+        # (GeoTIFFWriter) instead of accumulating whole-coverage arrays
+        # — the reference's incremental flush (`ows.go:695,1088-1091`)
+        stream_tif = (
+            fmt in ("geotiff", "gtiff", "tiff", "image/tiff")
+            and width * height > WCS_STREAM_PIXELS
+            and lay.wcs_max_tile_width % 256 == 0
+            and lay.wcs_max_tile_height % 256 == 0)
+        out = {} if stream_tif else \
+            {n: np.zeros((height, width), np.float32) for n in ns_names}
+        valid = {} if stream_tif else \
+            {n: np.zeros((height, width), bool) for n in ns_names}
+
+        nodata = -9999.0
+        gt = GeoTransform.from_bbox(p.bbox, width, height)
+        stamp = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%d%H%M%S")
+        writer = None
+        if stream_tif:
+            from ..io.geotiff import GeoTIFFWriter
+            path = os.path.join(self.temp_dir, f"wcs_{stamp}_{id(p)}.tif")
+            writer = GeoTIFFWriter(path, len(ns_names), height, width,
+                                   np.float32, gt, p.crs, nodata=nodata)
 
         async def render_tile(tb, ox, oy, tw, th):
             req = GeoTileRequest(
@@ -496,13 +520,22 @@ class OWSServer:
                 polygon_segments=lay.wcs_polygon_segments)
             res = await asyncio.to_thread(_render_with_fusion, pipe, req,
                                           lay, cfg, self)
+            if writer is not None:
+                block = np.full((len(ns_names), th, tw), nodata,
+                                np.float32)
+                for i, n in enumerate(ns_names):
+                    if n in res.data:
+                        d = np.asarray(res.data[n])
+                        v = np.asarray(res.valid[n])
+                        block[i] = np.where(v, d, nodata)
+                await asyncio.to_thread(writer.write_region, ox, oy,
+                                        block)
+                return
             for n in ns_names:
                 if n in res.data:
                     out[n][oy:oy + th, ox:ox + tw] = np.asarray(res.data[n])
                     valid[n][oy:oy + th, ox:ox + tw] = \
                         np.asarray(res.valid[n])
-
-        nodata = -9999.0
         # OWS-cluster scale-out (`ows.go:835-872,930-995,1094-1150`):
         # partition the output into contiguous tile-row bands, render
         # band 0 locally and re-enter GetCoverage on peer nodes for the
@@ -512,8 +545,8 @@ class OWSServer:
         nodes = cfg.service_config.ows_cluster_nodes
         local_tiles = list(tiles)
         remote_jobs = []
-        if q is not None and not is_shard and len(nodes) > 1 \
-                and len(tiles) >= 2 * len(nodes):
+        if q is not None and not is_shard and not stream_tif \
+                and len(nodes) > 1 and len(tiles) >= 2 * len(nodes):
             row_starts = sorted({t[2] for t in tiles})
             per = max(1, -(-len(row_starts) // len(nodes)))
             groups = [row_starts[i * per:(i + 1) * per]
@@ -573,13 +606,19 @@ class OWSServer:
             asyncio.gather(*(render_tile(*t) for t in local_tiles),
                            *(fetch_shard(*j) for j in remote_jobs)),
             timeout=lay.wcs_timeout * max(1, len(tiles)))
+        if writer is not None:
+            await asyncio.to_thread(writer.close)
+            fname = f"{lay.name}_{stamp}.tif"
+            asyncio.get_event_loop().call_later(
+                600, lambda: os.path.exists(path) and os.remove(path))
+            return web.FileResponse(writer.path, headers={
+                "Content-Disposition": f'attachment; filename="{fname}"',
+                "Content-Type": "image/geotiff"})
         arrays = {}
         for n in ns_names:
             a = out[n].copy()
             a[~valid[n]] = nodata
             arrays[n] = a
-        gt = GeoTransform.from_bbox(p.bbox, width, height)
-        stamp = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%d%H%M%S")
         if fmt == "dap4":
             body = await asyncio.to_thread(dap4.encode_dap4, ns_names,
                                            arrays)

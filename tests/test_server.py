@@ -528,3 +528,31 @@ class TestServerReviewRegressions:
         after = set(glob.glob(os.path.join(
             env["server"].temp_dir, "wcs_*.tif")))
         assert after == before  # deleted after the response body was read
+
+
+class TestWCSStreaming:
+    def test_large_coverage_streams_to_disk(self, env, tmp_path,
+                                            monkeypatch):
+        """Coverages beyond WCS_STREAM_PIXELS write tiles straight to a
+        GeoTIFFWriter (`ows.go:695,1088-1091` incremental flush) and the
+        result must match the in-RAM path."""
+        import gsky_tpu.server.ows as ows_mod
+        url = (f"/ows?service=WCS&request=GetCoverage&coverage="
+               f"frac_cover&crs=EPSG:3857&bbox={BBOX3857}"
+               f"&width=512&height=512&format=GeoTIFF&time={DATE}")
+        status, _, plain = _get(env, url)
+        assert status == 200
+        monkeypatch.setattr(ows_mod, "WCS_STREAM_PIXELS", 1000)
+        status, _, streamed = _get(env, url)
+        assert status == 200
+        pp = tmp_path / "plain.tif"
+        ps = tmp_path / "stream.tif"
+        pp.write_bytes(plain)
+        ps.write_bytes(streamed)
+        from gsky_tpu.io.geotiff import GeoTIFF
+        with GeoTIFF(str(pp)) as a, GeoTIFF(str(ps)) as b:
+            assert (a.width, a.height, a.count) == \
+                (b.width, b.height, b.count)
+            assert b.nodata == -9999.0
+            for bi in range(1, a.count + 1):
+                np.testing.assert_array_equal(a.read(bi), b.read(bi))
