@@ -25,7 +25,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_BIG = jnp.float32(3.0e38)
+# plain float, NOT jnp.float32(...): a module-level jnp value would
+# initialise a jax backend at import time, which the IO-only decode
+# subprocess must never do (and which hangs if the device link is down)
+_BIG = 3.0e38
 
 
 @functools.partial(jax.jit, static_argnames=("pixel_count",))
@@ -61,7 +64,7 @@ def deciles(data, valid, n_deciles: int):
     data = data.astype(jnp.float32)
     B, N = data.shape
     D = n_deciles
-    buf = jnp.sort(jnp.where(valid, data, _BIG), axis=-1)
+    buf = jnp.sort(jnp.where(valid, data, jnp.float32(_BIG)), axis=-1)
     n = jnp.sum(valid, axis=-1)  # (B,)
     step = n // (D + 1)
     is_even = (n % (D + 1)) == 0

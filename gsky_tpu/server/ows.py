@@ -100,6 +100,9 @@ class OWSServer:
         if sc.worker_nodes:
             from ..worker import WorkerClient
             remote = WorkerClient(sc.worker_nodes)
+            # concurrency cap from the workers' real pool sizes
+            # (`getGrpcPoolSize`, `utils/config.go:1124-1187`)
+            remote.autosize()
         pipe = TilePipeline(self._mas(cfg), remote=remote)
         self._pipelines[nskey] = (settings, pipe)
         return pipe

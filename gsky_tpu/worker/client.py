@@ -75,6 +75,24 @@ class WorkerClient:
             max_workers=conc_per_node * len(nodes),
             thread_name_prefix="gsky-warp-rpc")
 
+    def autosize(self) -> int:
+        """Size the RPC concurrency cap from the workers' actual pool
+        sizes (`getGrpcPoolSize`, `utils/config.go:1124-1187`): the
+        fan-out limit becomes sum(pool_size) across nodes.  Returns the
+        new cap; keeps the configured default when the query fails."""
+        try:
+            total = sum(i.pool_size for i in self.worker_info()
+                        if i.pool_size > 0)
+        except Exception:
+            return self.limiter._sem._value if hasattr(
+                self.limiter, "_sem") else 0
+        if total > 0:
+            self.limiter = ConcLimiter(total)
+            self._fanout.shutdown(wait=False)
+            self._fanout = cf.ThreadPoolExecutor(
+                max_workers=total, thread_name_prefix="gsky-warp-rpc")
+        return total
+
     def _stub(self):
         return self._stubs[next(self._rr) % len(self._stubs)]
 

@@ -26,6 +26,19 @@ import socket
 import sys
 import traceback
 
+# The decode subprocess is host-IO only by design — it must never claim
+# an accelerator (N pool children each grabbing a TPU seat would starve
+# the executor, and a wedged device link would hang child startup).  The
+# container sitecustomize registers the TPU backend at interpreter start
+# regardless of env vars, but backends initialise lazily, so pinning the
+# platform here (before any jax use) keeps the child CPU-only.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - jax-less minimal installs
+    pass
+
 import numpy as np
 
 from . import gskyrpc_pb2 as pb

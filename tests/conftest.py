@@ -10,7 +10,10 @@ as no computation ran yet).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")  # for subprocesses we spawn
+# hard override, not setdefault: the container env pre-sets
+# JAX_PLATFORMS to the TPU backend, and worker-pool subprocesses inherit
+# os.environ — tests must be hermetic on CPU regardless of device state
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 

@@ -286,3 +286,13 @@ def test_crawler_rpc_mode(grpc_worker, archive, capsys):
     rec = json.loads(capsys.readouterr().out.strip())
     assert rec["filename"] == tif
     assert rec["geo_metadata"]
+
+
+def test_client_autosize_from_worker_info(grpc_worker):
+    """getGrpcPoolSize parity: the RPC concurrency cap resizes to the
+    sum of worker pool sizes."""
+    from gsky_tpu.worker import WorkerClient
+    c = WorkerClient([grpc_worker], conc_per_node=3)
+    total = c.autosize()
+    infos = c.worker_info()
+    assert total == sum(i.pool_size for i in infos) > 0
