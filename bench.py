@@ -61,8 +61,30 @@ def build_archive(root):
     return store, utm, paths
 
 
+def _probe_device(timeout_s: float = 90.0) -> bool:
+    """True when the configured accelerator initialises within the
+    timeout.  Probed in a SUBPROCESS because a wedged device link hangs
+    PJRT client creation uninterruptibly; on failure the parent pins
+    jax to CPU so the benchmark still reports a number."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0 and b"ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     t_setup = time.time()
+    if not _probe_device():
+        print(json.dumps({"warning": "accelerator unreachable, "
+                          "benchmarking on CPU fallback"}),
+              file=sys.stderr)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from gsky_tpu.geo.crs import EPSG3857, EPSG4326, parse_crs
