@@ -39,6 +39,34 @@ from .types import DrillResult, GeoDrillRequest
 _BIG = 3.0e38
 
 
+def split_by_years(req: "GeoDrillRequest", year_step: int):
+    """Year-stepped request splitting — the TimeSplitter stage
+    (`processor/date_splitter.go:19-31`): yields copies of ``req``
+    covering consecutive ``year_step``-year windows of its time range
+    (the last window may extend past end_time, as the reference's
+    AddDate loop does).  ``year_step <= 0`` yields the request as is."""
+    import dataclasses
+    import datetime as _dt
+
+    if year_step <= 0 or req.start_time is None or req.end_time is None:
+        yield req
+        return
+
+    def add_years(ts: float, n: int) -> float:
+        d = _dt.datetime.fromtimestamp(ts, _dt.timezone.utc)
+        try:
+            d = d.replace(year=d.year + n)
+        except ValueError:      # Feb 29 -> Mar 1, Go AddDate behaviour
+            d = d.replace(year=d.year + n, month=3, day=1)
+        return d.timestamp()
+
+    t = req.start_time
+    while t < req.end_time:
+        nxt = add_years(t, year_step)
+        yield dataclasses.replace(req, start_time=t, end_time=nxt)
+        t = nxt
+
+
 class DrillPipeline:
     def __init__(self, mas: MASClient):
         self.mas = mas

@@ -358,3 +358,34 @@ class TestFusedBandsRender:
             bands=["total = phot_veg + bare_soil"],
             bbox=TILE_BBOX, crs=EPSG3857, width=64, height=64)
         assert pipe.render_bands_byte(req) is None
+
+
+class TestTimeSplitter:
+    def test_year_step_windows(self):
+        """TimeSplitter parity (`processor/date_splitter.go:19-31`)."""
+        import datetime as dt
+        from gsky_tpu.pipeline.drill import split_by_years
+        from gsky_tpu.pipeline.types import GeoDrillRequest
+        t0 = dt.datetime(2015, 3, 1, tzinfo=dt.timezone.utc).timestamp()
+        t1 = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc).timestamp()
+        req = GeoDrillRequest(collection="/c", bands=["b"],
+                              geometry_wkt="POINT(0 0)",
+                              start_time=t0, end_time=t1)
+        parts = list(split_by_years(req, 2))
+        assert len(parts) == 3
+        assert parts[0].start_time == t0
+        for a, b in zip(parts, parts[1:]):
+            assert b.start_time == a.end_time
+        # last window extends past end_time, as the reference's loop does
+        assert parts[-1].end_time >= t1
+        # other fields preserved
+        assert all(p.collection == "/c" and p.bands == ["b"]
+                   for p in parts)
+
+    def test_no_step_passthrough(self):
+        from gsky_tpu.pipeline.drill import split_by_years
+        from gsky_tpu.pipeline.types import GeoDrillRequest
+        req = GeoDrillRequest(collection="/c", bands=["b"],
+                              geometry_wkt="POINT(0 0)",
+                              start_time=0.0, end_time=1.0)
+        assert list(split_by_years(req, 0)) == [req]
