@@ -402,6 +402,32 @@ def render_scenes_ctrl_many(stack, ctrls, params, scale_params,
             colour_scale))(ctrls, params, scale_params)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step"))
+def warp_scenes_ctrl_scored(stack, ctrl, params, method: str = "near",
+                            n_ns: int = 1,
+                            out_hw: Tuple[int, int] = (256, 256),
+                            step: int = 16):
+    """`warp_scenes_ctrl` that also returns the per-pixel winning
+    priority — one per-source-CRS group dispatch of a multi-CRS mosaic
+    (granule sets spanning UTM zones)."""
+    h, w = out_hw
+    sx = _bilerp_grid(ctrl[0], h, w, step)
+    sy = _bilerp_grid(ctrl[1], h, w, step)
+    return _warp_scenes_scored(stack, sx, sy, params, method, n_ns)
+
+
+@jax.jit
+def combine_scored(canvs, bests):
+    """Combine G partial mosaics by per-pixel priority: canvs
+    (G, n_ns, h, w) f32, bests (G, n_ns, h, w) f32 (-inf = no data) ->
+    (canvases (n_ns, h, w), valids bool)."""
+    idx = jnp.argmax(bests, axis=0)
+    canv = jnp.take_along_axis(canvs, idx[None], axis=0)[0]
+    ok = jnp.max(bests, axis=0) > -jnp.inf
+    return jnp.where(ok, canv, 0.0), ok
+
+
 @functools.partial(jax.jit, static_argnames=("method", "n_ns"))
 def warp_scenes_batch(stack, sxy, params, method: str = "near",
                       n_ns: int = 1):
@@ -432,7 +458,12 @@ def warp_scenes_batch(stack, sxy, params, method: str = "near",
     return _warp_scenes_core(stack, sxy[0], sxy[1], params, method, n_ns)
 
 
-def _warp_scenes_core(stack, sx, sy, params, method: str, n_ns: int):
+def _warp_scenes_scored(stack, sx, sy, params, method: str, n_ns: int):
+    """Core warp + per-namespace mosaic returning (canvases, best) where
+    ``best`` is the winning granule's mosaic priority per pixel (-inf
+    where no granule contributed) — the carrier that lets partial
+    mosaics from several dispatches (e.g. per-source-CRS groups) combine
+    with newest-wins semantics preserved."""
     fn = _METHODS[method]
 
     def per(scene, p):
@@ -450,18 +481,23 @@ def _warp_scenes_core(stack, sx, sy, params, method: str, n_ns: int):
     ns_id = params[:, 10].astype(jnp.int32)
     score = jnp.where(ok, prio[:, None, None], -jnp.inf)
     canv = []
-    vals = []
+    best = []
     for n in range(n_ns):
         member = (ns_id == n)[:, None, None]
         s = jnp.where(member, score, -jnp.inf)
         idx = jnp.argmax(s, axis=0)
-        v = jnp.max(s, axis=0) > -jnp.inf
+        b = jnp.max(s, axis=0)
         c = jnp.take_along_axis(out, idx[None], axis=0)[0]
         # deterministic fill at invalid pixels (encoders key off the mask,
         # but downstream comparisons and file writers see the raw values)
-        canv.append(jnp.where(v, c, 0.0))
-        vals.append(v)
-    return jnp.stack(canv), jnp.stack(vals)
+        canv.append(jnp.where(b > -jnp.inf, c, 0.0))
+        best.append(b)
+    return jnp.stack(canv), jnp.stack(best)
+
+
+def _warp_scenes_core(stack, sx, sy, params, method: str, n_ns: int):
+    canv, best = _warp_scenes_scored(stack, sx, sy, params, method, n_ns)
+    return canv, best > -jnp.inf
 
 
 @functools.partial(jax.jit, static_argnames=("method",))

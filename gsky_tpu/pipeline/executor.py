@@ -20,9 +20,10 @@ import numpy as np
 
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
-from ..ops.warp import (render_scenes_bands_ctrl, render_scenes_ctrl,
-                        warp_gather_batch, warp_mosaic_batch,
-                        warp_scenes_ctrl)
+from ..ops.warp import (combine_scored, render_scenes_bands_ctrl,
+                        render_scenes_ctrl, warp_gather_batch,
+                        warp_mosaic_batch, warp_scenes_ctrl,
+                        warp_scenes_ctrl_scored)
 from .decode import DecodedWindow
 
 # padded source-window shape buckets (H and W independently bucketed)
@@ -207,14 +208,27 @@ class WarpExecutor:
         set is not uniform enough (mixed CRS/dtype/bucket) or a scene is
         uncacheable — callers fall back to the window path.
         """
-        made = self._scene_inputs(granules, ns_ids, prios, dst_gt,
-                                  dst_crs, height, width, cache)
-        if made is None:
+        groups = self._scene_groups(granules, ns_ids, prios, dst_gt,
+                                    dst_crs, height, width, cache)
+        if groups is None:
             return None
-        stack, ctrl, params, step = made
-        return warp_scenes_ctrl(stack, jnp.asarray(ctrl),
-                                jnp.asarray(params), method,
-                                _bucket_pow2(n_ns), (height, width), step)
+        n_pad = _bucket_pow2(n_ns)
+        if len(groups) == 1:
+            stack, ctrl, params, step = groups[0]
+            return warp_scenes_ctrl(stack, jnp.asarray(ctrl),
+                                    jnp.asarray(params), method,
+                                    n_pad, (height, width), step)
+        # multi-CRS granule set (e.g. scenes across UTM zones): one
+        # scored dispatch per source-CRS group, then a per-pixel
+        # priority combine — newest-wins survives the grouping because
+        # each partial carries its winners' priorities
+        parts = [warp_scenes_ctrl_scored(
+                    stack, jnp.asarray(ctrl), jnp.asarray(params),
+                    method, n_pad, (height, width), step)
+                 for stack, ctrl, params, step in groups]
+        canvs = jnp.stack([p[0] for p in parts])
+        bests = jnp.stack([p[1] for p in parts])
+        return combine_scored(canvs, bests)
 
     def render_byte_scenes(self, granules, ns_ids: Sequence[int],
                            prios: Sequence[float], dst_gt: GeoTransform,
@@ -270,6 +284,21 @@ class WarpExecutor:
 
     def _scene_inputs(self, granules, ns_ids, prios, dst_gt, dst_crs,
                       height, width, cache=None):
+        """Single-group scene inputs; None when the granule set is not
+        uniform (the byte fast paths then fall back)."""
+        groups = self._scene_groups(granules, ns_ids, prios, dst_gt,
+                                    dst_crs, height, width, cache)
+        if groups is None or len(groups) != 1:
+            return None
+        return groups[0]
+
+    def _scene_groups(self, granules, ns_ids, prios, dst_gt, dst_crs,
+                      height, width, cache=None):
+        """Device inputs for the fused scene kernels, grouped by
+        (source CRS, bucket shape, dtype): each group gets its own
+        (stack, ctrl, params, step); multi-group sets (granules spanning
+        UTM zones) combine via the scored kernels.  None when any scene
+        is uncacheable."""
         from .scene_cache import default_scene_cache
         cache = cache or default_scene_cache
         scenes = []
@@ -278,45 +307,51 @@ class WarpExecutor:
             if s is None:
                 return None
             scenes.append(s)
-        s0 = scenes[0]
-        if any(s.crs.name() != s0.crs.name() or s.bucket != s0.bucket
-               or s.dtype != s0.dtype for s in scenes[1:]):
-            return None
+        by_key: Dict[tuple, List[int]] = {}
+        for i, s in enumerate(scenes):
+            by_key.setdefault(
+                (s.crs.name(), s.bucket, str(s.dtype)), []).append(i)
 
         step = 16
-        sx, sy = self._ctrl_geo_coords(dst_gt, dst_crs, height, width,
-                                       s0.crs, step)
-        ox, oy = s0.gt.x0, s0.gt.y0
-        ctrl = np.stack([sx - ox, sy - oy]).astype(np.float32)
+        groups = []
+        for idxs in by_key.values():
+            gs = [scenes[i] for i in idxs]
+            s0 = gs[0]
+            sx, sy = self._ctrl_geo_coords(dst_gt, dst_crs, height,
+                                           width, s0.crs, step)
+            ox, oy = s0.gt.x0, s0.gt.y0
+            ctrl = np.stack([sx - ox, sy - oy]).astype(np.float32)
 
-        B = _bucket_pow2(len(scenes))
-        params = np.zeros((B, 11), np.float64)
-        params[:, 10] = -1.0
-        for k, s in enumerate(scenes):
-            gt = s.gt
-            det = gt.dx * gt.dy - gt.rx * gt.ry
-            inv = (gt.dy / det, -gt.rx / det, -gt.ry / det, gt.dx / det)
-            a0 = inv[0] * (ox - gt.x0) + inv[1] * (oy - gt.y0)
-            a3 = inv[2] * (ox - gt.x0) + inv[3] * (oy - gt.y0)
-            params[k, :6] = (a0, inv[0], inv[1], a3, inv[2], inv[3])
-            params[k, 6] = s.height
-            params[k, 7] = s.width
-            params[k, 8] = s.nodata
-            params[k, 9] = prios[k]
-            params[k, 10] = ns_ids[k]
+            B = _bucket_pow2(len(gs))
+            params = np.zeros((B, 11), np.float64)
+            params[:, 10] = -1.0
+            for k, (i, s) in enumerate(zip(idxs, gs)):
+                gt = s.gt
+                det = gt.dx * gt.dy - gt.rx * gt.ry
+                inv = (gt.dy / det, -gt.rx / det, -gt.ry / det,
+                       gt.dx / det)
+                a0 = inv[0] * (ox - gt.x0) + inv[1] * (oy - gt.y0)
+                a3 = inv[2] * (ox - gt.x0) + inv[3] * (oy - gt.y0)
+                params[k, :6] = (a0, inv[0], inv[1], a3, inv[2], inv[3])
+                params[k, 6] = s.height
+                params[k, 7] = s.width
+                params[k, 8] = s.nodata
+                params[k, 9] = prios[i]
+                params[k, 10] = ns_ids[i]
 
-        skey = tuple(id(s.dev) for s in scenes) + (B,)
-        with self._lock:
-            stack = self._stack_cache.get(skey)
-        if stack is None:
-            devs = [s.dev for s in scenes]
-            devs += [devs[0]] * (B - len(devs))
-            stack = jnp.stack(devs)
+            skey = tuple(id(s.dev) for s in gs) + (B,)
             with self._lock:
-                if len(self._stack_cache) > 32:
-                    self._stack_cache.clear()
-                self._stack_cache[skey] = stack
-        return stack, ctrl, params.astype(np.float32), step
+                stack = self._stack_cache.get(skey)
+            if stack is None:
+                devs = [s.dev for s in gs]
+                devs += [devs[0]] * (B - len(devs))
+                stack = jnp.stack(devs)
+                with self._lock:
+                    if len(self._stack_cache) > 32:
+                        self._stack_cache.clear()
+                    self._stack_cache[skey] = stack
+            groups.append((stack, ctrl, params.astype(np.float32), step))
+        return groups
 
 
 # module-level default executor (compile cache shared across requests)
