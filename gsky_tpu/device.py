@@ -1,0 +1,78 @@
+"""Accelerator platform resolution for long-running processes.
+
+The deployment image registers the TPU backend plugin at interpreter
+startup (sitecustomize), so ``JAX_PLATFORMS=cpu`` in the environment
+alone does not stop a later PJRT client creation from touching the
+device link — and a wedged link hangs client creation uninterruptibly.
+Every long-running entry point (gsky-ows, gsky-rpc, bench) therefore
+resolves its platform ONCE at startup through this module:
+
+- ``JAX_PLATFORMS=cpu`` (or ``GSKY_FORCE_CPU=1``) pins CPU immediately
+  via ``jax.config.update`` (the reliable mechanism).
+- Otherwise the accelerator is probed in a SUBPROCESS with a timeout
+  and bounded retries; a dead/wedged link falls back to CPU instead of
+  hanging the server.  The probe result is recorded for metrics/bench
+  reporting.
+
+The reference has no analogue (GDAL is host-only); this is the
+operational price of a device behind a network link.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+_resolved: Optional[dict] = None
+
+
+def probe_device(timeout_s: float = 60.0) -> bool:
+    """True when the configured accelerator initialises within the
+    timeout.  Runs in a subprocess because a wedged device link hangs
+    PJRT client creation uninterruptibly."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0 and b"ok" in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def ensure_platform(retries: int = 2, timeout_s: float = 60.0,
+                    retry_wait_s: float = 5.0) -> dict:
+    """Resolve the jax platform before first device use.  Idempotent;
+    returns {"platform", "probe_attempts", "fallback"}."""
+    global _resolved
+    if _resolved is not None:
+        return _resolved
+
+    want = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    force_cpu = want == "cpu" or os.environ.get("GSKY_FORCE_CPU") == "1"
+    attempts = 0
+    if not force_cpu:
+        ok = False
+        for attempts in range(1, max(1, retries) + 1):
+            if probe_device(timeout_s):
+                ok = True
+                break
+            if attempts <= retries - 1:
+                time.sleep(retry_wait_s)
+        if not ok:
+            force_cpu = True
+
+    import jax
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+        fallback = want != "cpu" and attempts > 0
+    else:
+        platform = jax.devices()[0].platform
+        fallback = False
+    _resolved = {"platform": platform, "probe_attempts": attempts,
+                 "fallback": fallback}
+    return _resolved

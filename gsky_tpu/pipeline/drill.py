@@ -60,16 +60,61 @@ def split_by_years(req: "GeoDrillRequest", year_step: int):
             d = d.replace(year=d.year + n, month=3, day=1)
         return d.timestamp()
 
+    if req.start_time >= req.end_time:
+        # point-in-time query: splitting has nothing to window
+        yield req
+        return
     t = req.start_time
     while t < req.end_time:
+        # clamp: unlike the reference (which chunks an already-filtered
+        # timestamp list), each window here widens a MAS query, so an
+        # unclamped last window would return rows past end_time
         nxt = add_years(t, year_step)
-        yield dataclasses.replace(req, start_time=t, end_time=nxt)
+        yield dataclasses.replace(req, start_time=t,
+                                  end_time=min(nxt, req.end_time))
         t = nxt
+
+
+def merge_results(parts: List["DrillResult"]) -> "DrillResult":
+    """Concatenate per-window DrillResults (windows from
+    `split_by_years` are disjoint, so rows merge by date sort)."""
+    parts = [p for p in parts if p.dates]
+    if not parts:
+        return DrillResult([], {}, {}, [])
+    if len(parts) == 1:
+        return parts[0]
+    names: List[str] = []
+    for p in parts:
+        for n in p.values:
+            if n not in names:
+                names.append(n)
+    rows = {}
+    counts_rows = {}
+    for p in parts:
+        for i, d in enumerate(p.dates):
+            row = rows.setdefault(d, {})
+            crow = counts_rows.setdefault(d, {})
+            for n in p.values:
+                row[n] = p.values[n][i]
+                crow[n] = p.counts.get(n, [0] * len(p.dates))[i]
+    dates = sorted(rows)
+    values = {n: [rows[d].get(n, float("nan")) for d in dates]
+              for n in names}
+    counts = {n: [counts_rows[d].get(n, 0) for d in dates] for n in names}
+    raw = sorted({n for p in parts for n in p.raw_namespaces})
+    return DrillResult(dates, values, counts, raw)
 
 
 class DrillPipeline:
     def __init__(self, mas: MASClient):
         self.mas = mas
+
+    def process_split(self, req: GeoDrillRequest,
+                      year_step: int = 0) -> DrillResult:
+        """TimeSplitter-wired entry: split the request into year-stepped
+        windows, drill each, and merge (`processor/date_splitter.go`)."""
+        return merge_results([self.process(w)
+                              for w in split_by_years(req, year_step)])
 
     def index(self, req: GeoDrillRequest) -> List[Dataset]:
         kw = dict(srs="EPSG:4326", wkt=req.geometry_wkt,

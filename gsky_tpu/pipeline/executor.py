@@ -82,32 +82,72 @@ class WarpExecutor:
 
     def _ctrl_geo_coords(self, dst_gt: GeoTransform, dst_crs: CRS,
                          height: int, width: int, src_crs: CRS,
-                         step: int) -> Tuple[np.ndarray, np.ndarray]:
+                         step: int) -> Tuple[np.ndarray, np.ndarray, int]:
         """Sparse control-point grid: dst pixel centres at every
         ``step``-th row/col projected into src CRS (f64, host).  The
         dense grid is reconstructed on device (`ops.warp._bilerp_grid`),
         GDAL-approx-transformer style, so only ~2 KB of coordinates are
-        uploaded per tile."""
+        uploaded per tile.
+
+        Like GDAL's approx transformer (0.125 px error bound,
+        `worker/gdalprocess/warp.go:219`), the grid is validated once
+        per cache entry against exactly projected cell midpoints; the
+        step halves until the interpolation error is within bound (so
+        strongly nonlinear transforms — polar CRSs — refine instead of
+        silently smearing).  Returns (sx, sy, actual_step)."""
         key = ("ctrl", dst_gt.to_gdal(), dst_crs, height, width, src_crs,
                step)
         with self._lock:
             hit = self._geo_cache.get(key)
         if hit is not None:
             return hit
-        gh = (height - 1 + step - 1) // step + 1
-        gw = (width - 1 + step - 1) // step + 1
-        c = np.arange(gw, dtype=np.float64) * step + 0.5
-        r = np.arange(gh, dtype=np.float64) * step + 0.5
-        C, R = np.meshgrid(c, r)
-        x, y = dst_gt.pixel_to_geo(C, R, np)
-        sx, sy = dst_crs.transform_to(src_crs, x, y, np)
-        sx = np.asarray(sx, np.float64)
-        sy = np.asarray(sy, np.float64)
+        while True:
+            gh = (height - 1 + step - 1) // step + 1
+            gw = (width - 1 + step - 1) // step + 1
+            c = np.arange(gw, dtype=np.float64) * step + 0.5
+            r = np.arange(gh, dtype=np.float64) * step + 0.5
+            C, R = np.meshgrid(c, r)
+            x, y = dst_gt.pixel_to_geo(C, R, np)
+            sx, sy = dst_crs.transform_to(src_crs, x, y, np)
+            sx = np.asarray(sx, np.float64)
+            sy = np.asarray(sy, np.float64)
+            if step <= 2 or self._ctrl_err_px(
+                    sx, sy, dst_gt, dst_crs, src_crs, step) <= 0.125:
+                break
+            step //= 2
         with self._lock:
             if len(self._geo_cache) > 256:
                 self._geo_cache.clear()
-            self._geo_cache[key] = (sx, sy)
-        return sx, sy
+            self._geo_cache[key] = (sx, sy, step)
+        return sx, sy, step
+
+    @staticmethod
+    def _ctrl_err_px(sx: np.ndarray, sy: np.ndarray, dst_gt: GeoTransform,
+                     dst_crs: CRS, src_crs: CRS, step: int) -> float:
+        """Max bilinear-interpolation error of the ctrl grid at cell
+        midpoints, in units of local source-coords-per-dst-pixel."""
+        gh, gw = sx.shape
+        if gh < 2 or gw < 2:
+            return 0.0
+        c = (np.arange(gw - 1, dtype=np.float64) + 0.5) * step + 0.5
+        r = (np.arange(gh - 1, dtype=np.float64) + 0.5) * step + 0.5
+        C, R = np.meshgrid(c, r)
+        x, y = dst_gt.pixel_to_geo(C, R, np)
+        ex, ey = dst_crs.transform_to(src_crs, x, y, np)
+        ix = 0.25 * (sx[:-1, :-1] + sx[:-1, 1:] + sx[1:, :-1]
+                     + sx[1:, 1:])
+        iy = 0.25 * (sy[:-1, :-1] + sy[:-1, 1:] + sy[1:, :-1]
+                     + sy[1:, 1:])
+        du = np.hypot(sx[:-1, 1:] - sx[:-1, :-1],
+                      sy[:-1, 1:] - sy[:-1, :-1]) / step
+        dv = np.hypot(sx[1:, :-1] - sx[:-1, :-1],
+                      sy[1:, :-1] - sy[:-1, :-1]) / step
+        scale = np.maximum(np.maximum(du, dv), 1e-12)
+        with np.errstate(invalid="ignore"):
+            px = np.hypot(np.asarray(ex) - ix, np.asarray(ey) - iy) / scale
+        if not px.size or np.all(np.isnan(px)):
+            return 0.0
+        return float(np.nanmax(px))
 
     def warp_all(self, windows: Sequence[Optional[DecodedWindow]],
                  dst_gt: GeoTransform, dst_crs: CRS, height: int, width: int,
@@ -214,7 +254,7 @@ class WarpExecutor:
             return None
         n_pad = _bucket_pow2(n_ns)
         if len(groups) == 1:
-            stack, ctrl, params, step = groups[0]
+            stack, ctrl, params, step, _ = groups[0]
             return warp_scenes_ctrl(stack, jnp.asarray(ctrl),
                                     jnp.asarray(params), method,
                                     n_pad, (height, width), step)
@@ -225,7 +265,7 @@ class WarpExecutor:
         parts = [warp_scenes_ctrl_scored(
                     stack, jnp.asarray(ctrl), jnp.asarray(params),
                     method, n_pad, (height, width), step)
-                 for stack, ctrl, params, step in groups]
+                 for stack, ctrl, params, step, _ in groups]
         canvs = jnp.stack([p[0] for p in parts])
         bests = jnp.stack([p[1] for p in parts])
         return combine_scored(canvs, bests)
@@ -245,13 +285,15 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, ctrl, params, step = made
+        stack, ctrl, params, step, skey = made
         sp = np.array([offset, scale, clip], np.float32)
         statics = (method, _bucket_pow2(n_ns), (height, width), step,
                    auto, colour_scale)
         from .batcher import batching_enabled
         if batching_enabled():
-            key = (id(stack),) + statics
+            # scene-serial key (not id()): address reuse after eviction
+            # must never coalesce a request into another stack's batch
+            key = skey + statics
             return self._batcher.render(key, stack, ctrl, params, sp,
                                         statics)
         return render_scenes_ctrl(stack, jnp.asarray(ctrl),
@@ -274,7 +316,7 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, ctrl, params, step = made
+        stack, ctrl, params, step, _ = made
         sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
         sel = jnp.asarray(np.asarray(out_sel, np.int32))
         return render_scenes_bands_ctrl(
@@ -312,13 +354,12 @@ class WarpExecutor:
             by_key.setdefault(
                 (s.crs.name(), s.bucket, str(s.dtype)), []).append(i)
 
-        step = 16
         groups = []
         for idxs in by_key.values():
             gs = [scenes[i] for i in idxs]
             s0 = gs[0]
-            sx, sy = self._ctrl_geo_coords(dst_gt, dst_crs, height,
-                                           width, s0.crs, step)
+            sx, sy, step = self._ctrl_geo_coords(dst_gt, dst_crs, height,
+                                                 width, s0.crs, 16)
             ox, oy = s0.gt.x0, s0.gt.y0
             ctrl = np.stack([sx - ox, sy - oy]).astype(np.float32)
 
@@ -339,7 +380,7 @@ class WarpExecutor:
                 params[k, 9] = prios[i]
                 params[k, 10] = ns_ids[i]
 
-            skey = tuple(id(s.dev) for s in gs) + (B,)
+            skey = tuple(s.serial for s in gs) + (B,)
             with self._lock:
                 stack = self._stack_cache.get(skey)
             if stack is None:
@@ -350,7 +391,8 @@ class WarpExecutor:
                     if len(self._stack_cache) > 32:
                         self._stack_cache.clear()
                     self._stack_cache[skey] = stack
-            groups.append((stack, ctrl, params.astype(np.float32), step))
+            groups.append((stack, ctrl, params.astype(np.float32), step,
+                           skey))
         return groups
 
 

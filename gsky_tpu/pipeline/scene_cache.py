@@ -15,8 +15,9 @@ cached (a one-off window read is cheaper than shipping the whole raster).
 
 from __future__ import annotations
 
+import itertools
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -28,6 +29,9 @@ from ..geo.transform import GeoTransform
 from .types import Granule
 
 
+_scene_serial = itertools.count(1)
+
+
 @dataclass
 class DeviceScene:
     dev: jax.Array            # (bh, bw) native dtype, bucket-padded
@@ -36,6 +40,9 @@ class DeviceScene:
     nodata: float             # NaN when absent
     gt: GeoTransform
     crs: CRS
+    # monotonic identity: downstream caches key on this instead of
+    # id(dev), which can be reused after eviction/GC (stale-stack hazard)
+    serial: int = field(default_factory=lambda: next(_scene_serial))
 
     @property
     def bucket(self) -> Tuple[int, int]:

@@ -257,6 +257,9 @@ class ProcessConfig:
     approx: bool = True
     deciles: int = 0
     drill_algorithm: str = ""
+    # year-stepped drill request splitting (TimeSplitter,
+    # `processor/date_splitter.go:19-31`); 0 = no splitting
+    year_step: int = 0
     literal_data: List[Dict] = field(default_factory=list)
     complex_data: List[Dict] = field(default_factory=list)
 
@@ -274,6 +277,7 @@ class ProcessConfig:
             approx=bool(j["approx"]) if j.get("approx") is not None else True,
             deciles=deciles,
             drill_algorithm=da,
+            year_step=int(j.get("year_step") or 0),
             literal_data=list(j.get("literal_data", []) or []),
             complex_data=list(j.get("complex_data", []) or []),
         )

@@ -66,6 +66,13 @@ def main(argv=None):
                              default=str)[:100000])
         return 0
 
+    from ..device import ensure_platform
+    plat = ensure_platform()
+    if plat["fallback"]:
+        print("accelerator unreachable after "
+              f"{plat['probe_attempts']} probe(s); serving on CPU",
+              file=sys.stderr)
+
     metrics = MetricsLogger(args.log_dir, verbose=args.verbose)
     server = OWSServer(watcher, mas_factory, metrics,
                        static_dir=args.static, temp_dir=args.temp_dir)

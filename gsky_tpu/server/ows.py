@@ -509,9 +509,13 @@ class OWSServer:
         writer = None
         if stream_tif:
             from ..io.geotiff import GeoTIFFWriter
-            path = os.path.join(self.temp_dir, f"wcs_{stamp}_{id(p)}.tif")
-            writer = GeoTIFFWriter(path, len(ns_names), height, width,
-                                   np.float32, gt, p.crs, nodata=nodata)
+            # distinct name: `path` is the request path, needed for peer
+            # shard URL construction in fetch_shard
+            stream_path = os.path.join(self.temp_dir,
+                                       f"wcs_{stamp}_{id(p)}.tif")
+            writer = GeoTIFFWriter(stream_path, len(ns_names), height,
+                                   width, np.float32, gt, p.crs,
+                                   nodata=nodata)
 
         async def render_tile(tb, ox, oy, tw, th):
             req = GeoTileRequest(
@@ -605,15 +609,30 @@ class OWSServer:
                               node)
                 await asyncio.gather(*(render_tile(*t) for t in tiles_in))
 
-        await asyncio.wait_for(
-            asyncio.gather(*(render_tile(*t) for t in local_tiles),
-                           *(fetch_shard(*j) for j in remote_jobs)),
-            timeout=lay.wcs_timeout * max(1, len(tiles)))
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*(render_tile(*t) for t in local_tiles),
+                               *(fetch_shard(*j) for j in remote_jobs)),
+                timeout=lay.wcs_timeout * max(1, len(tiles)))
+        except BaseException:
+            # close + unlink the partial stream file on timeout/failure
+            # (ADVICE r1: fd and temp-file leak)
+            if writer is not None:
+                try:
+                    await asyncio.to_thread(writer.close)
+                except Exception:
+                    pass
+                try:
+                    os.remove(stream_path)
+                except OSError:
+                    pass
+            raise
         if writer is not None:
             await asyncio.to_thread(writer.close)
             fname = f"{lay.name}_{stamp}.tif"
             asyncio.get_event_loop().call_later(
-                600, lambda: os.path.exists(path) and os.remove(path))
+                600, lambda: os.path.exists(stream_path)
+                and os.remove(stream_path))
             return web.FileResponse(writer.path, headers={
                 "Content-Disposition": f'attachment; filename="{fname}"',
                 "Content-Type": "image/geotiff"})
@@ -703,8 +722,10 @@ class OWSServer:
                 band_strides=src.band_strides,
                 pixel_count="pixel_count" in proc.drill_algorithm)
             dp = DrillPipeline(self._mas(cfg))
+            # year-stepped splitting (TimeSplitter parity) bounds the
+            # per-window working set for multi-decade drills
             res = await asyncio.wait_for(
-                asyncio.to_thread(dp.process, dreq),
+                asyncio.to_thread(dp.process_split, dreq, proc.year_step),
                 timeout=src.wcs_timeout or 30)
             from ..pipeline.drill import drill_csv
             names = list(res.values)

@@ -187,6 +187,12 @@ def main(argv=None):
     a = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
+    from ..device import ensure_platform
+    plat = ensure_platform()
+    if plat["fallback"]:
+        log.warning("accelerator unreachable after %d probe(s); "
+                    "computing on CPU", plat["probe_attempts"])
+
     svc = WorkerService(pool_size=a.pool or None, task_timeout=a.timeout)
     monitor = None
     if a.oom_threshold:

@@ -390,6 +390,92 @@ class TestTimeSplitter:
                               start_time=0.0, end_time=1.0)
         assert list(split_by_years(req, 0)) == [req]
 
+    def test_merge_results_concatenates_windows(self):
+        from gsky_tpu.pipeline.drill import merge_results
+        from gsky_tpu.pipeline.types import DrillResult
+        a = DrillResult([1.0, 2.0], {"ndvi": [0.1, 0.2]},
+                        {"ndvi": [5, 6]}, ["ndvi"])
+        b = DrillResult([3.0], {"ndvi": [0.3]}, {"ndvi": [7]}, ["ndvi"])
+        m = merge_results([b, a])
+        assert m.dates == [1.0, 2.0, 3.0]
+        assert m.values["ndvi"] == [0.1, 0.2, 0.3]
+        assert m.counts["ndvi"] == [5, 6, 7]
+
+    def test_process_split_runs_one_drill_per_window(self, monkeypatch):
+        """serve_wps drives `process_split`, so a configured year_step
+        must fan the drill out into windowed sub-requests."""
+        import datetime as dt
+        from gsky_tpu.pipeline.drill import DrillPipeline
+        from gsky_tpu.pipeline.types import DrillResult, GeoDrillRequest
+        t0 = dt.datetime(2015, 1, 1, tzinfo=dt.timezone.utc).timestamp()
+        t1 = dt.datetime(2019, 1, 1, tzinfo=dt.timezone.utc).timestamp()
+        req = GeoDrillRequest(collection="/c", bands=["b"],
+                              geometry_wkt="POINT(0 0)",
+                              start_time=t0, end_time=t1)
+        seen = []
+
+        def fake_process(self, r):
+            seen.append((r.start_time, r.end_time))
+            return DrillResult([r.start_time], {"b": [1.0]}, {"b": [1]},
+                               ["b"])
+
+        monkeypatch.setattr(DrillPipeline, "process", fake_process)
+        res = DrillPipeline(mas=None).process_split(req, year_step=2)
+        assert len(seen) == 2
+        assert seen[0][1] == seen[1][0]
+        assert len(res.dates) == 2
+
+
+class TestCtrlGridValidation:
+    """GDAL-approx-transformer parity: the control grid refines (step
+    halves) when bilinear interpolation error exceeds 0.125 px
+    (`worker/gdalprocess/warp.go:219`)."""
+
+    def test_linear_transform_keeps_step(self):
+        from gsky_tpu.geo.crs import parse_crs
+        from gsky_tpu.geo.transform import GeoTransform
+        from gsky_tpu.pipeline.executor import WarpExecutor
+        ex = WarpExecutor()
+        gt = GeoTransform.from_gdal((0.0, 100.0, 0.0, 0.0, 0.0, -100.0))
+        crs = parse_crs("EPSG:3857")
+        _, _, step = ex._ctrl_geo_coords(gt, crs, 256, 256, crs, 16)
+        assert step == 16
+
+    def test_nonlinear_transform_refines_step(self):
+        import numpy as np
+        from gsky_tpu.geo.transform import GeoTransform
+        from gsky_tpu.pipeline.executor import WarpExecutor
+
+        class BendyCRS:
+            """Strongly nonlinear toy projection (quadratic in x)."""
+
+            def transform_to(self, other, x, y, xp=np):
+                return xp.asarray(x) ** 2 / 300.0, xp.asarray(y)
+
+            def __hash__(self):
+                return 42
+
+            def __eq__(self, o):
+                return isinstance(o, BendyCRS)
+
+        ex = WarpExecutor()
+        gt = GeoTransform.from_gdal((0.0, 1.0, 0.0, 0.0, 0.0, -1.0))
+        _, _, step = ex._ctrl_geo_coords(gt, BendyCRS(), 256, 256,
+                                         object(), 16)
+        assert step < 16
+
+    def test_scene_serials_are_unique(self):
+        from gsky_tpu.geo.crs import parse_crs
+        from gsky_tpu.geo.transform import GeoTransform
+        from gsky_tpu.pipeline.scene_cache import DeviceScene
+        import jax.numpy as jnp
+        mk = lambda: DeviceScene(
+            dev=jnp.zeros((4, 4)), height=4, width=4, nodata=0.0,
+            gt=GeoTransform.from_gdal((0, 1, 0, 0, 0, -1)),
+            crs=parse_crs("EPSG:4326"))
+        a, b = mk(), mk()
+        assert a.serial != b.serial
+
 
 class TestMultiCRSMosaic:
     def test_fused_groups_match_window_path(self, tmp_path):
