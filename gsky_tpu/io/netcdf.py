@@ -489,19 +489,56 @@ class _NC3Reader:
                 off = self.begin + t * self.rec_stride
                 raw = self.f.read_at(off, per_rec * self.dt.itemsize)
                 recs.append(np.frombuffer(raw, self.dt).reshape(shape_rest))
-            arr = np.stack(recs) if isinstance(tkey, slice) else recs[0]
-            out = arr[rest] if rest else arr
+            if isinstance(tkey, slice):
+                arr = np.stack(recs)
+                # rest indexes the per-record axes, not the time axis
+                out = arr[(slice(None),) + rest] if rest else arr
+            else:
+                arr = recs[0]
+                out = arr[rest] if rest else arr
         else:
-            total = int(np.prod(var.shape, dtype=np.int64))
-            raw = self.f.read_at(self.begin, total * self.dt.itemsize)
-            arr = np.frombuffer(raw, self.dt).reshape(var.shape)
-            out = arr[key] if key is not None else arr
+            out = self._fixed(key, var)
         out = np.ascontiguousarray(out).astype(self.dt.newbyteorder("="))
         # NetCDF-3 has no unsigned types; honour the _Unsigned convention
         if str(var.attrs.get("_Unsigned", "")).lower() in ("true", "1") \
                 and out.dtype.kind == "i":
             out = out.view(np.dtype(f"u{out.dtype.itemsize}"))
         return out
+
+    def _fixed(self, key, var):
+        """Fixed (non-record) variable read.  Selections on the leading
+        axis read ONLY that byte range — a (T, H, W) stack stored as a
+        fixed var must not materialise all T frames to serve one
+        timestep (the band_query lesson, `netcdfdataset.cpp:6994`)."""
+        itemsize = self.dt.itemsize
+        if key is not None and var.shape:
+            per0 = int(np.prod(var.shape[1:], dtype=np.int64))
+            k0, rest = (key[0], key[1:]) if isinstance(key, tuple) \
+                else (key, ())
+            if isinstance(k0, (int, np.integer)):
+                t = int(k0)
+                if t < 0:
+                    t += var.shape[0]
+                if not 0 <= t < var.shape[0]:
+                    raise IndexError(
+                        f"index {k0} out of range for {var.name}")
+                raw = self.f.read_at(self.begin + t * per0 * itemsize,
+                                     per0 * itemsize)
+                arr = np.frombuffer(raw, self.dt).reshape(var.shape[1:])
+                return arr[rest] if rest else arr
+            if isinstance(k0, slice):
+                lo, hi, step = k0.indices(var.shape[0])
+                if step == 1 and hi > lo:
+                    raw = self.f.read_at(
+                        self.begin + lo * per0 * itemsize,
+                        (hi - lo) * per0 * itemsize)
+                    arr = np.frombuffer(raw, self.dt).reshape(
+                        (hi - lo,) + var.shape[1:])
+                    return arr[(slice(None),) + rest] if rest else arr
+        total = int(np.prod(var.shape, dtype=np.int64))
+        raw = self.f.read_at(self.begin, total * itemsize)
+        arr = np.frombuffer(raw, self.dt).reshape(var.shape)
+        return arr[key] if key is not None else arr
 
 
 # ---------------------------------------------------------------------------

@@ -424,3 +424,54 @@ class TestIOReviewRegressions:
             write_netcdf3(str(tmp_path / "x.nc"),
                           {"t": np.array([[2 ** 40]], np.int64)},
                           np.arange(1.0), np.arange(1.0), EPSG4326)
+
+    def test_nc3_fixed_var_partial_reads(self, tmp_path):
+        """Fixed (non-record) 3-D variables must serve single-timestep
+        and contiguous-range reads WITHOUT materialising the whole
+        variable (regression: whole-stack read per access)."""
+        p = str(tmp_path / "stack.nc")
+        data = np.arange(5 * 4 * 3, dtype=np.float32).reshape(5, 4, 3)
+        times = np.arange(5) * 86400.0
+        write_netcdf3(p, {"v": data}, np.arange(3.0), np.arange(4.0),
+                      EPSG4326, times)
+        with NetCDF(p) as nc:
+            v = nc.variables["v"]
+            reads = []
+            orig = nc._nc3.read_at
+
+            def counting(pos, n):
+                reads.append(n)
+                return orig(pos, n)
+
+            nc._nc3.read_at = counting
+            np.testing.assert_array_equal(v[(2, slice(1, 3), slice(0, 2))],
+                                          data[2, 1:3, 0:2])
+            np.testing.assert_array_equal(v[(slice(1, 4), slice(None),
+                                             slice(None))], data[1:4])
+            np.testing.assert_array_equal(v[(-1, slice(None), slice(None))],
+                                          data[-1])
+            frame = 4 * 3 * 4  # one (y, x) frame in bytes
+            assert reads == [frame, 3 * frame, frame], reads
+            # negative-stride / fancy keys still fall back correctly
+            np.testing.assert_array_equal(
+                v[(slice(None, None, 2), slice(None), slice(None))],
+                data[::2])
+
+    def test_nc3_record_var_slice_spatial_window(self, tmp_path):
+        """Record (unlimited-dim) variables: a slice time key plus
+        spatial window must apply the window per record, not to the
+        time axis (regression)."""
+        from scipy.io import netcdf_file
+        p = str(tmp_path / "rec.nc")
+        data = np.arange(5 * 4 * 3, dtype=np.float32).reshape(5, 4, 3)
+        f = netcdf_file(p, "w")
+        f.createDimension("time", None)
+        f.createDimension("y", 4)
+        f.createDimension("x", 3)
+        v = f.createVariable("v", np.float32, ("time", "y", "x"))
+        v[:] = data
+        f.close()
+        with NetCDF(p) as nc:
+            got = nc.variables["v"][(slice(1, 4), slice(1, 3),
+                                     slice(0, 2))]
+            np.testing.assert_array_equal(got, data[1:4, 1:3, 0:2])
