@@ -148,6 +148,47 @@ class Geometry:
                         polys=[[seg(r) for r in poly] for poly in self.polys],
                         points=self.points)
 
+    # -- antimeridian handling (ST_SplitDatelineWGS84, mas.sql:13-84) -------
+
+    def split_dateline(self) -> "Geometry":
+        """Split polygons whose longitudes span the antimeridian into a
+        MultiPolygon with parts on both sides of ±180 — without this, a
+        zone-60/zone-1 footprint transformed to WGS84 (mixed ±179.x
+        vertices) reads as a sliver wrapped the wrong way around the
+        planet and point/bbox predicates mis-answer on both sides.
+        Reference: `mas/api/mas.sql:13-84` (shift east, clip at the
+        hemisphere boundary, translate the western part back)."""
+        if self.kind not in ("Polygon", "MultiPolygon"):
+            return self
+        out_polys: List[List[Ring]] = []
+        changed = False
+        for poly in self.polys:
+            ext = poly[0]
+            lons = ext[:, 0]
+            if lons.max() - lons.min() <= 180.0:
+                out_polys.append(poly)
+                continue
+            changed = True
+            # ST_ShiftLongitude: extend into 0..360
+            shifted = [r.copy() for r in poly]
+            for r in shifted:
+                r[:, 0] = np.where(r[:, 0] < 0, r[:, 0] + 360.0, r[:, 0])
+            east = [_clip_ring_x(r, 180.0, keep_le=True) for r in shifted]
+            west = [_clip_ring_x(r, 180.0, keep_le=False) for r in shifted]
+            east = [r for r in east if len(r) >= 4]
+            west = [r for r in west if len(r) >= 4]
+            if east:
+                out_polys.append(east)
+            if west:
+                for r in west:
+                    r[:, 0] -= 360.0
+                out_polys.append(west)
+        if not changed:
+            return self
+        if len(out_polys) == 1:
+            return Geometry("Polygon", polys=out_polys)
+        return Geometry("MultiPolygon", polys=out_polys)
+
     # -- serialisation ------------------------------------------------------
 
     def to_wkt(self, ndigits: int = 8) -> str:
@@ -199,6 +240,34 @@ class Geometry:
 # ---------------------------------------------------------------------------
 # internal helpers
 # ---------------------------------------------------------------------------
+
+def _clip_ring_x(ring: Ring, x0: float, keep_le: bool) -> Ring:
+    """Sutherland-Hodgman clip of a ring against the half-plane
+    x <= x0 (or x >= x0), closing the result."""
+    def inside(p):
+        return p[0] <= x0 if keep_le else p[0] >= x0
+
+    def cross(p0, p1):
+        t = (x0 - p0[0]) / (p1[0] - p0[0])
+        return np.array([x0, p0[1] + t * (p1[1] - p0[1])])
+
+    pts = list(ring)
+    if len(pts) and np.array_equal(pts[0], pts[-1]):
+        pts = pts[:-1]
+    out: List[np.ndarray] = []
+    for i, p1 in enumerate(pts):
+        p0 = pts[i - 1]
+        if inside(p1):
+            if not inside(p0):
+                out.append(cross(p0, p1))
+            out.append(np.asarray(p1, np.float64))
+        elif inside(p0):
+            out.append(cross(p0, p1))
+    if len(out) < 3:
+        return np.zeros((0, 2))
+    out.append(out[0])
+    return np.asarray(out, np.float64)
+
 
 def _shoelace(ring: Ring) -> float:
     x, y = ring[:, 0], ring[:, 1]

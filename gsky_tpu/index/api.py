@@ -14,6 +14,8 @@ import argparse
 import asyncio
 import json
 import sys
+import threading
+from collections import OrderedDict
 from typing import Optional
 
 from aiohttp import web
@@ -21,7 +23,41 @@ from aiohttp import web
 from .store import MASStore
 
 
-def build_app(store: MASStore) -> web.Application:
+class ResponseCache:
+    """LRU response cache keyed on the canonical query — the memcached
+    response cache of `mas/api/api.go:43-52,133-137` (keyed md5(URL)
+    there).  Keys carry the store generation, so every ingest
+    invalidates all prior entries."""
+
+    def __init__(self, max_entries: int = 1024):
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, str]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple) -> Optional[str]:
+        with self._lock:
+            body = self._entries.get(key)
+            if body is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return body
+
+    def put(self, key: tuple, body: str):
+        with self._lock:
+            self._entries[key] = body
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+
+def build_app(store: MASStore,
+              cache: Optional[ResponseCache] = None) -> web.Application:
+    cache = cache if cache is not None else ResponseCache()
+
     async def handler(request: web.Request) -> web.Response:
         q = request.query
         form = await request.post() if request.method == "POST" else {}
@@ -30,6 +66,12 @@ def build_app(store: MASStore) -> web.Application:
             return q.get(key) or (form.get(key) if form else None) or default
 
         gpath = request.path
+        key = (store.generation, gpath,
+               tuple(sorted(q.items())),
+               tuple(sorted((k, str(v)) for k, v in form.items())))
+        hit = cache.get(key)
+        if hit is not None:
+            return web.json_response(text=hit)
         try:
             if "intersects" in q:
                 ns = val("namespace")
@@ -64,7 +106,9 @@ def build_app(store: MASStore) -> web.Application:
                     status=400)
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)
-        return web.json_response(result)
+        body = json.dumps(result)
+        cache.put(key, body)
+        return web.json_response(text=body)
 
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app.router.add_route("GET", "/{tail:.*}", handler)

@@ -106,6 +106,9 @@ class MASStore:
             self._conn().commit()
         self._columns = [d[0] for d in self._conn().execute(
             "SELECT * FROM datasets LIMIT 0").description]
+        # bumped on every ingest; response caches key on it so cached
+        # answers die with the data they were computed from
+        self.generation = 0
 
     def _maybe_lock(self):
         import contextlib
@@ -135,6 +138,7 @@ class MASStore:
         path = record.get("filename") or record.get("file_path")
         if not path:
             raise ValueError("record missing filename")
+        self.generation += 1
         with self._maybe_lock():
             return self._ingest_locked(record, path)
 
@@ -152,10 +156,17 @@ class MASStore:
             if poly_wkt:
                 try:
                     g = geom.from_wkt(poly_wkt)
-                    b = g.bbox()
                     if srs:
                         crs = parse_crs(srs)
-                        b = transform_bbox(b, crs, EPSG4326)
+                        if crs != EPSG4326:
+                            g = g.transform(
+                                lambda x, y: crs.transform_to(
+                                    EPSG4326, x, y))
+                    # dateline-crossing footprints index under the bbox
+                    # of their SPLIT parts (reaching +/-180 on each
+                    # side), so the prefilter admits queries near the
+                    # antimeridian on either side
+                    b = g.split_dateline().bbox()
                     bbox4326 = (b.xmin, b.ymin, b.xmax, b.ymax)
                 except (ValueError, KeyError):
                     pass
@@ -210,7 +221,9 @@ class MASStore:
                         g = g.segmentize(seg)
                     g = g.transform(
                         lambda x, y: crs.transform_to(EPSG4326, x, y))
-            q_geom = g
+            # antimeridian-crossing queries split into hemisphere parts
+            # (ST_SplitDatelineWGS84, mas.sql:13-84)
+            q_geom = g.split_dateline()
 
         t_a = parse_time(time) if time else None
         t_b = parse_time(until) if until else None
@@ -247,6 +260,8 @@ class MASStore:
                         if crs != EPSG4326:
                             p = p.transform(lambda x, y: crs.transform_to(
                                 EPSG4326, x, y))
+                    # zone-60/zone-1 footprints: split before testing
+                    p = p.split_dateline()
                     if not _geoms_intersect(p, q_geom):
                         continue
                 except (ValueError, KeyError):

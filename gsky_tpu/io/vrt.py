@@ -1,0 +1,338 @@
+"""VRT (virtual raster) granules: band-math / masking datasets assembled
+from source files at drill time.
+
+Reference behaviour being reproduced (not its implementation):
+`worker/gdalprocess/vrt_manager.go:58-176` materialises user VRT XML —
+auto-filling SRS / raster sizes (incl. fractional scaling) / geotransform
+/ nodata / dtype from the first ``metadata-template`` source — into
+/vsimem so GDAL can open it, and `worker/gdalprocess/drill.go:363-423`
+drills through it with GDAL pixel functions (including Python ones);
+`processor/drill_indexer.go:318-346` renders the per-granule VRT from a
+Jet template with ``{RasterXSize, RasterYSize, Data, Masks}`` context.
+
+Here there is no GDAL: the XML is parsed directly, the metadata template
+fills from the repo's own GeoTIFF/NetCDF readers, and pixel functions
+evaluate as numpy code with GDAL's Python pixel-function signature
+``fn(in_ar, out_ar, xoff, yoff, xsize, ysize, raster_xsize,
+raster_ysize, buf_radius, gt)``.  A second, preferred function language
+``expression`` routes through the jit band-expression compiler
+(`ops.expr`) with sources bound to ``b1..bN``.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..geo.crs import CRS, parse_crs
+from ..geo.transform import GeoTransform
+
+_DTYPES = {
+    "byte": np.uint8, "uint16": np.uint16, "int16": np.int16,
+    "uint32": np.uint32, "int32": np.int32, "float32": np.float32,
+    "float64": np.float64,
+}
+
+
+@dataclass
+class VRTSource:
+    path: str
+    metadata_template: bool = False
+
+
+@dataclass
+class VRTBand:
+    band: int = 1
+    dtype: str = ""
+    nodata: Optional[float] = None
+    pixel_fn_type: str = ""
+    pixel_fn_language: str = ""
+    pixel_fn_code: str = ""
+    sources: List[VRTSource] = field(default_factory=list)
+
+
+@dataclass
+class VRTDataset:
+    """Parsed (and, after `autofill`, materialised) VRT description."""
+
+    raster_x_size: float = 0.0            # fractional before autofill
+    raster_y_size: float = 0.0
+    srs: str = ""
+    geo_transform: Optional[Tuple[float, ...]] = None
+    bands: List[VRTBand] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, xml_text: str) -> "VRTDataset":
+        root = ET.fromstring(xml_text)
+        if root.tag != "VRTDataset":
+            raise ValueError(f"not a VRTDataset: <{root.tag}>")
+        ds = cls(
+            raster_x_size=float(root.get("rasterXSize", 0) or 0),
+            raster_y_size=float(root.get("rasterYSize", 0) or 0),
+            srs=(root.findtext("SRS") or "").strip())
+        gt_text = (root.findtext("GeoTransform") or "").strip()
+        if gt_text:
+            ds.geo_transform = tuple(
+                float(v) for v in gt_text.replace(",", " ").split())
+        for ib, be in enumerate(root.findall("VRTRasterBand")):
+            b = VRTBand(
+                band=int(be.get("band", 0) or 0) or ib + 1,
+                dtype=be.get("dataType", "") or "",
+                pixel_fn_type=(be.findtext("PixelFunctionType") or "").strip(),
+                pixel_fn_language=(be.findtext("PixelFunctionLanguage")
+                                   or "").strip().lower(),
+                pixel_fn_code=be.findtext("PixelFunctionCode") or "")
+            nd = (be.findtext("NoDataValue") or "").strip()
+            if nd:
+                b.nodata = float(nd)
+            for se in be.findall("SimpleSource"):
+                fn = (se.findtext("SourceFilename") or "").strip()
+                if fn:
+                    b.sources.append(VRTSource(
+                        path=fn,
+                        metadata_template=se.get("metadata-template")
+                        == "1"))
+            ds.bands.append(b)
+        if not ds.bands:
+            raise ValueError("VRTDataset has no VRTRasterBand")
+        return ds
+
+    def autofill(self) -> "VRTDataset":
+        """Fill SRS/sizes/geotransform/nodata/dtype from the first
+        metadata-template source (`vrt_manager.go:70-160`), with the
+        reference's fractional-size scaling rules."""
+        src = None
+        band = None
+        for b in self.bands:
+            for s in b.sources:
+                if s.metadata_template:
+                    src, band = s, b
+                    break
+            if src is not None:
+                break
+        if src is None:
+            return self
+
+        meta = _source_meta(src.path)
+        if not self.srs.strip():
+            self.srs = meta["srs"]
+        x_size, y_size = float(meta["width"]), float(meta["height"])
+
+        xs, ys = self.raster_x_size, self.raster_y_size
+        if xs <= 0 and ys <= 0:
+            xs, ys = x_size, y_size
+        else:
+            if 0 < xs < 1:
+                xs = float(int(x_size * xs + 0.5))
+            if 0 < ys < 1:
+                ys = float(int(y_size * ys + 0.5))
+            if xs <= 0 < ys:
+                xs = float(int(ys * x_size / y_size + 0.5))
+            elif ys <= 0 < xs:
+                ys = float(int(xs * y_size / x_size + 0.5))
+        self.raster_x_size = min(max(xs, 1.0), x_size)
+        self.raster_y_size = min(max(ys, 1.0), y_size)
+
+        if self.geo_transform is None:
+            gt = list(meta["geo_transform"])
+            if self.raster_x_size < x_size:
+                gt[1] *= x_size / self.raster_x_size
+            if self.raster_y_size < y_size:
+                gt[5] *= y_size / self.raster_y_size
+            self.geo_transform = tuple(gt)
+
+        if band.nodata is None and meta["nodata"] is not None:
+            band.nodata = meta["nodata"]
+        if not band.dtype:
+            band.dtype = meta["dtype"]
+        return self
+
+
+def _source_meta(path: str) -> dict:
+    from .geotiff import GeoTIFF
+    from .netcdf import NetCDF
+
+    if path.lower().endswith((".nc", ".nc4")):
+        with NetCDF(path) as nc:
+            v = nc.raster_vars()[0]
+            crs = nc.crs(v)
+            gt = nc.geotransform()
+            return {"srs": crs.to_wkt() if crs else "",
+                    "width": v.shape[-1], "height": v.shape[-2],
+                    "geo_transform": gt.to_gdal() if gt else
+                    (0, 1, 0, 0, 0, 1),
+                    "nodata": v.nodata,
+                    "dtype": np.dtype(v.dtype).name.capitalize()}
+    with GeoTIFF(path) as g:
+        return {"srs": g.crs.to_wkt(), "width": g.width,
+                "height": g.height, "geo_transform": g.gt.to_gdal(),
+                "nodata": g.nodata,
+                "dtype": np.dtype(g.dtype).name.capitalize()}
+
+
+class VRTRaster:
+    """Windowed reader over a materialised VRT: sources decode through
+    the repo readers, the band's pixel function combines them."""
+
+    def __init__(self, xml_text: str):
+        self.ds = VRTDataset.parse(xml_text).autofill()
+        if self.ds.geo_transform is None:
+            raise ValueError("VRT has no GeoTransform and no "
+                             "metadata-template source to derive it")
+        self.width = int(self.ds.raster_x_size)
+        self.height = int(self.ds.raster_y_size)
+        self.gt = GeoTransform.from_gdal(self.ds.geo_transform)
+        self.crs: Optional[CRS] = None
+        if self.ds.srs.strip():
+            self.crs = parse_crs(self.ds.srs)
+        b0 = self.ds.bands[0]
+        self.nodata = b0.nodata if b0.nodata is not None else float("nan")
+        self.dtype = _DTYPES.get(b0.dtype.lower(), np.float32)
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def read(self, band: int = 1,
+             window: Optional[Tuple[int, int, int, int]] = None,
+             time_index: Optional[int] = None) -> np.ndarray:
+        """window = (col0, row0, w, h) on the VRT grid."""
+        b = self.ds.bands[band - 1]
+        c0, r0, w, h = window or (0, 0, self.width, self.height)
+        in_ar = [self._read_source(s, c0, r0, w, h, time_index)
+                 for s in b.sources]
+        if not in_ar:
+            raise ValueError(f"VRT band {band} has no sources")
+        if not b.pixel_fn_type:
+            return in_ar[0]
+        out = np.zeros((h, w), _DTYPES.get(b.dtype.lower(), np.float32))
+        if b.pixel_fn_language in ("", "python"):
+            fn = _compile_python_fn(b.pixel_fn_type, b.pixel_fn_code)
+            fn(in_ar, out, c0, r0, w, h, self.width, self.height, 0,
+               tuple(self.ds.geo_transform))
+            return out
+        if b.pixel_fn_language == "expression":
+            from ..ops.expr import parse_band_expressions
+            exprs = parse_band_expressions([b.pixel_fn_code.strip()])
+            env = {f"b{i + 1}": np.asarray(a, np.float32)
+                   for i, a in enumerate(in_ar)}
+            out[:] = np.asarray(exprs.expressions[0](env, xp=np))
+            return out
+        raise ValueError(
+            f"unsupported PixelFunctionLanguage {b.pixel_fn_language!r}")
+
+    def _read_source(self, s: VRTSource, c0, r0, w, h,
+                     time_index: Optional[int]) -> np.ndarray:
+        from .geotiff import GeoTIFF
+        from .netcdf import NetCDF
+
+        is_nc = s.path.lower().endswith((".nc", ".nc4")) \
+            or s.path.upper().startswith("NETCDF:")
+        path, var = s.path, None
+        if ":" in s.path and s.path.upper().startswith("NETCDF:"):
+            parts = s.path.split(":")
+            path = parts[1].strip('"')
+            var = parts[-1].strip('"')
+        if is_nc:
+            with NetCDF(path) as nc:
+                v = nc.variables[var] if var else nc.raster_vars()[0]
+                sh, sw = v.shape[-2], v.shape[-1]
+                sc0, sr0, scw, srh = self._src_window(sw, sh, c0, r0, w, h)
+                data = nc.read_slice(v.name, time_index,
+                                     (sc0, sr0, scw, srh))
+        else:
+            with GeoTIFF(path) as g:
+                sw, sh = g.width, g.height
+                sc0, sr0, scw, srh = self._src_window(sw, sh, c0, r0, w, h)
+                data = g.read(1, (sc0, sr0, scw, srh))
+        if data.shape != (h, w):
+            # VRT grid is a scaled view of the source: nearest resample
+            rr = (np.arange(h) + 0.5) * data.shape[0] / h
+            cc = (np.arange(w) + 0.5) * data.shape[1] / w
+            data = data[np.clip(rr.astype(int), 0, data.shape[0] - 1)
+                        [:, None],
+                        np.clip(cc.astype(int), 0, data.shape[1] - 1)]
+        return data
+
+    def _src_window(self, sw: int, sh: int, c0, r0, w, h):
+        """Map a VRT-grid window onto a (possibly larger) source."""
+        fx = sw / self.width
+        fy = sh / self.height
+        sc0 = int(math.floor(c0 * fx))
+        sr0 = int(math.floor(r0 * fy))
+        scw = max(1, int(math.ceil(w * fx)))
+        srh = max(1, int(math.ceil(h * fy)))
+        scw = min(scw, sw - sc0)
+        srh = min(srh, sh - sr0)
+        return sc0, sr0, scw, srh
+
+
+def _compile_python_fn(name: str, code: str):
+    """GDAL-style Python pixel function: the VRT ships the function body
+    (trusted, server-registered templates — the reference executes these
+    through GDAL's Python pixel functions, `vrt_manager.go` + GDAL
+    gdal_pixfun docs)."""
+    ns: dict = {"np": np, "numpy": np}
+    exec(compile(code, "<vrt-pixel-function>", "exec"), ns)  # noqa: S102
+    fn = ns.get(name)
+    if fn is None:
+        raise ValueError(f"pixel function {name!r} not defined by "
+                         "PixelFunctionCode")
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# per-granule template rendering (`processor/drill_indexer.go:318-346`)
+# ---------------------------------------------------------------------------
+
+_RANGE_RE = re.compile(
+    r"\{\{\s*range\s+(?:\w+\s*:?=\s*)?\.Masks\s*\}\}(.*?)\{\{\s*end\s*\}\}",
+    re.S)
+_FIELD_RE = re.compile(r"\{\{\s*\.?(?:\w+\.)*(\w+)\s*\}\}")
+
+
+def render_vrt(template: str, data_path: str,
+               mask_paths: Sequence[str] = (),
+               raster_x_size: float = 0.0,
+               raster_y_size: float = 0.0) -> str:
+    """Render a WPS VRT template with the reference's context
+    ``{RasterXSize, RasterYSize, Data, Masks}`` — supports the
+    ``{{ .Data.Path }}`` / ``{{ range ... .Masks }}`` subset the shipped
+    templates use (`templates/WPS_VRTs/masks_example.vrt`)."""
+
+    def expand_range(m: "re.Match[str]") -> str:
+        body = m.group(1)
+        return "".join(
+            _FIELD_RE.sub(lambda f: _mask_field(f, p), body)
+            for p in mask_paths)
+
+    def _mask_field(f: "re.Match[str]", path: str) -> str:
+        return path if f.group(1) == "Path" else f.group(0)
+
+    out = _RANGE_RE.sub(expand_range, template)
+
+    def sub_field(m: "re.Match[str]") -> str:
+        name = m.group(1)
+        if name == "Path":
+            return data_path
+        if name == "RasterXSize":
+            return _fmt_size(raster_x_size)
+        if name == "RasterYSize":
+            return _fmt_size(raster_y_size)
+        return m.group(0)
+
+    return _FIELD_RE.sub(sub_field, out)
+
+
+def _fmt_size(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))

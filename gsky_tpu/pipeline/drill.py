@@ -19,6 +19,7 @@ Reference dataflow: DrillIndexer -> GeoDrillGRPC -> DrillMerger
 from __future__ import annotations
 
 import math
+import xml.etree.ElementTree as ET
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
@@ -117,8 +118,11 @@ class DrillPipeline:
                               for w in split_by_years(req, year_step)])
 
     def index(self, req: GeoDrillRequest) -> List[Dataset]:
+        namespaces = list(req.band_exprs.var_list) \
+            + [n for n in req.mask_namespaces
+               if n not in req.band_exprs.var_list]
         kw = dict(srs="EPSG:4326", wkt=req.geometry_wkt,
-                  namespaces=",".join(req.band_exprs.var_list))
+                  namespaces=",".join(namespaces))
         if req.start_time is not None:
             kw["time"] = fmt_time(req.start_time)
         if req.end_time is not None:
@@ -129,14 +133,26 @@ class DrillPipeline:
         datasets = self.index(req)
         g4326 = geom.from_wkt(req.geometry_wkt)
 
+        mask_ds = [d for d in datasets
+                   if d.namespace in set(req.mask_namespaces)]
+        data_ds = [d for d in datasets if d not in mask_ds]
+
         # (namespace, date) -> [(value, count)] accumulated across files
         acc: Dict[Tuple[str, float], List[Tuple[float, int]]] = defaultdict(list)
 
-        for ds in datasets:
+        for ds in data_ds:
             sel = _selected_times(ds, req)
             if not sel:
                 continue
-            if req.approx and ds.means and ds.sample_counts \
+            vrt_xml = None
+            if req.vrt_xml:
+                # per-granule VRT rendering (`drill_indexer.go:318-346`):
+                # masks are the temporally co-registered mask granules
+                from ..io.vrt import render_vrt
+                masks = [m.file_path for m in mask_ds
+                         if _times_match(ds, m)]
+                vrt_xml = render_vrt(req.vrt_xml, ds.file_path, masks)
+            elif req.approx and ds.means and ds.sample_counts \
                     and len(ds.means) >= len(ds.timestamps):
                 # crawler-stats fast path: no file IO at all
                 for ti in sel:
@@ -145,7 +161,7 @@ class DrillPipeline:
                         (float(ds.means[min(ti, len(ds.means) - 1)]),
                          int(ds.sample_counts[min(ti, len(ds.sample_counts) - 1)])))
                 continue
-            stats = _drill_file(ds, sel, g4326, req)
+            stats = _drill_file(ds, sel, g4326, req, vrt_xml=vrt_xml)
             if stats is None:
                 continue
             values, counts, deciles = stats
@@ -158,6 +174,14 @@ class DrillPipeline:
                         (float(deciles[k, d]), 1))
 
         return _merge(acc, req)
+
+
+def _times_match(data: Dataset, mask: Dataset) -> bool:
+    """A mask granule rides with a data granule when their timestamp
+    sets overlap (or either carries none)."""
+    if not data.timestamps or not mask.timestamps:
+        return True
+    return bool(set(data.timestamps) & set(mask.timestamps))
 
 
 def _selected_times(ds: Dataset, req: GeoDrillRequest) -> List[int]:
@@ -174,20 +198,19 @@ def _selected_times(ds: Dataset, req: GeoDrillRequest) -> List[int]:
 
 
 def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
-                req: GeoDrillRequest):
-    """Masked reductions for the selected bands of one file."""
+                req: GeoDrillRequest, vrt_xml: Optional[str] = None):
+    """Masked reductions for the selected bands of one file (or of a
+    rendered VRT wrapping it, `drill.go:363-423`)."""
+    is_vrt = bool(vrt_xml)
+    is_nc = not is_vrt and (
+        ds.file_path.lower().endswith((".nc", ".nc4"))
+        or ds.ds_name.upper().startswith("NETCDF:"))
     try:
-        src_crs = parse_crs(ds.srs) if ds.srs else EPSG4326
-    except ValueError:
-        return None
-    gt = GeoTransform.from_gdal(ds.geo_transform)
-    g = g4326 if src_crs == EPSG4326 else g4326.transform(
-        lambda x, y: EPSG4326.transform_to(src_crs, x, y))
-
-    is_nc = ds.file_path.lower().endswith((".nc", ".nc4")) \
-        or ds.ds_name.upper().startswith("NETCDF:")
-    try:
-        if is_nc:
+        if is_vrt:
+            from ..io.vrt import VRTRaster
+            h = VRTRaster(vrt_xml)
+            H, W = h.height, h.width
+        elif is_nc:
             h = NetCDF(ds.file_path)
             var = ds.ds_name.split(":")[-1].strip('"')
             v = h.variables[var]
@@ -195,10 +218,22 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
         else:
             h = GeoTIFF(ds.file_path)
             H, W = h.height, h.width
-    except (OSError, ValueError, KeyError):
+    except (OSError, ValueError, KeyError, ET.ParseError):
         return None
 
     try:
+        try:
+            if is_vrt and h.crs is not None:
+                src_crs = h.crs
+            else:
+                src_crs = parse_crs(ds.srs) if ds.srs else EPSG4326
+            gt = h.gt if is_vrt else \
+                GeoTransform.from_gdal(ds.geo_transform)
+            g = g4326 if src_crs == EPSG4326 else g4326.transform(
+                lambda x, y: EPSG4326.transform_to(src_crs, x, y))
+        except ValueError:  # unparseable SRS / out-of-domain projection
+            return None
+
         # envelope intersect + ALL_TOUCHED mask burn
         b = g.bbox()
         c0, r0 = gt.geo_to_pixel(b.xmin, b.ymax)
@@ -235,7 +270,11 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
         bands_data = []
         for k in read_idx:
             ti = sel[k]
-            if is_nc:
+            if is_vrt:
+                data = h.read(1, (c0, r0, c1 - c0, r1 - r0),
+                              time_index=ti)
+                nodata = h.nodata
+            elif is_nc:
                 data = h.read_slice(var, ti if len(v.shape) > 2 else None,
                                     (c0, r0, c1 - c0, r1 - r0))
                 nodata = ds.nodata if ds.nodata is not None else v.nodata

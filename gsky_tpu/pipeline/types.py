@@ -125,7 +125,12 @@ class GeoDrillRequest:
     pixel_count: bool = False
     band_strides: int = 1
     approx: bool = True                   # use crawler stats fast path
-    vrt_url: str = ""                     # optional VRT wrapping sources
+    # VRT granules (`drill_indexer.go:318-346`, `vrt_manager.go`):
+    # vrt_url names the template, vrt_xml is its text; rendered
+    # per-granule with {Data, Masks, RasterX/YSize} context
+    vrt_url: str = ""
+    vrt_xml: str = ""
+    mask_namespaces: Sequence[str] = ()   # namespaces feeding .Masks
 
     _exprs: Optional[BandExpressions] = None
 

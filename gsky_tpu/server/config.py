@@ -114,6 +114,9 @@ class Layer:
     legend_path: str = ""
     legend_height: int = DEFAULT_LEGEND_HEIGHT
     legend_width: int = DEFAULT_LEGEND_WIDTH
+    # WPS drill-through-VRT template (`ows.go:1395`, resolved against
+    # the config dir; rendered per granule by the drill pipeline)
+    vrt_url: str = ""
     styles: List["Layer"] = field(default_factory=list)
     input_layers: List["Layer"] = field(default_factory=list)
     overviews: List["Layer"] = field(default_factory=list)
@@ -210,6 +213,7 @@ class Layer:
             legend_path=j.get("legend_path", ""),
             legend_height=i("legend_height", DEFAULT_LEGEND_HEIGHT),
             legend_width=i("legend_width", DEFAULT_LEGEND_WIDTH),
+            vrt_url=j.get("vrt_url", ""),
             styles=[Layer.from_json(s) for s in j.get("styles", []) or []],
             input_layers=[Layer.from_json(s)
                           for s in j.get("input_layers", []) or []],
@@ -299,6 +303,7 @@ class Config:
     service_config: ServiceConfig = field(default_factory=ServiceConfig)
     layers: List[Layer] = field(default_factory=list)
     processes: List[ProcessConfig] = field(default_factory=list)
+    base_dir: str = ""                   # directory of this config.json
 
     def layer(self, name: str) -> Optional[Layer]:
         for l in self.layers:
@@ -466,6 +471,7 @@ def load_config_file(path: str, namespace: str = "") -> Config:
         layers=[Layer.from_json(l) for l in j.get("layers", []) or []],
         processes=[ProcessConfig.from_json(p)
                    for p in j.get("processes", []) or []],
+        base_dir=os.path.dirname(os.path.abspath(path)),
     )
     # styles inherit layer rendering defaults (`config.go:536-600`)
     for lay in cfg.layers:

@@ -714,13 +714,27 @@ class OWSServer:
 
         csv_blocks = []
         for src in proc.data_sources:
+            vrt_xml = ""
+            if src.vrt_url:
+                # drill-through-VRT: load the registered template
+                # (`ows.go:1389-1406` VRTURL -> view.GetTemplate)
+                vp = src.vrt_url if os.path.isabs(src.vrt_url) \
+                    else os.path.join(cfg.base_dir, src.vrt_url)
+                try:
+                    with open(vp) as fp:
+                        vrt_xml = fp.read()
+                except OSError as e:
+                    raise OWSError(f"VRT template {src.vrt_url!r} "
+                                   f"unreadable: {e}")
             dreq = GeoDrillRequest(
                 collection=src.data_source, bands=src.rgb_products,
                 geometry_wkt=g.to_wkt(),
                 start_time=p.start_time, end_time=p.end_time,
                 deciles=proc.deciles, approx=proc.approx,
                 band_strides=src.band_strides,
-                pixel_count="pixel_count" in proc.drill_algorithm)
+                pixel_count="pixel_count" in proc.drill_algorithm,
+                vrt_url=src.vrt_url, vrt_xml=vrt_xml,
+                mask_namespaces=[src.mask.id] if src.mask else ())
             dp = DrillPipeline(self._mas(cfg))
             # year-stepped splitting (TimeSplitter parity) bounds the
             # per-window working set for multi-decade drills

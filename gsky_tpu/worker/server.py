@@ -132,10 +132,12 @@ class WorkerService:
             deciles=9 if sp.deciles else 0,
             pixel_count=sp.pixel_count,
             clip_lower=sp.clip_lower if sp.has_clip else -3.0e38,
-            clip_upper=sp.clip_upper if sp.has_clip else 3.0e38,
-            vrt_url=sp.vrt_xml)
+            clip_upper=sp.clip_upper if sp.has_clip else 3.0e38)
         sel = list(sp.time_indices) or [0]
-        out = _drill_file(ds, sel, geom.from_wkt(sp.geometry_wkt), req)
+        # sp.vrt_xml arrives RENDERED (the client renders per granule,
+        # `drill_indexer.go:340`); drill through the VRT when present
+        out = _drill_file(ds, sel, geom.from_wkt(sp.geometry_wkt), req,
+                          vrt_xml=sp.vrt_xml or None)
         res = pb.Result()
         if out is None:
             return res

@@ -305,3 +305,140 @@ measurements:
         rec = json.loads(capsys.readouterr().out.strip())
         assert {d["namespace"] for d in rec["geo_metadata"]} == \
             {"nbart_red", "fmask"}
+
+
+class TestDatelineSplit:
+    """ST_SplitDatelineWGS84 parity (`mas/api/mas.sql:13-84`):
+    antimeridian-crossing footprints must match queries on BOTH sides
+    of 180 deg."""
+
+    def test_split_geometry(self):
+        from gsky_tpu.geo import geometry as geom
+        g = geom.Geometry.polygon([[(179.0, -36.0), (-179.0, -36.0),
+                                    (-179.0, -35.0), (179.0, -35.0),
+                                    (179.0, -36.0)]])
+        s = g.split_dateline()
+        assert s.kind == "MultiPolygon"
+        assert len(s.polys) == 2
+        # the split parts answer point containment on both sides
+        assert s.contains_point(179.5, -35.5)
+        assert s.contains_point(-179.5, -35.5)
+        assert not s.contains_point(0.0, -35.5)
+        # unsplit, the sliver wraps the wrong way around the planet
+        assert not g.contains_point(179.5, -35.5) \
+            or not g.contains_point(-179.5, -35.5)
+
+    def test_non_crossing_unchanged(self):
+        from gsky_tpu.geo import geometry as geom
+        g = geom.Geometry.polygon([[(148.0, -36.0), (149.0, -36.0),
+                                    (149.0, -35.0), (148.0, -35.0),
+                                    (148.0, -36.0)]])
+        assert g.split_dateline() is g
+
+    def _dateline_store(self, root):
+        """A synthetic Landsat-style footprint straddling 180 deg
+        (zone-60/zone-1 scene), expressed in EPSG:4326."""
+        from gsky_tpu.index import MASStore
+        store = MASStore()
+        store.ingest({
+            "filename": f"{root}/LC08_179E_2020.tif",
+            "file_type": "GeoTIFF",
+            "geo_metadata": [{
+                "ds_name": f"{root}/LC08_179E_2020.tif",
+                "namespace": "b1", "array_type": "Int16",
+                "proj_wkt": "EPSG:4326",
+                "geotransform": [179.0, 0.001, 0, -35.0, 0, -0.001],
+                "x_size": 2000, "y_size": 1000,
+                "polygon": ("POLYGON((179 -36,-179 -36,-179 -35,"
+                            "179 -35,179 -36))"),
+                "timestamps": ["2020-01-10T00:00:00Z"],
+            }],
+        })
+        return store
+
+    def test_footprint_matches_both_sides(self, tmp_path):
+        store = self._dateline_store(str(tmp_path))
+        east = store.intersects(
+            str(tmp_path), srs="EPSG:4326",
+            wkt="POLYGON((179.2 -35.8,179.6 -35.8,179.6 -35.2,"
+                "179.2 -35.2,179.2 -35.8))")
+        west = store.intersects(
+            str(tmp_path), srs="EPSG:4326",
+            wkt="POLYGON((-179.6 -35.8,-179.2 -35.8,-179.2 -35.2,"
+                "-179.6 -35.2,-179.6 -35.8))")
+        away = store.intersects(
+            str(tmp_path), srs="EPSG:4326",
+            wkt="POLYGON((0 -36,1 -36,1 -35,0 -35,0 -36))")
+        assert east["files"] and west["files"]
+        assert not away["files"]
+
+    def test_crossing_query_polygon(self, tmp_path):
+        """A QUERY straddling the dateline must also split."""
+        store = self._dateline_store(str(tmp_path))
+        both = store.intersects(
+            str(tmp_path), srs="EPSG:4326",
+            wkt="POLYGON((179.8 -35.8,-179.8 -35.8,-179.8 -35.2,"
+                "179.8 -35.2,179.8 -35.8))")
+        assert both["files"]
+
+
+class TestResponseCache:
+    """masapi response caching (`mas/api/api.go:43-52`) — LRU keyed on
+    the canonical query, invalidated by ingest generation."""
+
+    def _run(self, app, scenario):
+        """Run async `scenario(get)` against one live TestClient."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def go():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+
+            async def get(path):
+                resp = await client.get(path)
+                return resp.status, await resp.json()
+            try:
+                return await scenario(get)
+            finally:
+                await client.close()
+        return asyncio.new_event_loop().run_until_complete(go())
+
+    def test_hit_and_invalidate(self, archive):
+        from gsky_tpu.index.api import ResponseCache, build_app
+        cache = ResponseCache()
+        app = build_app(archive["store"], cache)
+        url = ("/?intersects&metadata=gdal&srs=EPSG:4326"
+               "&wkt=POLYGON((148 -36,149 -36,149 -35,148 -35,148 -36))")
+
+        async def scenario(get):
+            s1, j1 = await get(url)
+            s2, j2 = await get(url)
+            assert (s1, s2) == (200, 200)
+            assert j1 == j2
+            assert cache.hits == 1 and cache.misses == 1
+            # a different query is a different key
+            s3, _ = await get(url + "&limit=1")
+            assert s3 == 200 and cache.misses == 2
+            # ingest bumps the generation: prior cached key is dead
+            rec = extract(archive["paths"][0])
+            archive["store"].ingest(rec)
+            s4, j4 = await get(url)
+            assert s4 == 200 and cache.misses == 3
+            # re-ingest may reorder rows; same content either way
+            key = lambda d: (d["file_path"], d["namespace"])
+            assert sorted(j4["gdal"], key=key) == \
+                sorted(j1["gdal"], key=key)
+        self._run(app, scenario)
+
+    def test_errors_not_cached(self, archive):
+        from gsky_tpu.index.api import ResponseCache, build_app
+        cache = ResponseCache()
+        app = build_app(archive["store"], cache)
+
+        async def scenario(get):
+            s, _ = await get("/?intersects&srs=EPSG:4326&wkt=NOPE")
+            assert s == 400
+            s, _ = await get("/?intersects&srs=EPSG:4326&wkt=NOPE")
+            assert s == 400
+            assert cache.hits == 0
+        self._run(app, scenario)

