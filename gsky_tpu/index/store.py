@@ -84,6 +84,8 @@ CREATE TABLE IF NOT EXISTS datasets(
 CREATE INDEX IF NOT EXISTS idx_ds_path ON datasets(path);
 CREATE INDEX IF NOT EXISTS idx_ds_bbox ON datasets(xmin, xmax, ymin, ymax);
 CREATE INDEX IF NOT EXISTS idx_ds_ns ON datasets(namespace);
+CREATE TABLE IF NOT EXISTS gsky_meta(k TEXT PRIMARY KEY, v INTEGER);
+INSERT OR IGNORE INTO gsky_meta(k, v) VALUES ('generation', 0);
 """
 
 
@@ -107,8 +109,17 @@ class MASStore:
         self._columns = [d[0] for d in self._conn().execute(
             "SELECT * FROM datasets LIMIT 0").description]
         # bumped on every ingest; response caches key on it so cached
-        # answers die with the data they were computed from
-        self.generation = 0
+        # answers die with the data they were computed from.  Persisted
+        # in sqlite (gsky_meta) so an ingest from ANOTHER process against
+        # the same file DB (e.g. the crawler CLI) also invalidates this
+        # server's cache.
+
+    @property
+    def generation(self) -> int:
+        with self._maybe_lock():
+            row = self._conn().execute(
+                "SELECT v FROM gsky_meta WHERE k = 'generation'").fetchone()
+        return int(row[0]) if row else 0
 
     def _maybe_lock(self):
         import contextlib
@@ -138,9 +149,17 @@ class MASStore:
         path = record.get("filename") or record.get("file_path")
         if not path:
             raise ValueError("record missing filename")
-        self.generation += 1
         with self._maybe_lock():
-            return self._ingest_locked(record, path)
+            try:
+                self._conn().execute(
+                    "UPDATE gsky_meta SET v = v + 1 WHERE k = 'generation'")
+                return self._ingest_locked(record, path)
+            except BaseException:
+                # a half-ingested record must not linger in the open
+                # implicit transaction, where the next successful ingest
+                # would commit it
+                self._conn().rollback()
+                raise
 
     def _ingest_locked(self, record: Dict, path: str) -> int:
         conn = self._conn()

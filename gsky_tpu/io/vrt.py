@@ -281,7 +281,17 @@ def _compile_python_fn(name: str, code: str):
     """GDAL-style Python pixel function: the VRT ships the function body
     (trusted, server-registered templates — the reference executes these
     through GDAL's Python pixel functions, `vrt_manager.go` + GDAL
-    gdal_pixfun docs)."""
+    gdal_pixfun docs).  Gated on GSKY_VRT_ENABLE_PYTHON (default on, the
+    reference's `gdal_init.go` sets GDAL_VRT_ENABLE_PYTHON=YES) so
+    operators can disable arbitrary-code pixel functions on workers whose
+    gRPC port accepts caller-supplied rendered VRT XML; the jit
+    'expression' language path stays available either way."""
+    import os
+    if os.environ.get("GSKY_VRT_ENABLE_PYTHON", "YES").upper() in (
+            "NO", "0", "FALSE", "OFF"):
+        raise ValueError(
+            "Python pixel functions disabled (GSKY_VRT_ENABLE_PYTHON=NO); "
+            "use an 'expression'-language PixelFunctionType instead")
     ns: dict = {"np": np, "numpy": np}
     exec(compile(code, "<vrt-pixel-function>", "exec"), ns)  # noqa: S102
     fn = ns.get(name)

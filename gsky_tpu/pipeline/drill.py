@@ -147,10 +147,37 @@ class DrillPipeline:
             vrt_xml = None
             if req.vrt_xml:
                 # per-granule VRT rendering (`drill_indexer.go:318-346`):
-                # masks are the temporally co-registered mask granules
+                # exactly ONE temporally co-registered mask granule per
+                # requested mask namespace, placed at that namespace's
+                # position in req.mask_namespaces so in_ar band order is
+                # stable for asymmetric pixel functions
+                # (`drill_indexer.go:355-380` places maskGrans[iv] and
+                # errors on duplicates)
                 from ..io.vrt import render_vrt
-                masks = [m.file_path for m in mask_ds
-                         if _times_match(ds, m)]
+                masks = []
+                for ns in req.mask_namespaces:
+                    cands = [m for m in mask_ds
+                             if m.namespace == ns and _times_match(ds, m)]
+                    if len(cands) > 1:
+                        # the reference's group key is (polygon,
+                        # timestamps): spatially tiled mask collections
+                        # produce several temporal matches, of which the
+                        # co-located tile is the right one
+                        same_tile = [m for m in cands
+                                     if m.polygon == ds.polygon]
+                        if len(same_tile) == 1:
+                            cands = same_tile
+                    if len(cands) > 1:
+                        raise ValueError(
+                            f"multiple mask granules for namespace {ns!r} "
+                            f"co-registered with {ds.file_path}")
+                    if not cands:
+                        # count mismatch is an indexer error in the
+                        # reference (`drill_indexer.go:309-315`)
+                        raise ValueError(
+                            f"no mask granule for namespace {ns!r} "
+                            f"co-registered with {ds.file_path}")
+                    masks.append(cands[0].file_path)
                 vrt_xml = render_vrt(req.vrt_xml, ds.file_path, masks)
             elif req.approx and ds.means and ds.sample_counts \
                     and len(ds.means) >= len(ds.timestamps):
