@@ -132,9 +132,13 @@ def mosaic_stack(rasters, nodata_masks, timestamps,
         w[:T] = [weights[i] for i in order]
         return mosaic_weighted(stack, valid, jnp.asarray(w))
     if stack.ndim == 3:
-        from .pallas_tpu import mosaic_first_valid_pallas, use_pallas
-        if use_pallas():
-            return mosaic_first_valid_pallas(stack, valid)
+        from .pallas_tpu import (_MOSAIC_T_MAX, mosaic_first_valid_pallas,
+                                 run_with_fallback)
+        if stack.shape[0] <= _MOSAIC_T_MAX:
+            return run_with_fallback(
+                "mosaic_first_valid",
+                lambda: mosaic_first_valid_pallas(stack, valid),
+                lambda: mosaic_first_valid(stack, valid))
     return mosaic_first_valid(stack, valid)
 
 

@@ -39,8 +39,14 @@ except Exception:  # pragma: no cover
 # spatial block for the mosaic scan (f32 min tile is (8, 128))
 _BLK_H = 128
 _BLK_W = 128
-# pixel chunk for the stats accumulation
+# granule-axis bound for the mosaic kernel's VMEM budget: the block holds
+# (T, 128, 128) f32 + int8 = T * 80 KiB; keep well under the 16 MiB limit
+_MOSAIC_T_MAX = 128
+# pixel chunk / row block for the stats accumulation.  Per-block VMEM:
+# inputs (128, 2048) f32+i8 = 1.25 MiB (x2 for double buffering) plus
+# accumulators (128, 2048) f32+i32 = 2 MiB -> ~4.5 MiB, independent of B.
 _CHUNK = 2048
+_ROWS = 128
 
 
 def use_pallas() -> bool:
@@ -52,6 +58,31 @@ def use_pallas() -> bool:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:  # pragma: no cover
         return False
+
+
+# kernels that failed to compile/run this process: fall back to XLA and
+# stop retrying (a Mosaic compile failure is deterministic per shape, but
+# one bad shape must never take down the pipeline — BENCH_r03 post-mortem)
+_FAILED: set = set()
+
+
+def run_with_fallback(name, pallas_thunk, xla_thunk):
+    """Run `pallas_thunk()` when the Pallas path is enabled and healthy,
+    else `xla_thunk()`.  Any Pallas failure (VMEM OOM, Mosaic lowering
+    bug, relay hiccup) is logged once, the kernel is blacklisted for the
+    process, and the XLA fallback result is returned — callers always get
+    numbers."""
+    if name in _FAILED or not use_pallas():
+        return xla_thunk()
+    try:
+        return pallas_thunk()
+    except Exception as e:  # noqa: BLE001 - any compile/runtime failure
+        _FAILED.add(name)
+        import warnings
+        warnings.warn(
+            f"pallas kernel {name!r} failed; using XLA fallback: "
+            f"{type(e).__name__}: {str(e)[:300]}", stacklevel=2)
+        return xla_thunk()
 
 
 # ---------------------------------------------------------------------------
@@ -113,7 +144,7 @@ def mosaic_first_valid_pallas(stack, valid, interpret: bool = False):
 # ---------------------------------------------------------------------------
 
 def _stats_kernel(data_ref, valid_ref, clip_ref, sum_ref, cnt_ref):
-    j = pl.program_id(0)
+    j = pl.program_id(1)
     x = data_ref[:]
     v = valid_ref[:] != 0
     inclip = v & (x >= clip_ref[0]) & (x <= clip_ref[1])
@@ -131,32 +162,39 @@ def _stats_kernel(data_ref, valid_ref, clip_ref, sum_ref, cnt_ref):
 def masked_stats_pallas(data, valid, clip_lower=-3.0e38, clip_upper=3.0e38,
                         interpret: bool = False):
     """data (B, N) f32, valid (B, N) bool -> (sums (B,), counts (B,)) of
-    valid pixels within [clip_lower, clip_upper].  The pixel axis is
-    streamed through VMEM in chunks; the (B, chunk) partial accumulator
-    is reduced at the end (one tiny XLA sum)."""
+    valid pixels within [clip_lower, clip_upper].  Both axes are tiled:
+    the pixel axis streams through VMEM in `_CHUNK` columns and the band/
+    timestep axis in `_ROWS`-row blocks, so per-block VMEM is a constant
+    ~4.5 MiB regardless of B (the round-3 bench OOM'd holding the full
+    (B, chunk) accumulator for B=1000; see BENCH_r03).  The (Bp, chunk)
+    partial accumulator lives in HBM between grid steps and is reduced at
+    the end (one tiny XLA sum)."""
     B, N = data.shape
     Np = -(-N // _CHUNK) * _CHUNK
-    data = jnp.pad(data.astype(jnp.float32), ((0, 0), (0, Np - N)))
-    valid8 = jnp.pad(valid.astype(jnp.int8), ((0, 0), (0, Np - N)))
+    Bp = -(-B // _ROWS) * _ROWS
+    data = jnp.pad(data.astype(jnp.float32),
+                   ((0, Bp - B), (0, Np - N)))
+    valid8 = jnp.pad(valid.astype(jnp.int8),
+                     ((0, Bp - B), (0, Np - N)))
     clip = jnp.asarray([clip_lower, clip_upper], jnp.float32)
     psum, pcnt = pl.pallas_call(
         _stats_kernel,
-        grid=(Np // _CHUNK,),
+        grid=(Bp // _ROWS, Np // _CHUNK),
         in_specs=[
-            pl.BlockSpec((B, _CHUNK), lambda j: (0, j)),
-            pl.BlockSpec((B, _CHUNK), lambda j: (0, j)),
+            pl.BlockSpec((_ROWS, _CHUNK), lambda b, j: (b, j)),
+            pl.BlockSpec((_ROWS, _CHUNK), lambda b, j: (b, j)),
             pl.BlockSpec(memory_space=getattr(pltpu, "SMEM", None))
             if _HAVE_PLTPU and not interpret else
-            pl.BlockSpec((2,), lambda j: (0,)),
+            pl.BlockSpec((2,), lambda b, j: (0,)),
         ],
         out_specs=[
-            pl.BlockSpec((B, _CHUNK), lambda j: (0, 0)),
-            pl.BlockSpec((B, _CHUNK), lambda j: (0, 0)),
+            pl.BlockSpec((_ROWS, _CHUNK), lambda b, j: (b, 0)),
+            pl.BlockSpec((_ROWS, _CHUNK), lambda b, j: (b, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, _CHUNK), jnp.float32),
-            jax.ShapeDtypeStruct((B, _CHUNK), jnp.int32),
+            jax.ShapeDtypeStruct((Bp, _CHUNK), jnp.float32),
+            jax.ShapeDtypeStruct((Bp, _CHUNK), jnp.int32),
         ],
         interpret=interpret,
     )(data, valid8, clip)
-    return jnp.sum(psum, axis=-1), jnp.sum(pcnt, axis=-1)
+    return jnp.sum(psum, axis=-1)[:B], jnp.sum(pcnt, axis=-1)[:B]

@@ -318,23 +318,30 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
         B = data.shape[0]
         dataf = data.reshape(B, -1)
         validf = valid.reshape(B, -1)
-        from ..ops.pallas_tpu import masked_stats_pallas, use_pallas
-        if use_pallas() and not req.pixel_count:
+        from ..ops.pallas_tpu import masked_stats_pallas, run_with_fallback
+
+        def _via_pallas():
             # VMEM-streamed reduction kernel on TPU backends
             s, c = masked_stats_pallas(
                 jnp.asarray(dataf), jnp.asarray(validf),
                 req.clip_lower, req.clip_upper)
-            counts = np.asarray(c)
-            vals = np.where(counts > 0,
-                            np.asarray(s) / np.maximum(counts, 1), 0.0) \
-                .astype(np.float32)
-        else:
-            vals, counts = D.masked_mean(
+            c = np.asarray(c)
+            v = np.where(c > 0, np.asarray(s) / np.maximum(c, 1),
+                         0.0).astype(np.float32)
+            return v, c
+
+        def _via_xla():
+            v, c = D.masked_mean(
                 jnp.asarray(dataf), jnp.asarray(validf),
                 clip_lower=req.clip_lower, clip_upper=req.clip_upper,
                 pixel_count=req.pixel_count)
-            vals = np.asarray(vals)
-            counts = np.asarray(counts)
+            return np.asarray(v), np.asarray(c)
+
+        if not req.pixel_count:
+            vals, counts = run_with_fallback(
+                "masked_stats", _via_pallas, _via_xla)
+        else:
+            vals, counts = _via_xla()
         if req.deciles:
             dec = np.asarray(D.deciles(jnp.asarray(dataf),
                                        jnp.asarray(validf), req.deciles))
