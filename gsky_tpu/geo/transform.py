@@ -142,6 +142,18 @@ class GeoTransform:
         return GeoTransform(self.x0, self.dx * fx, self.rx * fy,
                             self.y0, self.ry * fx, self.dy * fy)
 
+    def decimated(self, st: int) -> "GeoTransform":
+        """Transform for a [::st, ::st] strided sampling of this grid:
+        decimated pixel k holds the VALUE of full-res pixel k*st, so the
+        origin shifts back by (st-1)/2 pixels to keep sample centres
+        honest (unlike `scaled`, which models extent-preserving
+        block-reduced overviews)."""
+        return GeoTransform(
+            self.x0 - (st - 1) / 2 * (self.dx + self.rx),
+            self.dx * st, self.rx * st,
+            self.y0 - (st - 1) / 2 * (self.ry + self.dy),
+            self.ry * st, self.dy * st)
+
 
 # ---------------------------------------------------------------------------
 # Reprojection of extents

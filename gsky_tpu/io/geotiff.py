@@ -469,6 +469,22 @@ class GeoTIFF:
         return arr.astype(dt.newbyteorder("="), copy=False).reshape(
             rows, cols, samples)
 
+    def pick_overview(self, stride: float):
+        """(fx, fy, ifd) for the coarsest overview whose decimation
+        factor fits under ``stride`` source pixels per destination pixel
+        — the decode-path overview selection of
+        `worker/gdalprocess/warp.go:156-198`.  (1.0, 1.0, None) when
+        full resolution is the right level."""
+        best = None
+        for f, ifd in self.overviews:
+            if f <= stride:
+                best = ifd
+        if best is None:
+            return 1.0, 1.0, None
+        # exact ratios, not the rounded factor: odd-sized rasters have
+        # overview dims like ceil(W/2), and the geotransform must match
+        return self.width / best.width, self.height / best.height, best
+
     def read_window_geo(self, bbox: BBox, band: int = 1):
         """Read the pixel window covering a geographic bbox; returns
         (data, window_gt) or (None, None) when disjoint."""
@@ -524,6 +540,7 @@ class GeoTIFFWriter:
         self.tiles_y = (height + tile_size - 1) // tile_size
         self._lock = threading.Lock()
         self._tiles: dict = {}      # (ty, tx) -> (offset, nbytes)
+        self._ovr: List[dict] = []  # reduced-resolution IFDs-to-be
         self._fp = open(path, "wb")
         self._fp.write(b"II*\0\0\0\0\0")   # IFD offset patched at close
         self._pos = 8
@@ -561,6 +578,34 @@ class GeoTIFFWriter:
                 sub = data[:, max(r0, 0):r0 + ts, max(c0, 0):c0 + ts]
                 if sub.shape[1] and sub.shape[2]:
                     self.write_tile(tx, ty, sub)
+
+    def append_overview(self, data) -> None:
+        """Append one reduced-resolution level: ``data`` is the whole
+        decimated raster, (bands, oh, ow) or (oh, ow).  Tile data is
+        written immediately; the overview IFD (NewSubfileType=1,
+        GDAL-pyramid style) chains after the main IFD at close().  Call
+        in coarsening order before close()."""
+        data = np.asarray(data)
+        if data.ndim == 2:
+            data = data[None]
+        oh, ow = data.shape[1], data.shape[2]
+        ts = self.tile_size
+        txs = (ow + ts - 1) // ts
+        tys = (oh + ts - 1) // ts
+        tiles = {}
+        for ty in range(tys):
+            for tx in range(txs):
+                block = data[:, ty * ts:min((ty + 1) * ts, oh),
+                             tx * ts:min((tx + 1) * ts, ow)] \
+                    .astype(self.dtype)
+                blob = self._encode_block(block)
+                with self._lock:
+                    off = self._pos
+                    self._fp.write(blob)
+                    self._pos += len(blob)
+                tiles[(ty, tx)] = (off, len(blob))
+        self._ovr.append({"h": oh, "w": ow, "tiles": tiles,
+                          "tiles_x": txs, "tiles_y": tys})
 
     def close(self) -> None:
         if self._closed:
@@ -645,7 +690,47 @@ class GeoTIFFWriter:
                      [self._tiles[k][1] for k in order]))
         tags.sort(key=lambda t: t[0])
 
-        pos = self._pos
+        ifd_off, next_ptr = self._write_ifd(tags)
+        fp.seek(4)
+        fp.write(struct.pack(e + "I", ifd_off))
+        fp.seek(self._pos)
+
+        # reduced-resolution IFD chain (GDAL pyramid layout)
+        for ov in self._ovr:
+            ord_o = [(ty, tx) for ty in range(ov["tiles_y"])
+                     for tx in range(ov["tiles_x"])]
+            otags = [
+                (T_NEWSUBFILETYPE, 4, [1]),
+                (T_WIDTH, 3, [ov["w"]]),
+                (T_HEIGHT, 3, [ov["h"]]),
+                (T_BITS, 3, [dt.itemsize * 8] * bands),
+                (T_COMPRESSION, 3,
+                 [COMP_DEFLATE if self.compress else COMP_NONE]),
+                (T_PHOTOMETRIC, 3, [1]),
+                (T_SAMPLES, 3, [bands]),
+                (T_PLANAR, 3, [1]),
+                (T_TILE_W, 3, [self.tile_size]),
+                (T_TILE_H, 3, [self.tile_size]),
+                (T_SAMPLE_FORMAT, 3, [fmt_code] * bands),
+                (T_TILE_OFFSETS, 4,
+                 [ov["tiles"][k][0] for k in ord_o]),
+                (T_TILE_COUNTS, 4,
+                 [ov["tiles"][k][1] for k in ord_o]),
+            ]
+            otags.sort(key=lambda t: t[0])
+            o_off, o_next = self._write_ifd(otags)
+            fp.seek(next_ptr)
+            fp.write(struct.pack(e + "I", o_off))
+            fp.seek(self._pos)
+            next_ptr = o_next
+        fp.close()
+
+    def _write_ifd(self, tags) -> Tuple[int, int]:
+        """Pack + write one IFD (out-of-line values first) at the current
+        end of file.  Returns (ifd offset, file offset of its next-IFD
+        pointer, which is left as 0)."""
+        e = "<"
+        fp = self._fp
         blobs2 = []
         entries = []
         for tag, typ, vals in tags:
@@ -661,7 +746,7 @@ class GeoTIFFWriter:
                                 None))
             else:
                 entries.append((tag, typ, cnt, None, data_b))
-        ool_pos = pos
+        ool_pos = self._pos
         for i, (tag, typ, cnt, inline, data_b) in enumerate(entries):
             if data_b is not None:
                 entries[i] = (tag, typ, cnt,
@@ -674,10 +759,10 @@ class GeoTIFFWriter:
         fp.write(struct.pack(e + "H", len(entries)))
         for tag, typ, cnt, inline, _ in entries:
             fp.write(struct.pack(e + "HHI", tag, typ, cnt) + inline)
+        next_ptr = ifd_off + 2 + 12 * len(entries)
         fp.write(struct.pack(e + "I", 0))
-        fp.seek(4)
-        fp.write(struct.pack(e + "I", ifd_off))
-        fp.close()
+        self._pos = next_ptr + 4
+        return ifd_off, next_ptr
 
     def __enter__(self):
         return self
@@ -688,9 +773,17 @@ class GeoTIFFWriter:
 
 def write_geotiff(path: str, data, gt: GeoTransform, crs: CRS,
                   nodata: Optional[float] = None, tile_size: int = 256,
-                  compress: bool = True):
+                  compress: bool = True,
+                  overviews: Sequence[int] = ()):
     """Write a (H, W) or (bands, H, W) array (or sequence of 2D bands)
-    as a tiled GeoTIFF via the streaming writer."""
+    as a tiled GeoTIFF via the streaming writer.  ``overviews`` lists
+    decimation factors (e.g. (2, 4, 8)) to embed as reduced-resolution
+    IFDs, sampled nearest (GDAL's default overview resampling) so
+    values — including nodata — pass through exactly.  Samples are taken
+    at block CENTRES (offset f//2), because readers georeference
+    overviews extent-preservingly (`GeoTransform.scaled`): top-left
+    sampling would misregister every overview render by (f-1)/2 source
+    pixels, centre sampling by at most half of one."""
     if isinstance(data, np.ndarray) and data.ndim == 2:
         data = data[None]
     bands = len(data)
@@ -707,4 +800,10 @@ def write_geotiff(path: str, data, gt: GeoTransform, crs: CRS,
             block = np.stack([np.asarray(b)[ty * ts:r1, tx * ts:c1]
                               for b in data]).astype(dt)
             w.write_tile(tx, ty, block)
+    for f in sorted(overviews):
+        if f < 2 or H // f < 1 or W // f < 1:
+            continue
+        w.append_overview(np.stack(
+            [np.asarray(b)[f // 2::f, f // 2::f][:H // f, :W // f]
+             for b in data]).astype(dt))
     w.close()

@@ -301,14 +301,21 @@ class NetCDF:
         return cf_times_to_unix(np.asarray(tv[:]), units)
 
     def read_slice(self, var_name: str, time_index: Optional[int] = None,
-                   window: Optional[Tuple[int, int, int, int]] = None) -> np.ndarray:
+                   window: Optional[Tuple[int, int, int, int]] = None,
+                   step: int = 1) -> np.ndarray:
         """The band_query analogue: one (y, x) hyperslab of one timestep.
-        window = (col0, row0, w, h)."""
+        window = (col0, row0, w, h), in FULL-resolution pixels.  With
+        ``step`` > 1, every step-th pixel is returned — the NetCDF
+        analogue of GeoTIFF overview reads for zoomed-out requests (no
+        precomputed pyramids in the format, so this decimates on read)."""
         v = self.variables[var_name]
         if window is not None:
             c0, r0, w, h = window
-            ys = slice(r0, r0 + h)
-            xs = slice(c0, c0 + w)
+            ys = slice(r0, r0 + h, step if step > 1 else None)
+            xs = slice(c0, c0 + w, step if step > 1 else None)
+        elif step > 1:
+            ys = slice(None, None, step)
+            xs = slice(None, None, step)
         else:
             ys = slice(None)
             xs = slice(None)

@@ -135,9 +135,13 @@ def mosaic_stack(rasters, nodata_masks, timestamps,
         from .pallas_tpu import (_MOSAIC_T_MAX, mosaic_first_valid_pallas,
                                  run_with_fallback)
         if stack.shape[0] <= _MOSAIC_T_MAX:
+            # materialise inside the thunk: jit dispatch is async, so a
+            # runtime kernel fault would otherwise surface downstream,
+            # past the fallback's try/except
             return run_with_fallback(
                 "mosaic_first_valid",
-                lambda: mosaic_first_valid_pallas(stack, valid),
+                lambda: jax.block_until_ready(
+                    mosaic_first_valid_pallas(stack, valid)),
                 lambda: mosaic_first_valid(stack, valid))
     return mosaic_first_valid(stack, valid)
 

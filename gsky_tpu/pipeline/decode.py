@@ -74,10 +74,33 @@ def margin_for(resample: str) -> int:
     return {"near": 1, "nearest": 1, "bilinear": 2, "cubic": 3}.get(resample, 2)
 
 
+def dst_stride_px(gt: GeoTransform, src_bbox: BBox,
+                  dst_hw: Optional[Tuple[int, int]]) -> float:
+    """Source pixels stepped per destination pixel for this request —
+    the quantity GDAL's warper derives to select an overview level
+    (`worker/gdalprocess/warp.go:156-198`).  Conservative (min of the
+    two axes) so the chosen level always meets the finer axis."""
+    if dst_hw is None:
+        return 1.0
+    th, tw = dst_hw
+    if not tw or not th or not gt.dx or not gt.dy:
+        return 1.0
+    sx = abs(src_bbox.width / gt.dx) / tw
+    sy = abs(src_bbox.height / gt.dy) / th
+    return max(1.0, min(sx, sy))
+
+
 def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
-                  resample: str = "near") -> Optional[DecodedWindow]:
+                  resample: str = "near",
+                  dst_hw: Optional[Tuple[int, int]] = None
+                  ) -> Optional[DecodedWindow]:
     """Read the source window covering dst_bbox (+ resample margin).
-    Returns None when the granule doesn't intersect the tile."""
+    Returns None when the granule doesn't intersect the tile.
+
+    With ``dst_hw`` = (height, width) of the destination tile, zoomed-out
+    requests read from the coarsest sufficient overview (GeoTIFF pyramid
+    IFDs) or a strided hyperslab (NetCDF) instead of full resolution —
+    `worker/gdalprocess/warp.go:156-198`."""
     src_crs = parse_crs(granule.srs) if granule.srs else dst_crs
     gt = GeoTransform.from_gdal(granule.geo_transform)
     try:
@@ -87,25 +110,56 @@ def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
 
     margin = margin_for(resample)
     h = _handles.get(granule.path, granule.is_netcdf)
+    stride = dst_stride_px(gt, src_bbox, dst_hw)
     if granule.is_netcdf:
         v = h.variables.get(granule.var_name)
         if v is None:
             return None
         H, W = v.shape[-2], v.shape[-1]
-        win = _pixel_window(gt, src_bbox, W, H, margin)
-        if win is None:
-            return None
-        c0, r0, w, ww = win
-        data = h.read_slice(granule.var_name, granule.time_index,
-                            (c0, r0, w, ww))
+        st = int(stride) if stride >= 2.0 else 1
+        if st > 1 and (H // st < 2 or W // st < 2):
+            st = 1
+        if st > 1:
+            Ho, Wo = H // st, W // st
+            gt_ov = gt.decimated(st)
+            win = _pixel_window(gt_ov, src_bbox, Wo, Ho, margin)
+            if win is None:
+                return None
+            c0, r0, w, ww = win
+            data = h.read_slice(granule.var_name, granule.time_index,
+                                (c0 * st, r0 * st, w * st, ww * st),
+                                step=st)
+            gt = gt_ov
+            win = (c0, r0, w, ww)
+        else:
+            win = _pixel_window(gt, src_bbox, W, H, margin)
+            if win is None:
+                return None
+            c0, r0, w, ww = win
+            data = h.read_slice(granule.var_name, granule.time_index,
+                                (c0, r0, w, ww))
         nodata = granule.nodata if granule.nodata is not None else v.nodata
     else:
         W, H = h.width, h.height
-        win = _pixel_window(gt, src_bbox, W, H, margin)
-        if win is None:
-            return None
-        c0, r0, w, ww = win
-        data = h.read(granule.band, (c0, r0, w, ww))
+        fx = fy = 1.0
+        ovr = None
+        if stride >= 2.0 and h.overviews:
+            fx, fy, ovr = h.pick_overview(stride)
+        if ovr is not None:
+            gt_ov = gt.scaled(fx, fy)
+            win = _pixel_window(gt_ov, src_bbox, ovr.width, ovr.height,
+                                margin)
+            if win is None:
+                return None
+            c0, r0, w, ww = win
+            data = h.read(granule.band, (c0, r0, w, ww), ifd=ovr)
+            gt = gt_ov
+        else:
+            win = _pixel_window(gt, src_bbox, W, H, margin)
+            if win is None:
+                return None
+            c0, r0, w, ww = win
+            data = h.read(granule.band, (c0, r0, w, ww))
         nodata = granule.nodata if granule.nodata is not None else h.nodata
     window_gt = gt.window(win[0], win[1])
     valid = nodata_mask(data, nodata)
@@ -130,19 +184,21 @@ def _pixel_window(gt: GeoTransform, bbox: BBox, W: int, H: int,
 
 
 def decode_all(granules: List[Granule], dst_bbox: BBox, dst_crs: CRS,
-               resample: str = "near",
-               workers: int = 8) -> List[Optional[DecodedWindow]]:
+               resample: str = "near", workers: int = 8,
+               dst_hw: Optional[Tuple[int, int]] = None
+               ) -> List[Optional[DecodedWindow]]:
     """Decode all granule windows concurrently, preserving order."""
     if not granules:
         return []
     with cf.ThreadPoolExecutor(min(workers, len(granules))) as ex:
         return list(ex.map(
-            lambda g: _safe_decode(g, dst_bbox, dst_crs, resample), granules))
+            lambda g: _safe_decode(g, dst_bbox, dst_crs, resample, dst_hw),
+            granules))
 
 
-def _safe_decode(g, dst_bbox, dst_crs, resample):
+def _safe_decode(g, dst_bbox, dst_crs, resample, dst_hw=None):
     try:
-        return decode_window(g, dst_bbox, dst_crs, resample)
+        return decode_window(g, dst_bbox, dst_crs, resample, dst_hw)
     except Exception:
         # failures degrade to an empty granule, not a failed request
         # (EmptyTile sentinel behaviour, `tile_indexer.go:106,211,307`)

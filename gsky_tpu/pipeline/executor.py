@@ -149,6 +149,30 @@ class WarpExecutor:
             return 0.0
         return float(np.nanmax(px))
 
+    def _granule_stride(self, g, dst_gt: GeoTransform, dst_crs: CRS,
+                        height: int, width: int) -> float:
+        """Source pixels stepped per dst pixel for a granule under this
+        request — drives overview-level selection in the scene cache
+        (`worker/gdalprocess/warp.go:156-198`).  Reuses the cached ctrl
+        grid, so the cost after the first call per (dst, src CRS) is a
+        few medians."""
+        from ..geo.crs import parse_crs
+        try:
+            src_crs = parse_crs(g.srs) if g.srs else None
+            if src_crs is None:
+                return 1.0
+            sx, sy, step = self._ctrl_geo_coords(dst_gt, dst_crs, height,
+                                                 width, src_crs, 16)
+            ggt = GeoTransform.from_gdal(g.geo_transform)
+            col, row = ggt.geo_to_pixel(sx, sy, np)
+            with np.errstate(invalid="ignore"):
+                dr = np.nanmedian(np.abs(np.diff(row, axis=0))) / step
+                dc = np.nanmedian(np.abs(np.diff(col, axis=1))) / step
+            stride = min(float(dr), float(dc))
+            return stride if np.isfinite(stride) and stride > 1.0 else 1.0
+        except Exception:
+            return 1.0
+
     def warp_all(self, windows: Sequence[Optional[DecodedWindow]],
                  dst_gt: GeoTransform, dst_crs: CRS, height: int, width: int,
                  method: str = "near") -> List[Optional[Tuple[np.ndarray, np.ndarray]]]:
@@ -345,7 +369,8 @@ class WarpExecutor:
         cache = cache or default_scene_cache
         scenes = []
         for g in granules:
-            s = cache.get(g)
+            s = cache.get(g, self._granule_stride(g, dst_gt, dst_crs,
+                                                  height, width))
             if s is None:
                 return None
             scenes.append(s)

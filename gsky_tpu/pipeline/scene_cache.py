@@ -71,12 +71,46 @@ class SceneCache:
     def _key(self, g: Granule) -> tuple:
         return (g.path, g.band, g.var_name, g.time_index)
 
-    def get(self, g: Granule) -> Optional[DeviceScene]:
+    def _pick_level(self, g: Granule, stride: float) -> int:
+        """Decimation level to cache for a request stepping ``stride``
+        source pixels per dst pixel: the coarsest GeoTIFF overview that
+        fits, or a power-of-two read stride for NetCDF (quantised so a
+        zoom sweep shares cache entries instead of one per stride)."""
+        if stride < 2.0:
+            return 1
+        try:
+            from .decode import _handles
+            h = _handles.get(g.path, g.is_netcdf)
+            if g.is_netcdf:
+                v = h.variables.get(g.var_name)
+                H, W = (v.shape[-2], v.shape[-1]) if v is not None \
+                    else (2, 2)
+                lv = 1
+                while lv * 2 <= stride and H // (lv * 2) >= 2 \
+                        and W // (lv * 2) >= 2:
+                    lv *= 2
+                return lv
+            best = 1
+            for f, _ in h.overviews:
+                if f <= stride:
+                    best = f
+            return best
+        except Exception:
+            return 1
+
+    def get(self, g: Granule,
+            stride: float = 1.0) -> Optional[DeviceScene]:
         """Cached scene for a granule, decoding + uploading on first use.
         Returns None when the scene is uncacheable (too big / unreadable).
         Concurrent requests for the same scene decode once (per-key
-        latch), not once per tile."""
-        key = self._key(g)
+        latch), not once per tile.
+
+        ``stride`` (source px per dst px) selects the cached resolution:
+        zoomed-out requests get the overview/decimated level — which also
+        makes scenes above ``max_scene_px`` cacheable once the level
+        fits (`worker/gdalprocess/warp.go:156-198`)."""
+        level = self._pick_level(g, stride)
+        key = self._key(g) + (level,)
         while True:
             with self._lock:
                 hit = self._scenes.get(key)
@@ -92,7 +126,7 @@ class SceneCache:
 
         scene = None
         try:
-            scene = self._load(g)
+            scene = self._load(g, level)
             if scene is not None:
                 nbytes = int(np.prod(scene.bucket)) * scene.dtype.itemsize
                 with self._lock:
@@ -110,8 +144,9 @@ class SceneCache:
                 self._inflight.pop(key).set()
         return scene
 
-    def _load(self, g: Granule) -> Optional[DeviceScene]:
+    def _load(self, g: Granule, level: int = 1) -> Optional[DeviceScene]:
         from .decode import _handles
+        gt = GeoTransform.from_gdal(g.geo_transform)
         try:
             h = _handles.get(g.path, g.is_netcdf)
             if g.is_netcdf:
@@ -119,19 +154,30 @@ class SceneCache:
                 if v is None:
                     return None
                 H, W = v.shape[-2], v.shape[-1]
-                if H * W > self._max_scene_px:
+                st = level if level > 1 and H // level >= 2 \
+                    and W // level >= 2 else 1
+                if (H // st) * (W // st) > self._max_scene_px:
                     return None
-                data = h.read_slice(g.var_name, g.time_index, (0, 0, W, H))
+                Ho, Wo = H // st, W // st
+                data = h.read_slice(g.var_name, g.time_index,
+                                    (0, 0, Wo * st, Ho * st), step=st)
+                if st > 1:
+                    gt = gt.decimated(st)
                 nodata = g.nodata if g.nodata is not None else v.nodata
             else:
                 W, H = h.width, h.height
+                ovr = None
+                if level > 1:
+                    fx, fy, ovr = h.pick_overview(float(level))
+                if ovr is not None:
+                    gt = gt.scaled(fx, fy)
+                    W, H = ovr.width, ovr.height
                 if H * W > self._max_scene_px:
                     return None
-                data = h.read(g.band, (0, 0, W, H))
+                data = h.read(g.band, (0, 0, W, H), ifd=ovr)
                 nodata = g.nodata if g.nodata is not None else h.nodata
         except Exception:
             return None
-        gt = GeoTransform.from_gdal(g.geo_transform)
         crs = parse_crs(g.srs) if g.srs else None
         if crs is None:
             return None

@@ -103,7 +103,7 @@ class TilePipeline:
             len(ns_names), req.resample)
         if sc is None:
             ws = decode_all(granules, req.bbox, req.crs, req.resample,
-                            self.decode_workers)
+                            self.decode_workers, dst_hw=(H, W))
             live = [(g, w) for g, w in zip(granules, ws) if w is not None]
             if not live:
                 return _empty_result(exprs, H, W)
@@ -236,7 +236,8 @@ class TilePipeline:
                                            req, method)
             else:
                 ws = decode_all([granules[i] for i in idxs], req.bbox,
-                                req.crs, method, self.decode_workers)
+                                req.crs, method, self.decode_workers,
+                                dst_hw=(H, W))
                 wr = self.executor.warp_all(ws, req.dst_gt(), req.crs, H, W,
                                             method)
             for k, i in enumerate(idxs):

@@ -60,7 +60,8 @@ def _do_decode(task: pb.Task) -> pb.Result:
     dst_bbox = dst_gt.bbox(d.width, d.height)
     dst_crs = parse_crs(d.srs)
     res = pb.Result()
-    w = decode_window(g, dst_bbox, dst_crs, d.resample or "near")
+    w = decode_window(g, dst_bbox, dst_crs, d.resample or "near",
+                      dst_hw=(d.height, d.width))
     if w is None:
         return res
     pack_raster(res, w.data, w.valid)

@@ -88,9 +88,9 @@ def test_scene_cache_single_decode_under_contention(archive):
     loads = []
     orig = cache._load
 
-    def counting_load(granule):
+    def counting_load(granule, level=1):
         loads.append(granule.path)
-        return orig(granule)
+        return orig(granule, level)
 
     cache._load = counting_load
     out = [None] * 16

@@ -475,3 +475,158 @@ class TestIOReviewRegressions:
             got = nc.variables["v"][(slice(1, 4), slice(1, 3),
                                      slice(0, 2))]
             np.testing.assert_array_equal(got, data[1:4, 1:3, 0:2])
+
+
+class TestOverviews:
+    """Embedded reduced-resolution IFDs: writer round-trip + selection
+    (`worker/gdalprocess/warp.go:156-198` decode-path overview use)."""
+
+    def _with_ovr(self, tmp_path, shape=(400, 300), factors=(2, 4)):
+        rng = np.random.default_rng(3)
+        data = rng.uniform(0, 3000, shape).astype(np.int16)
+        data[:32, :32] = -999
+        gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+        p = str(tmp_path / "ovr.tif")
+        write_geotiff(p, data, gt, parse_crs("EPSG:32755"), nodata=-999,
+                      overviews=factors)
+        return p, data
+
+    def test_roundtrip_factors_and_pixels(self, tmp_path):
+        p, data = self._with_ovr(tmp_path)
+        H, W = data.shape
+        with GeoTIFF(p) as g:
+            assert [f for f, _ in g.overviews] == [2, 4]
+            for f, ifd in g.overviews:
+                got = g.read(1, (0, 0, ifd.width, ifd.height), ifd=ifd)
+                # centre-of-block sampling (readers georeference
+                # overviews extent-preservingly)
+                np.testing.assert_array_equal(
+                    got,
+                    data[f // 2::f, f // 2::f][:H // f, :W // f])
+            # full-res read unaffected
+            np.testing.assert_array_equal(g.read(1), data)
+
+    def test_overview_registration(self, tmp_path):
+        """An overview render must stay registered with full resolution:
+        each decimated sample sits within half a SOURCE pixel of where
+        the extent-preserving scaled geotransform claims it is (top-left
+        sampling would be off by (f-1)/2 px and fail this).  The fixture
+        encodes each pixel's own coordinates, so the sampled source
+        pixel is exactly decodable."""
+        cc, rr = np.meshgrid(np.arange(512), np.arange(512))
+        data = (rr * 512 + cc).astype(np.int32)
+        gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+        p = str(tmp_path / "reg.tif")
+        write_geotiff(p, data, gt, parse_crs("EPSG:32755"),
+                      overviews=(2, 4))
+        with GeoTIFF(p) as g:
+            for f, ifd in g.overviews:
+                got = g.read(1, (0, 0, ifd.width, ifd.height), ifd=ifd)
+                for k in (0, 5, ifd.width - 1):
+                    src_row, src_col = divmod(int(got[k, k]), 512)
+                    claimed = (k + 0.5) * f - 0.5   # full-res px coords
+                    assert abs(src_row - claimed) <= 0.5 + 1e-9, \
+                        (f, k, src_row, claimed)
+                    assert abs(src_col - claimed) <= 0.5 + 1e-9
+
+    def test_pick_overview(self, tmp_path):
+        p, _ = self._with_ovr(tmp_path)
+        with GeoTIFF(p) as g:
+            assert g.pick_overview(1.5)[2] is None
+            fx, fy, ifd = g.pick_overview(2.7)
+            assert ifd.width == g.width // 2
+            fx, fy, ifd = g.pick_overview(64.0)
+            assert ifd.width == g.width // 4
+            assert fx == g.width / ifd.width
+
+    def test_pil_still_reads_main(self, tmp_path):
+        """Overview chain must not confuse other readers' main image."""
+        p, data = self._with_ovr(tmp_path, shape=(64, 64), factors=(2,))
+        im = Image.open(p)
+        np.testing.assert_array_equal(np.asarray(im), data)
+
+    def test_decode_window_uses_overview(self, tmp_path):
+        from gsky_tpu.pipeline.decode import decode_window
+        from gsky_tpu.pipeline.types import Granule
+
+        p, data = self._with_ovr(tmp_path, shape=(512, 512))
+        gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+        g = Granule(path=p, ds_name=p, namespace="b1",
+                    base_namespace="b1", band=1, time_index=None,
+                    timestamp=0.0, geo_transform=list(gt.to_gdal()),
+                    srs="EPSG:32755", nodata=-999.0)
+        bbox = gt.bbox(512, 512)
+        crs = parse_crs("EPSG:32755")
+        # 512px of source rendered onto a 128px tile -> stride 4
+        w = decode_window(g, bbox, crs, "near", dst_hw=(128, 128))
+        assert w.data.shape[0] <= 130
+        np.testing.assert_array_equal(
+            w.data, data[2::4, 2::4][:128, :128].astype(np.float32))
+        assert w.window_gt.dx == pytest.approx(30.0 * 4)
+        # same request at full tile res -> full window
+        w1 = decode_window(g, bbox, crs, "near", dst_hw=(512, 512))
+        assert w1.data.shape[0] == 512
+        assert w1.window_gt.dx == pytest.approx(30.0)
+
+    def test_decode_window_netcdf_stride(self, tmp_path):
+        from gsky_tpu.pipeline.decode import decode_window
+        from gsky_tpu.pipeline.types import Granule
+
+        rng = np.random.default_rng(4)
+        H = W = 256
+        data = rng.uniform(0, 1, (H, W)).astype(np.float32)
+        xs = 148.0 + (np.arange(W) + 0.5) * 0.004
+        ys = -35.0 - (np.arange(H) + 0.5) * 0.004
+        p = str(tmp_path / "s.nc")
+        write_netcdf3(p, {"v": data}, xs, ys, EPSG4326, nodata=-9999.0)
+        gt = GeoTransform(148.0, 0.004, 0.0, -35.0, 0.0, -0.004)
+        g = Granule(path=p, ds_name=p, namespace="v",
+                    base_namespace="v", band=1, time_index=None,
+                    timestamp=0.0, geo_transform=list(gt.to_gdal()),
+                    srs="EPSG:4326", nodata=-9999.0, is_netcdf=True,
+                    var_name="v")
+        bbox = gt.bbox(W, H)
+        w = decode_window(g, bbox, EPSG4326, "near", dst_hw=(64, 64))
+        np.testing.assert_array_equal(w.data, data[::4, ::4])
+        assert w.window_gt.dx == pytest.approx(0.004 * 4)
+        # decimated pixel centres must still land on the sampled source
+        # pixel centres: centre of output pixel 0 == centre of src pixel 0
+        x, y = w.window_gt.pixel_to_geo(0.5, 0.5)
+        assert x == pytest.approx(148.0 + 0.5 * 0.004)
+        assert y == pytest.approx(-35.0 - 0.5 * 0.004)
+
+    def test_scene_cache_levels(self, tmp_path):
+        from gsky_tpu.pipeline.scene_cache import SceneCache
+        from gsky_tpu.pipeline.types import Granule
+
+        p, data = self._with_ovr(tmp_path, shape=(512, 512))
+        gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+        g = Granule(path=p, ds_name=p, namespace="b1",
+                    base_namespace="b1", band=1, time_index=None,
+                    timestamp=0.0, geo_transform=list(gt.to_gdal()),
+                    srs="EPSG:32755", nodata=-999.0)
+        cache = SceneCache()
+        full = cache.get(g, stride=1.0)
+        assert full.width == 512
+        ovr = cache.get(g, stride=4.5)
+        assert ovr.width == 128
+        assert ovr.gt.dx == pytest.approx(30.0 * 4)
+        # distinct cache entries, each reusable
+        assert cache.get(g, stride=4.5).serial == ovr.serial
+        assert cache.get(g, stride=1.0).serial == full.serial
+
+    def test_scene_cache_big_scene_cacheable_zoomed_out(self, tmp_path):
+        """Scenes over max_scene_px become cacheable at a coarse level."""
+        from gsky_tpu.pipeline.scene_cache import SceneCache
+        from gsky_tpu.pipeline.types import Granule
+
+        p, data = self._with_ovr(tmp_path, shape=(512, 512))
+        gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+        g = Granule(path=p, ds_name=p, namespace="b1",
+                    base_namespace="b1", band=1, time_index=None,
+                    timestamp=0.0, geo_transform=list(gt.to_gdal()),
+                    srs="EPSG:32755", nodata=-999.0)
+        cache = SceneCache(max_scene_px=300 * 300)
+        assert cache.get(g, stride=1.0) is None      # 512^2 too big
+        ovr = cache.get(g, stride=4.0)               # 128^2 fits
+        assert ovr is not None and ovr.width == 128

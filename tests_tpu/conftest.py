@@ -1,0 +1,38 @@
+"""On-device (opt-in) test tier.
+
+Unlike `tests/` (hermetic CPU, see tests/conftest.py), this directory
+talks to the real TPU through the axon relay.  Run it explicitly:
+
+    python -m pytest tests_tpu/ -q
+
+Every test here must (a) probe the relay cheaply (TCP, no jax) and skip
+when it is down — the relay wedges across whole rounds (DEVICE.md) — and
+(b) do all jax work in a SUBPROCESS with a hard timeout, because a wedged
+relay makes `jax.devices()` hang uninterruptibly in PJRT client creation.
+"""
+
+import socket
+
+import pytest
+
+RELAY_PORTS = range(8082, 8118)
+
+
+def relay_port_open() -> bool:
+    for port in RELAY_PORTS:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.settimeout(0.25)
+        try:
+            if s.connect_ex(("127.0.0.1", port)) == 0:
+                return True
+        finally:
+            s.close()
+    return False
+
+
+@pytest.fixture(scope="session")
+def tpu_relay():
+    if not relay_port_open():
+        pytest.skip("axon relay down: no open port in 8082-8117 "
+                    "(see DEVICE.md)")
+    return True
