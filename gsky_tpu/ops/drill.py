@@ -95,6 +95,37 @@ def deciles(data, valid, n_deciles: int):
     return jnp.where((n > 0)[:, None], out, 0.0)
 
 
+@functools.partial(jax.jit, static_argnames=("out_hw",))
+def window_gather(stack, tsel, r0, c0, mask, nodata, use_nodata,
+                  out_hw: Tuple[int, int]):
+    """Slice a polygon window out of a DEVICE-RESIDENT variable stack:
+    stack (T, H, W) native dtype, tsel (B,) int32 timestep indices,
+    (r0, c0) window origin (host-clamped so r0+h <= H), mask (h, w) bool
+    (True = inside polygon, already shifted to the clamped origin),
+    nodata a 0-d array in the STACK's dtype (comparison happens before
+    the f32 cast, matching `ops.raster.nodata_mask`'s native-dtype
+    equality), use_nodata a 0-d bool (False when the request's nodata is
+    not representable in the stack dtype, i.e. matches nothing).
+
+    Returns (dataf (B, h*w) f32, validf (B, h*w) bool) still on device —
+    the inputs `masked_mean` / `deciles` / the Pallas stats kernel take,
+    with zero re-upload of pixel data (the point: a drill request's
+    device traffic is ~KBs of mask + indices instead of the whole
+    (B, window) raster through the host link)."""
+    T = stack.shape[0]
+    h, w = out_hw
+    win = jax.lax.dynamic_slice(
+        stack, (jnp.int32(0), r0.astype(jnp.int32), c0.astype(jnp.int32)),
+        (T, h, w))
+    raw = win[tsel]                               # (B, h, w) native dtype
+    nodata_hit = (raw == nodata) & use_nodata
+    sub = raw.astype(jnp.float32)
+    # ~isnan, not isfinite: ops.raster.nodata_mask treats inf as valid
+    valid = mask[None] & ~jnp.isnan(sub) & ~nodata_hit
+    B = sub.shape[0]
+    return sub.reshape(B, h * w), valid.reshape(B, h * w)
+
+
 def interp_strided(values: np.ndarray, counts: np.ndarray,
                    band_positions: np.ndarray, n_bands: int) -> Tuple[np.ndarray, np.ndarray]:
     """Linear interpolation of statistics between strided endpoint bands —

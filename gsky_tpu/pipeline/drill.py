@@ -294,6 +294,31 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
         if not is_nc and ":" in ds.ds_name \
                 and ds.ds_name.rsplit(":", 1)[-1].isdigit():
             band0 = int(ds.ds_name.rsplit(":", 1)[-1])
+
+        # device-resident stack fast path: the whole variable stack
+        # lives in HBM (uploaded once per file), the window slice +
+        # reductions run on device, and this request ships only the
+        # polygon mask + timestep indices — KBs instead of the
+        # (B, window) raster through the host link
+        if not is_vrt:
+            from . import drill_cache as DC
+            if DC.enabled():
+                try:
+                    st = DC.default_drill_cache.get(
+                        ds.file_path, is_nc, var if is_nc else "", band0,
+                        ds.nodata)
+                    dev = _drill_device(st, sel, read_idx, mask,
+                                        (c0, r0, c1, r1), req) \
+                        if st is not None else None
+                except Exception:
+                    # any device-path failure (upload OOM, compile)
+                    # degrades to host reads, not a failed request
+                    dev = None
+                if dev is not None:
+                    vals, counts, dec = dev
+                    return _maybe_interp(vals, counts, dec, read_idx,
+                                         sel, stride, req)
+
         bands_data = []
         for k in read_idx:
             ti = sel[k]
@@ -316,49 +341,111 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
         data = np.stack([d for d, _ in bands_data])
         valid = np.stack([m for _, m in bands_data]) & (mask[None] > 0)
         B = data.shape[0]
-        dataf = data.reshape(B, -1)
-        validf = valid.reshape(B, -1)
-        from ..ops.pallas_tpu import masked_stats_pallas, run_with_fallback
-
-        def _via_pallas():
-            # VMEM-streamed reduction kernel on TPU backends
-            s, c = masked_stats_pallas(
-                jnp.asarray(dataf), jnp.asarray(validf),
-                req.clip_lower, req.clip_upper)
-            c = np.asarray(c)
-            v = np.where(c > 0, np.asarray(s) / np.maximum(c, 1),
-                         0.0).astype(np.float32)
-            return v, c
-
-        def _via_xla():
-            v, c = D.masked_mean(
-                jnp.asarray(dataf), jnp.asarray(validf),
-                clip_lower=req.clip_lower, clip_upper=req.clip_upper,
-                pixel_count=req.pixel_count)
-            return np.asarray(v), np.asarray(c)
-
-        if not req.pixel_count:
-            vals, counts = run_with_fallback(
-                "masked_stats", _via_pallas, _via_xla)
-        else:
-            vals, counts = _via_xla()
-        if req.deciles:
-            dec = np.asarray(D.deciles(jnp.asarray(dataf),
-                                       jnp.asarray(validf), req.deciles))
-        else:
-            dec = np.zeros((B, 0), np.float32)
-
-        if stride > 1 and len(read_idx) < len(sel):
-            cols = np.concatenate([vals[:, None], dec], axis=1)
-            vi, ci = D.interp_strided(cols, np.tile(counts[:, None],
-                                                    (1, cols.shape[1])),
-                                      np.asarray(read_idx), len(sel))
-            vals = vi[:, 0]
-            dec = vi[:, 1:]
-            counts = ci[:, 0]
-        return vals, counts, dec
+        vals, counts, dec = _stats_tail(data.reshape(B, -1),
+                                        valid.reshape(B, -1), req)
+        return _maybe_interp(vals, counts, dec, read_idx, sel, stride,
+                             req)
     finally:
         h.close()
+
+
+def _stats_tail(dataf, validf, req: GeoDrillRequest):
+    """Masked mean + deciles over (B, N) data/valid — device or host
+    arrays (jnp.asarray is a no-op for resident device buffers)."""
+    from ..ops.pallas_tpu import masked_stats_pallas, run_with_fallback
+
+    def _via_pallas():
+        # VMEM-streamed reduction kernel on TPU backends
+        s, c = masked_stats_pallas(
+            jnp.asarray(dataf), jnp.asarray(validf),
+            req.clip_lower, req.clip_upper)
+        c = np.asarray(c)
+        v = np.where(c > 0, np.asarray(s) / np.maximum(c, 1),
+                     0.0).astype(np.float32)
+        return v, c
+
+    def _via_xla():
+        v, c = D.masked_mean(
+            jnp.asarray(dataf), jnp.asarray(validf),
+            clip_lower=req.clip_lower, clip_upper=req.clip_upper,
+            pixel_count=req.pixel_count)
+        return np.asarray(v), np.asarray(c)
+
+    if not req.pixel_count:
+        vals, counts = run_with_fallback(
+            "masked_stats", _via_pallas, _via_xla)
+    else:
+        vals, counts = _via_xla()
+    if req.deciles:
+        dec = np.asarray(D.deciles(jnp.asarray(dataf),
+                                   jnp.asarray(validf), req.deciles))
+    else:
+        dec = np.zeros((dataf.shape[0], 0), np.float32)
+    return vals, counts, dec
+
+
+def _maybe_interp(vals, counts, dec, read_idx, sel, stride,
+                  req: GeoDrillRequest):
+    """Strided-endpoint interpolation of statistics (`drill.go:119-214`)."""
+    if stride > 1 and len(read_idx) < len(sel):
+        cols = np.concatenate([vals[:, None], dec], axis=1)
+        vi, ci = D.interp_strided(cols, np.tile(counts[:, None],
+                                                (1, cols.shape[1])),
+                                  np.asarray(read_idx), len(sel))
+        vals = vi[:, 0]
+        dec = vi[:, 1:]
+        counts = ci[:, 0]
+    return vals, counts, dec
+
+
+def _drill_device(st, sel: List[int], read_idx: List[int],
+                  mask: np.ndarray, win, req: GeoDrillRequest):
+    """Drill one file from its DEVICE-RESIDENT stack: upload the
+    rasterized polygon mask + timestep indices (KBs), slice the window
+    on device (`ops.drill.window_gather`), reduce in place.  Returns
+    (values, counts, deciles) for the read_idx bands, or None when the
+    window doesn't fit a padded bucket (caller falls back to host
+    reads)."""
+    from .executor import _bucket, _bucket_pow2
+
+    c0, r0, c1, r1 = win
+    T, H, W = st.shape
+    wh, ww = r1 - r0, c1 - c0
+    bh = min(_bucket(wh), H)
+    bw = min(_bucket(ww), W)
+    if bh < wh or bw < ww:
+        return None
+    # clamp the origin so the padded window stays in bounds; the mask
+    # shifts by the clamp offset so pixels keep their identity
+    r0c = min(r0, H - bh)
+    c0c = min(c0, W - bw)
+    mask_p = np.zeros((bh, bw), bool)
+    mask_p[r0 - r0c:r0 - r0c + wh, c0 - c0c:c0 - c0c + ww] = mask > 0
+    tsel = np.asarray([sel[k] for k in read_idx], np.int32)
+    B = len(tsel)
+    Bp = _bucket_pow2(B)
+    tsel_p = np.pad(tsel, (0, Bp - B), mode="edge")
+    # nodata compares in the stack's NATIVE dtype (parity with
+    # ops.raster.nodata_mask); a nodata not representable there matches
+    # nothing, exactly like the host path's dtype-promoting !=
+    dtype = st.dev.dtype
+    nd = st.nodata
+    if np.isnan(nd):
+        use_nd = np.dtype(dtype).kind == "f"
+        nd_native = np.zeros((), dtype) if not use_nd \
+            else np.asarray(np.nan, dtype)
+        if use_nd:
+            # NaN nodata: NaN != NaN, so the ~isnan term already covers
+            # it — disable the equality term
+            use_nd = False
+    else:
+        nd_native = np.asarray(nd).astype(dtype)
+        use_nd = bool(np.asarray(float(nd_native) == float(nd)))
+    dataf, validf = D.window_gather(
+        st.dev, jnp.asarray(tsel_p), np.int32(r0c), np.int32(c0c),
+        jnp.asarray(mask_p), nd_native, np.bool_(use_nd), (bh, bw))
+    vals, counts, dec = _stats_tail(dataf, validf, req)
+    return vals[:B], counts[:B], dec[:B]
 
 
 def _merge(acc, req: GeoDrillRequest) -> DrillResult:

@@ -435,3 +435,56 @@ class TestReviewRegressions:
         assert "+ellps=bessel" in p.to_proj4()
         p2 = parse_crs(p.to_proj4())
         assert p2.ellps == p.ellps
+
+
+class TestWindowGather:
+    """Device-resident drill slicing (`ops.drill.window_gather`): nodata
+    must compare in the stack's NATIVE dtype, before the f32 cast."""
+
+    def _gather(self, stack, nodata, use_nd, mask=None, tsel=None,
+                r0=0, c0=0):
+        import jax.numpy as jnp
+
+        from gsky_tpu.ops.drill import window_gather
+        T, H, W = stack.shape
+        if mask is None:
+            mask = np.ones((H, W), bool)
+        if tsel is None:
+            tsel = np.arange(T, dtype=np.int32)
+        return window_gather(
+            jnp.asarray(stack), jnp.asarray(tsel), np.int32(r0),
+            np.int32(c0), jnp.asarray(mask), nodata, np.bool_(use_nd),
+            mask.shape)
+
+    def test_int_nodata_native_compare(self):
+        stack = np.array([[[5, -999], [7, 3]]], np.int32)
+        d, v = self._gather(stack, np.int32(-999), True)
+        np.testing.assert_array_equal(np.asarray(v)[0], [1, 0, 1, 1])
+
+    def test_unrepresentable_nodata_matches_nothing(self):
+        # host semantics: int data != 0.5 is always True (all valid)
+        stack = np.array([[[0, 1], [2, 3]]], np.int32)
+        d, v = self._gather(stack, np.int32(0), False)
+        assert np.asarray(v).all()
+
+    def test_large_int_values_not_collapsed(self):
+        # distinct int32 values that collide after f32 rounding must not
+        # cross-contaminate the nodata mask
+        nd = -999999999
+        near = -999999968          # f32(near) == f32(nd)
+        stack = np.array([[[nd, near]]], np.int32)
+        d, v = self._gather(stack, np.int32(nd), True)
+        np.testing.assert_array_equal(np.asarray(v)[0], [0, 1])
+
+    def test_window_and_timesteps(self):
+        rng = np.random.default_rng(0)
+        stack = rng.normal(size=(6, 16, 16)).astype(np.float32)
+        mask = np.zeros((8, 8), bool)
+        mask[2:6, 1:7] = True
+        tsel = np.array([4, 1], np.int32)
+        d, v = self._gather(stack, np.float32(np.nan), False, mask,
+                            tsel, r0=3, c0=5)
+        want = stack[[4, 1], 3:11, 5:13].reshape(2, -1)
+        np.testing.assert_array_equal(np.asarray(d), want)
+        np.testing.assert_array_equal(
+            np.asarray(v), np.broadcast_to(mask.reshape(-1), (2, 64)))

@@ -3,6 +3,7 @@ granule expansion, drill statistics, extent suggestion, feature info."""
 
 import datetime as dt
 import math
+import os
 
 import numpy as np
 import pytest
@@ -217,6 +218,83 @@ class TestDrill:
         # quartile ordering
         assert res.values["phot_veg_d1"][0] <= res.values["phot_veg_d2"][0] \
             <= res.values["phot_veg_d3"][0]
+
+    def test_device_stack_cache_parity(self, mas, archive, monkeypatch):
+        """The device-resident stack path (drill_cache + window_gather)
+        must match host-read reductions exactly."""
+        from gsky_tpu.pipeline.drill_cache import default_drill_cache
+
+        monkeypatch.delenv("GSKY_DRILL_CACHE", raising=False)
+        req = GeoDrillRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            geometry_wkt=self.WKT, start_time=t(9), end_time=t(13),
+            approx=False, deciles=3)
+        dp = DrillPipeline(mas)
+        res_dev = dp.process(req)              # cached-stack path
+        # guard against a vacuous pass: the fixture's stack must be
+        # device-resident (earlier tests may have already cached it)
+        assert any(k[0].startswith(archive["root"])
+                   for k in default_drill_cache._order)
+        monkeypatch.setenv("GSKY_DRILL_CACHE", "0")
+        res_host = dp.process(req)             # host-read path
+        assert res_dev.dates == res_host.dates
+        for ns in res_host.values:
+            np.testing.assert_allclose(
+                res_dev.values[ns], res_host.values[ns], rtol=1e-6,
+                err_msg=ns)
+            assert res_dev.counts[ns] == res_host.counts[ns], ns
+
+    def test_device_stack_cache_edge_polygon(self, mas, archive,
+                                             monkeypatch):
+        """Window clamped at the raster edge: the shifted mask must keep
+        pixel identity (parity with host reads)."""
+        # fixture NetCDF grid spans lon 147.99-148.24, lat -35.37..-35.19;
+        # this polygon pokes past the north-west corner
+        wkt = ("POLYGON((147.9 -35.25,148.05 -35.25,148.05 -35.1,"
+               "147.9 -35.1,147.9 -35.25))")
+        from gsky_tpu.pipeline.drill_cache import default_drill_cache
+
+        monkeypatch.delenv("GSKY_DRILL_CACHE", raising=False)
+        req = GeoDrillRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            geometry_wkt=wkt, start_time=t(9), end_time=t(13),
+            approx=False)
+        dp = DrillPipeline(mas)
+        res_dev = dp.process(req)
+        assert default_drill_cache._order  # device path engaged
+        monkeypatch.setenv("GSKY_DRILL_CACHE", "0")
+        res_host = dp.process(req)
+        assert res_dev.dates == res_host.dates
+        assert res_dev.dates, "edge polygon should still hit data"
+        for ns in res_host.values:
+            np.testing.assert_allclose(
+                res_dev.values[ns], res_host.values[ns], rtol=1e-6)
+            assert res_dev.counts[ns] == res_host.counts[ns]
+
+    def test_drill_stack_cache_reuse_and_eviction(self, archive):
+        from gsky_tpu.pipeline.drill_cache import DrillStackCache
+
+        nc = None
+        for fn in os.listdir(archive["root"]):
+            if fn.endswith(".nc"):
+                nc = os.path.join(archive["root"], fn)
+                break
+        assert nc
+        cache = DrillStackCache()
+        s1 = cache.get(nc, True, "phot_veg", 1, None)
+        assert s1 is not None and s1.shape[0] >= 1
+        assert cache.get(nc, True, "phot_veg", 1, None).serial == s1.serial
+        # over-budget stack -> uncacheable, negative entry sticks
+        tiny = DrillStackCache(max_item_bytes=16)
+        assert tiny.get(nc, True, "phot_veg", 1, None) is None
+        assert tiny.get(nc, True, "phot_veg", 1, None) is None
+        # byte-budget eviction keeps the newest
+        small = DrillStackCache(max_bytes=s1.nbytes + 1)
+        a = small.get(nc, True, "phot_veg", 1, None)
+        b = small.get(nc, True, "bare_soil", 1, None)
+        assert a is not None and b is not None
+        c = small.get(nc, True, "phot_veg", 1, None)
+        assert c is not None and c.serial != a.serial  # was evicted
 
     def test_drill_expression(self, mas, archive):
         req = GeoDrillRequest(
