@@ -9,7 +9,10 @@ scene stack + static config into ONE vmapped dispatch
 (`ops.warp.render_scenes_ctrl_many`), amortising the round trips N ways.
 
 A request waits at most ``max_wait_s`` (default 3 ms) for companions.
-Batches are padded to one fixed size so each key compiles exactly once.
+Batches are padded to the next power of two (clamped to ``max_batch``,
+which should itself be a power of two), so a key compiles at most
+log2(max_batch)+1 specialisations while half-full batches don't pull
+double their bytes.
 
 **Default OFF** (`GSKY_RENDER_BATCH=1` enables): batching trades
 transfer granularity for round-trip count, which wins when the
@@ -83,11 +86,15 @@ class RenderBatcher:
         method, n_ns, out_hw, step, auto, colour_scale = statics
         try:
             N = len(items)
-            # ALWAYS pad to the fixed max batch: exactly one jit
-            # specialisation per key (variable batch sizes would
-            # recompile mid-traffic), and padded lanes cost only device
-            # compute (~15 us/lane), not round trips
-            Np = self.max_batch
+            # pad to the next power of two (<= max_batch): bounded jit
+            # specialisations per key (log2(max_batch) of them) while
+            # keeping the padded PULL close to the real batch — padding
+            # always to max_batch doubles transfer bytes for half-full
+            # batches, and the pull is the expensive part of the link
+            Np = 1
+            while Np < N:
+                Np *= 2
+            Np = min(Np, self.max_batch)
             ctrls = np.stack([it[0] for it in items]
                              + [items[0][0]] * (Np - N))
             params = np.stack([it[1] for it in items]

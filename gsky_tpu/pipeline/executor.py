@@ -30,6 +30,19 @@ from .decode import DecodedWindow
 _BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096)
 
 
+def _prefetch(x):
+    """Start the device->host copy of a TERMINAL result now, without
+    blocking: the caller's eventual np.asarray overlaps with other
+    requests' transfers instead of serialising per-buffer (measured on
+    the tunneled link: ~80 ms per cold 64 KB pull serial, ~10 ms with
+    copies in flight)."""
+    try:
+        x.copy_to_host_async()
+    except Exception:
+        pass
+    return x
+
+
 def _bucket(n: int) -> int:
     for b in _BUCKETS:
         if n <= b:
@@ -320,9 +333,10 @@ class WarpExecutor:
             key = skey + statics
             return self._batcher.render(key, stack, ctrl, params, sp,
                                         statics)
-        return render_scenes_ctrl(stack, jnp.asarray(ctrl),
-                                  jnp.asarray(params), jnp.asarray(sp),
-                                  *statics)
+        out = render_scenes_ctrl(stack, jnp.asarray(ctrl),
+                                 jnp.asarray(params), jnp.asarray(sp),
+                                 *statics)
+        return _prefetch(out)
 
     def render_bands_byte(self, granules, ns_ids: Sequence[int],
                           prios: Sequence[float], dst_gt: GeoTransform,
@@ -343,10 +357,10 @@ class WarpExecutor:
         stack, ctrl, params, step, _ = made
         sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
         sel = jnp.asarray(np.asarray(out_sel, np.int32))
-        return render_scenes_bands_ctrl(
+        return _prefetch(render_scenes_bands_ctrl(
             stack, jnp.asarray(ctrl), jnp.asarray(params), sp, sel,
             method, _bucket_pow2(n_ns), (height, width), step, auto,
-            colour_scale)
+            colour_scale))
 
     def _scene_inputs(self, granules, ns_ids, prios, dst_gt, dst_crs,
                       height, width, cache=None):

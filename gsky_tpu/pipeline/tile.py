@@ -21,7 +21,7 @@ from ..index.store import fmt_time
 from ..ops import mosaic as M
 from ..ops.expr import BandExpressions
 from .decode import decode_all
-from .executor import WarpExecutor, default_executor
+from .executor import WarpExecutor, _prefetch, default_executor
 from .granule import expand_granules
 from .types import GeoTileRequest, Granule, TileResult
 
@@ -324,10 +324,12 @@ def evaluate_expressions(exprs: BandExpressions,
             out_valid[name] = valid_env[k]
         else:
             # stays on device: TileResult arrays are pulled to host only
-            # at encode time (one sync per response)
+            # at encode time (one sync per response).  Consumers
+            # (encoders, WCS merge) pull next, so start the copies now —
+            # transfers then overlap across concurrent requests
             o, ok = ce.eval_masked(env, venv)
-            out_data[name] = o.astype(jnp.float32)
-            out_valid[name] = ok
+            out_data[name] = _prefetch(o.astype(jnp.float32))
+            out_valid[name] = _prefetch(ok)
         names.append(name)
 
     # axis-expanded outputs with no expression (`var#axis=value` pass
