@@ -214,50 +214,6 @@ def warp_gather_batch(src, valid, rows, cols, method: str = "near"):
         src, valid, rows, cols)
 
 
-@functools.partial(jax.jit, static_argnames=("method", "n_ns"))
-def warp_mosaic_batch(src, coords, meta, method: str = "near", n_ns: int = 1):
-    """Fused warp + per-namespace newest-wins mosaic: ONE device dispatch
-    from decoded source windows to per-namespace canvases.
-
-    The modular path (warp_gather_batch -> mosaic_first_valid per
-    namespace) costs one dispatch per stage and namespace; over a
-    high-latency host<->device link (e.g. a tunneled TPU) dispatch count
-    dominates wall time, so the whole granule->canvas dataflow of
-    `processor/tile_grpc.go` + `processor/tile_merger.go:281-312` fuses
-    here into a single XLA program.
-
-    src   (B, sh, sw) f32, NaN = nodata (validity is NaN-encoded so the
-          source costs one upload instead of two);
-    coords (2, B, h, w) f32 = (rows, cols) fractional source indices;
-    meta  (2, B) f32: meta[0] = strictly-unique mosaic priority (higher
-          wins; encode newest-first with later-arrival tie-break on
-          host, cf. `ops.mosaic.priority_order`), meta[1] = namespace id
-          (< 0 for padding granules);
-    Returns (canvases (n_ns, h, w) f32, valids (n_ns, h, w) bool).
-    """
-    valid = jnp.isfinite(src)
-    srcz = jnp.where(valid, src, 0.0)
-    fn = _METHODS[method]
-    out, ok = jax.vmap(lambda s, v, r, c: fn(s, v, r, c))(
-        srcz, valid, coords[0], coords[1])
-    prio = meta[0]
-    ns_id = meta[1].astype(jnp.int32)
-    score = jnp.where(ok, prio[:, None, None], -jnp.inf)
-    canv = []
-    vals = []
-    for n in range(n_ns):  # static unroll; n_ns is bucket-padded upstream
-        member = (ns_id == n)[:, None, None]
-        s = jnp.where(member, score, -jnp.inf)
-        idx = jnp.argmax(s, axis=0)
-        v = jnp.max(s, axis=0) > -jnp.inf
-        c = jnp.take_along_axis(out, idx[None], axis=0)[0]
-        # deterministic fill at invalid pixels (encoders key off the mask,
-        # but downstream comparisons and file writers see the raw values)
-        canv.append(jnp.where(v, c, 0.0))
-        vals.append(v)
-    return jnp.stack(canv), jnp.stack(vals)
-
-
 def _bilerp_grid(ctrl, h: int, w: int, step: int):
     """Upsample a control-point grid (gh, gw) to full (h, w) dst
     resolution — the on-device analogue of GDAL's approx transformer

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import math
 import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -22,8 +23,7 @@ from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
 from ..ops.warp import (combine_scored, render_scenes_bands_ctrl,
                         render_scenes_ctrl, warp_gather_batch,
-                        warp_mosaic_batch, warp_scenes_ctrl,
-                        warp_scenes_ctrl_scored)
+                        warp_scenes_ctrl, warp_scenes_ctrl_scored)
 from .decode import DecodedWindow
 
 # padded source-window shape buckets (H and W independently bucketed)
@@ -62,12 +62,32 @@ def _bucket_pow2(n: int, lo: int = 1) -> int:
 class WarpExecutor:
     """Batches decoded granule windows into device dispatches."""
 
+    # LRU bounds, not clear-alls: a burst of distinct tiles must evict
+    # the oldest entries, not dump the whole working set (a clear causes
+    # a recompute/re-upload storm exactly when traffic is heaviest)
+    _GEO_CACHE_MAX = 256
+    _STACK_CACHE_MAX = 32
+
     def __init__(self):
-        self._geo_cache: Dict[tuple, Tuple[np.ndarray, np.ndarray]] = {}
-        self._stack_cache: Dict[tuple, object] = {}
+        self._geo_cache: OrderedDict = OrderedDict()
+        self._stack_cache: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
         from .batcher import RenderBatcher
         self._batcher = RenderBatcher()
+
+    def _geo_cache_get(self, key):
+        with self._lock:
+            hit = self._geo_cache.get(key)
+            if hit is not None:
+                self._geo_cache.move_to_end(key)
+            return hit
+
+    def _geo_cache_put(self, key, value):
+        with self._lock:
+            self._geo_cache[key] = value
+            self._geo_cache.move_to_end(key)
+            while len(self._geo_cache) > self._GEO_CACHE_MAX:
+                self._geo_cache.popitem(last=False)
 
     def _dst_geo_coords(self, dst_gt: GeoTransform, dst_crs: CRS,
                         height: int, width: int,
@@ -76,8 +96,7 @@ class WarpExecutor:
         the projection math is shared by every granule in that CRS (the
         expensive part of `coord_grid`)."""
         key = (dst_gt.to_gdal(), dst_crs, height, width, src_crs)
-        with self._lock:
-            hit = self._geo_cache.get(key)
+        hit = self._geo_cache_get(key)
         if hit is not None:
             return hit
         c = np.arange(width, dtype=np.float64) + 0.5
@@ -87,10 +106,7 @@ class WarpExecutor:
         sx, sy = dst_crs.transform_to(src_crs, x, y, np)
         sx = np.asarray(sx, np.float64)
         sy = np.asarray(sy, np.float64)
-        with self._lock:
-            if len(self._geo_cache) > 256:
-                self._geo_cache.clear()
-            self._geo_cache[key] = (sx, sy)
+        self._geo_cache_put(key, (sx, sy))
         return sx, sy
 
     def _ctrl_geo_coords(self, dst_gt: GeoTransform, dst_crs: CRS,
@@ -110,8 +126,7 @@ class WarpExecutor:
         silently smearing).  Returns (sx, sy, actual_step)."""
         key = ("ctrl", dst_gt.to_gdal(), dst_crs, height, width, src_crs,
                step)
-        with self._lock:
-            hit = self._geo_cache.get(key)
+        hit = self._geo_cache_get(key)
         if hit is not None:
             return hit
         while True:
@@ -128,10 +143,7 @@ class WarpExecutor:
                     sx, sy, dst_gt, dst_crs, src_crs, step) <= 0.125:
                 break
             step //= 2
-        with self._lock:
-            if len(self._geo_cache) > 256:
-                self._geo_cache.clear()
-            self._geo_cache[key] = (sx, sy, step)
+        self._geo_cache_put(key, (sx, sy, step))
         return sx, sy, step
 
     @staticmethod
@@ -240,36 +252,59 @@ class WarpExecutor:
                     height: int, width: int, n_ns: int,
                     method: str = "near"):
         """Fused fast path: warp every window AND mosaic per namespace in
-        one device dispatch (3 uploads, 1 execution, 0 downloads — results
-        stay on device).  All windows are padded into a single
-        (B, sh, sw) bucket; B and n_ns are power-of-two padded.
+        one device dispatch per source CRS (uploads: padded window stack
+        + ~2 KB control grid + per-granule affine params — NOT the dense
+        (2, B, H, W) coordinate grids, which cost ~32 MB/tile for deep
+        stacks).  The dense dst->src projection happens once per
+        (dst grid, src CRS) on host at control points; the device
+        reconstructs it bilinearly (0.125 px validated error, as the
+        scene path does).
 
         Returns (canvases (n_ns_pad, H, W) f32 jax, valids bool jax) —
         callers slice the first ``n_ns`` entries.
         """
-        jobs = []
-        for wdw in windows:
-            sx, sy = self._dst_geo_coords(dst_gt, dst_crs, height, width,
-                                          wdw.src_crs)
-            col, row = wdw.window_gt.geo_to_pixel(sx, sy, np)
-            jobs.append((wdw, (row - 0.5).astype(np.float32),
-                         (col - 0.5).astype(np.float32)))
-        bh = _bucket(max(j[0].data.shape[0] for j in jobs))
-        bw = _bucket(max(j[0].data.shape[1] for j in jobs))
-        B = _bucket_pow2(len(jobs))
-        src = np.full((B, bh, bw), np.nan, np.float32)
-        coords = np.full((2, B, height, width), -1e6, np.float32)
-        meta = np.full((2, B), -1.0, np.float32)
-        for k, (wdw, rows, cols) in enumerate(jobs):
-            h, w = wdw.data.shape
-            src[k, :h, :w] = np.where(wdw.valid, wdw.data, np.nan)
-            coords[0, k] = rows
-            coords[1, k] = cols
-            meta[0, k] = prios[k]
-            meta[1, k] = ns_ids[k]
-        return warp_mosaic_batch(jnp.asarray(src), jnp.asarray(coords),
-                                 jnp.asarray(meta), method,
-                                 _bucket_pow2(n_ns))
+        by_crs: Dict[CRS, List[int]] = {}
+        for i, wdw in enumerate(windows):
+            by_crs.setdefault(wdw.src_crs, []).append(i)
+        n_pad = _bucket_pow2(n_ns)
+        parts = []
+        for crs, idxs in by_crs.items():
+            sx, sy, step = self._ctrl_geo_coords(dst_gt, dst_crs, height,
+                                                 width, crs, 16)
+            gs = [windows[i] for i in idxs]
+            bh = _bucket(max(g.data.shape[0] for g in gs))
+            bw = _bucket(max(g.data.shape[1] for g in gs))
+            B = _bucket_pow2(len(gs))
+            src = np.full((B, bh, bw), np.nan, np.float32)
+            params = np.zeros((B, 11), np.float64)
+            params[:, 10] = -1.0
+            ox, oy = gs[0].window_gt.x0, gs[0].window_gt.y0
+            ctrl = np.stack([sx - ox, sy - oy]).astype(np.float32)
+            for k, (i, wdw) in enumerate(zip(idxs, gs)):
+                h0, w0 = wdw.data.shape
+                src[k, :h0, :w0] = np.where(wdw.valid, wdw.data, np.nan)
+                gt = wdw.window_gt
+                det = gt.dx * gt.dy - gt.rx * gt.ry
+                inv = (gt.dy / det, -gt.rx / det, -gt.ry / det,
+                       gt.dx / det)
+                a0 = inv[0] * (ox - gt.x0) + inv[1] * (oy - gt.y0)
+                a3 = inv[2] * (ox - gt.x0) + inv[3] * (oy - gt.y0)
+                params[k, :6] = (a0, inv[0], inv[1], a3, inv[2], inv[3])
+                params[k, 6] = h0
+                params[k, 7] = w0
+                params[k, 8] = np.nan   # validity is NaN-encoded in src
+                params[k, 9] = prios[i]
+                params[k, 10] = ns_ids[i]
+            parts.append(warp_scenes_ctrl_scored(
+                jnp.asarray(src), jnp.asarray(ctrl),
+                jnp.asarray(params.astype(np.float32)), method, n_pad,
+                (height, width), step))
+        if len(parts) == 1:
+            canv, best = parts[0]
+            return canv, best > -jnp.inf
+        canvs = jnp.stack([p[0] for p in parts])
+        bests = jnp.stack([p[1] for p in parts])
+        return combine_scored(canvs, bests)
 
 
     def warp_mosaic_scenes(self, granules, ns_ids: Sequence[int],
@@ -422,14 +457,17 @@ class WarpExecutor:
             skey = tuple(s.serial for s in gs) + (B,)
             with self._lock:
                 stack = self._stack_cache.get(skey)
+                if stack is not None:
+                    self._stack_cache.move_to_end(skey)
             if stack is None:
                 devs = [s.dev for s in gs]
                 devs += [devs[0]] * (B - len(devs))
                 stack = jnp.stack(devs)
                 with self._lock:
-                    if len(self._stack_cache) > 32:
-                        self._stack_cache.clear()
                     self._stack_cache[skey] = stack
+                    self._stack_cache.move_to_end(skey)
+                    while len(self._stack_cache) > self._STACK_CACHE_MAX:
+                        self._stack_cache.popitem(last=False)
             groups.append((stack, ctrl, params.astype(np.float32), step,
                            skey))
         return groups

@@ -76,9 +76,9 @@ class TilePipeline:
                       granules: List[Granule]) -> TileResult:
         """Single-dispatch fast path (no mask band, local executor):
         decode -> fused warp+per-namespace mosaic
-        (`ops.warp.warp_mosaic_batch`) -> expressions.  Minimises device
-        round trips: one upload set, one execution, results stay on
-        device until encode."""
+        (`ops.warp.warp_scenes_ctrl_scored` over padded windows) ->
+        expressions.  Minimises device round trips: one upload set, one
+        execution, results stay on device until encode."""
         exprs = req.band_exprs
         H, W = req.height, req.width
 
