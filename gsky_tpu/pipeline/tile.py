@@ -50,25 +50,95 @@ class TilePipeline:
                 and not req.mask.data_source:
             if req.mask.id not in namespaces:
                 namespaces.append(req.mask.id)
-        wkt = req.bbox.to_polygon_wkt()
-        kw = dict(srs=req.crs.name(), wkt=wkt,
+        kw = dict(srs=req.crs.name(), wkt=req.bbox.to_polygon_wkt(),
                   namespaces=",".join(namespaces),
                   nseg=req.polygon_segments, limit=req.query_limit)
         if req.start_time is not None:
             kw["time"] = fmt_time(req.start_time)
         if req.end_time is not None:
             kw["until"] = fmt_time(req.end_time)
-        datasets = self.mas.intersects(req.collection, **kw)
+        datasets = self._index_query(req, kw, req.collection)
         granules = expand_granules(datasets, req.start_time, req.end_time,
                                    req.axes)
-        # separately indexed mask collection (`tile_indexer.go:265-284`)
+        # separately indexed mask collection (`tile_indexer.go:265-284`),
+        # subdivided under the same P2(b) policy as the data collection
         if req.mask is not None and req.mask.data_source:
             mkw = dict(kw)
             mkw["namespaces"] = req.mask.id
-            mds = self.mas.intersects(req.mask.data_source, **mkw)
+            mds = self._index_query(req, mkw, req.mask.data_source)
             granules += expand_granules(mds, req.start_time, req.end_time,
                                         req.axes)
         return granules
+
+    def _index_query(self, req: GeoTileRequest, kw: Dict,
+                     collection: str):
+        """One MAS ?intersects, or — for coarse-resolution requests over
+        a known layer extent — P2(b) spatial subdivision into concurrent
+        index-tile queries (`tile_indexer.go:201-258`): the 256-px
+        virtual grid over the clipped bbox splits into index tiles of
+        256*index_tile_{x,y}_size pixels, each queried separately, so no
+        single index query scans a continent at low zoom."""
+        sub = self._index_subdivision(req)
+        if sub is None:
+            return self.mas.intersects(collection, **kw)
+        if not sub:                # clipped bbox empty: nothing to ask
+            return []
+        import concurrent.futures as cf
+
+        def one(wkt4326):
+            skw = dict(kw, srs="EPSG:4326", wkt=wkt4326)
+            # failures propagate: a MAS outage must surface as an error
+            # response, not render as an empty (or partially empty) tile
+            return self.mas.intersects(collection, **skw)
+
+        with cf.ThreadPoolExecutor(min(8, len(sub))) as ex:
+            parts = list(ex.map(one, sub))
+        # a granule spanning several index tiles comes back once per
+        # tile; identity-dedup keeps mosaic priorities unique
+        seen = set()
+        out = []
+        for ds in (d for part in parts for d in part):
+            k = (ds.file_path, ds.ds_name, ds.namespace)
+            if k not in seen:
+                seen.add(k)
+                out.append(ds)
+        return out
+
+    def _index_subdivision(self, req: GeoTileRequest):
+        """None = query as one; [] = empty; else sub-bbox WKTs (4326)."""
+        if req.index_res_limit <= 0 or req.query_limit > 0 \
+                or not req.spatial_extent:
+            return None
+        from ..geo.transform import BBox as _BBox
+        from ..geo.transform import transform_bbox
+        try:
+            ll = transform_bbox(req.bbox, req.crs, EPSG4326)
+        except ValueError:
+            return None
+        ext = req.spatial_extent
+        xmin = max(ll.xmin, ext[0])
+        ymin = max(ll.ymin, ext[1])
+        xmax = min(ll.xmax, ext[2])
+        ymax = min(ll.ymax, ext[3])
+        if xmax < xmin or ymax < ymin:
+            return []
+        res_w = res_h = 256                  # virtual index raster
+        xres = (xmax - xmin) / res_w
+        yres = (ymax - ymin) / res_h
+        if max(xres, yres) <= req.index_res_limit:
+            return None
+        mx = int(res_w * req.index_tile_x_size) or res_w
+        my = int(res_h * req.index_tile_y_size) or res_h
+        if mx >= res_w and my >= res_h:
+            return None
+        subs = []
+        for y in range(0, res_h, my):
+            for x in range(0, res_w, mx):
+                subs.append(_BBox(
+                    xmin + x * xres, ymin + y * yres,
+                    min(xmin + (x + mx) * xres, xmax),
+                    min(ymin + (y + my) * yres, ymax)).to_polygon_wkt())
+        return subs
 
     # -- full render ---------------------------------------------------------
 

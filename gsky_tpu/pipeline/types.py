@@ -65,6 +65,19 @@ class GeoTileRequest:
     query_limit: int = 0
     polygon_segments: int = 2
     metrics: Optional[object] = None
+    # P2(b) index-query subdivision (`tile_indexer.go:201-258`): when the
+    # request is coarser than index_res_limit (degrees/pixel) and the
+    # layer extent is known, the MAS query splits into index tiles of
+    # 256*index_tile_{x,y}_size pixels each
+    spatial_extent: Optional[Tuple[float, float, float, float]] = None
+    index_tile_x_size: float = 1.0
+    index_tile_y_size: float = 1.0
+    index_res_limit: float = 0.0
+    # P2(c) per-granule dst sub-tiling on the worker RPC path
+    # (`tile_grpc.go:143-198`): <=1.0 means a fraction of the dst tile,
+    # >1 an absolute pixel bound; 0 disables
+    grpc_tile_x_size: float = 0.0
+    grpc_tile_y_size: float = 0.0
 
     _exprs: Optional[BandExpressions] = None
 

@@ -133,6 +133,12 @@ class Layer:
     wms_polygon_segments: int = DEFAULT_WMS_POLYGON_SEGMENTS
     wcs_polygon_segments: int = DEFAULT_WCS_POLYGON_SEGMENTS
     band_strides: int = 1
+    # P2(b)/P2(c) spatial decomposition knobs (`utils/config.go:172-177`)
+    grpc_tile_x_size: float = 0.0
+    grpc_tile_y_size: float = 0.0
+    index_tile_x_size: float = 1.0
+    index_tile_y_size: float = 1.0
+    index_res_limit: float = 0.0
     feature_info_max_dates: int = 0
     feature_info_bands: List[str] = field(default_factory=list)
     nodata_legend_path: str = ""
@@ -236,6 +242,11 @@ class Layer:
             wcs_polygon_segments=i("wcs_polygon_segments",
                                    DEFAULT_WCS_POLYGON_SEGMENTS),
             band_strides=i("band_strides", 1),
+            grpc_tile_x_size=f("grpc_tile_x_size"),
+            grpc_tile_y_size=f("grpc_tile_y_size"),
+            index_tile_x_size=f("index_tile_x_size", 1.0),
+            index_tile_y_size=f("index_tile_y_size", 1.0),
+            index_res_limit=f("index_res_limit"),
             feature_info_max_dates=i("feature_info_max_dates"),
             feature_info_bands=list(j.get("feature_info_bands", []) or []),
             nodata_legend_path=j.get("nodata_legend_path", ""),

@@ -15,6 +15,7 @@ serveWPS.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import datetime as dt
 import functools
 import io
@@ -259,7 +260,14 @@ class OWSServer:
             bbox=p.bbox, crs=p.crs, width=width, height=height,
             start_time=start, end_time=end, axes=axes, mask=mask,
             resample=style.resample or lay.resample,
-            polygon_segments=segments)
+            polygon_segments=segments,
+            spatial_extent=tuple(lay.default_geo_bbox)
+            if len(lay.default_geo_bbox) >= 4 else None,
+            index_tile_x_size=lay.index_tile_x_size,
+            index_tile_y_size=lay.index_tile_y_size,
+            index_res_limit=lay.index_res_limit,
+            grpc_tile_x_size=lay.grpc_tile_x_size,
+            grpc_tile_y_size=lay.grpc_tile_y_size)
 
     async def _getmap(self, cfg: Config, p, collector):
         if not p.layers:
@@ -518,12 +526,8 @@ class OWSServer:
                                    nodata=nodata)
 
         async def render_tile(tb, ox, oy, tw, th):
-            req = GeoTileRequest(
-                collection=base_req.collection, bands=base_req.bands,
-                bbox=tb, crs=p.crs, width=tw, height=th,
-                start_time=base_req.start_time, end_time=base_req.end_time,
-                axes=base_req.axes, mask=base_req.mask,
-                resample=base_req.resample,
+            req = dataclasses.replace(
+                base_req, bbox=tb, width=tw, height=th,
                 polygon_segments=lay.wcs_polygon_segments)
             res = await asyncio.to_thread(_render_with_fusion, pipe, req,
                                           lay, cfg, self)

@@ -69,6 +69,7 @@ class WorkerClient:
         self.limiter = ConcLimiter(conc_per_node * len(nodes))
         self.timeout = timeout
         self.nodes = nodes
+        self._max_msg = max_msg
         # persistent fan-out pool: sized to the RPC concurrency cap so
         # per-request thread churn stays off the GetMap hot path
         self._fanout = cf.ThreadPoolExecutor(
@@ -126,33 +127,89 @@ class WorkerClient:
             raise RuntimeError(res.error)
         return unpack_raster(res)
 
+    def _sub_tile_grid(self, req: GeoTileRequest) -> Tuple[int, int]:
+        """P2(c): dst sub-tile bounds for the RPC fan-out
+        (`tile_grpc.go:143-198`).  Config values <= 1.0 are fractions of
+        the dst size, > 1 absolute pixels, 0 off — but a response whose
+        raster would break the gRPC recv cap is ALWAYS sharded (the
+        reference relies on operators setting GrpcTileXSize; here a
+        4096^2 WCS tile must not 64 MB-bomb the channel by default)."""
+        def bound(cfg: float, full: int) -> int:
+            if cfg <= 0.0:
+                m = full
+            elif cfg <= 1.0:
+                m = int(full * cfg)
+            else:
+                m = int(cfg)
+            return max(min(m, full), 1)
+
+        mx = bound(req.grpc_tile_x_size, req.width)
+        my = bound(req.grpc_tile_y_size, req.height)
+        # auto-shard: warped response = w*h*(4B data + 1B mask) + slack.
+        # The budget must stay clear of the recv cap itself (a floor
+        # above 3/4*max_msg would shard to a size the channel still
+        # rejects — a deterministic self-inflicted outage)
+        budget = min(max(self._max_msg // 4, 1 << 20),
+                     max(self._max_msg * 3 // 4, 5 * 64 * 64))
+        while mx * my * 5 > budget and (mx > 64 or my > 64):
+            if mx >= my:
+                mx = max(mx // 2, 64)
+            else:
+                my = max(my // 2, 64)
+        return mx, my
+
     def warp_many(self, granules: Sequence[Granule], req: GeoTileRequest,
                   resample: str) -> List[Optional[Tuple[np.ndarray, np.ndarray]]]:
         """Concurrent per-granule warps, order-preserving; failures become
-        empty granules (EmptyTile sentinel semantics)."""
+        empty granules (EmptyTile sentinel semantics).  Large dst tiles
+        shard into sub-tile RPCs per granule (P2(c),
+        `tile_grpc.go:143-198`) and reassemble here."""
         if not granules:
             return []
         dst_gt = req.dst_gt()
         failures: List[Exception] = []
+        mx, my = self._sub_tile_grid(req)
 
-        def one(g: Granule):
+        jobs = []                 # (granule idx, ox, oy, tw, th)
+        for i in range(len(granules)):
+            for oy in range(0, req.height, my):
+                for ox in range(0, req.width, mx):
+                    jobs.append((i, ox, oy, min(mx, req.width - ox),
+                                 min(my, req.height - oy)))
+
+        def one(job):
+            i, ox, oy, tw, th = job
             try:
-                return self.warp(g, dst_gt, req.crs, req.width, req.height,
-                                 resample)
+                return self.warp(granules[i], dst_gt.window(ox, oy),
+                                 req.crs, tw, th, resample)
             except Exception as e:
                 failures.append(e)
                 return None
 
-        out = list(self._fanout.map(one, granules))
+        parts = list(self._fanout.map(one, jobs))
+        if len(jobs) == len(granules):        # one RPC per granule
+            out: List[Optional[Tuple[np.ndarray, np.ndarray]]] = parts
+        else:
+            out = [None] * len(granules)
+            for (i, ox, oy, tw, th), part in zip(jobs, parts):
+                if part is None:
+                    continue
+                if out[i] is None:
+                    out[i] = (np.zeros((req.height, req.width),
+                                       np.float32),
+                              np.zeros((req.height, req.width), bool))
+                d, v = part
+                out[i][0][oy:oy + th, ox:ox + tw] = np.asarray(d)
+                out[i][1][oy:oy + th, ox:ox + tw] = np.asarray(v)
         if failures:
             log.warning("%d/%d warp RPCs failed (first: %s)",
-                        len(failures), len(granules), failures[0])
+                        len(failures), len(jobs), failures[0])
             # outage visibility: a dead fleet must not look like "no
             # data" — per-granule failures degrade to empty granules,
             # total failure becomes an error response upstream
-            if len(failures) == len(granules):
+            if len(failures) == len(jobs):
                 raise RuntimeError(
-                    f"all {len(granules)} warp RPCs failed "
+                    f"all {len(jobs)} warp RPCs failed "
                     f"(first: {failures[0]})")
         return out
 

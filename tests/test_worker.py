@@ -464,3 +464,106 @@ class TestVRT:
                 float(data[keep].mean()), rel=1e-5)
         finally:
             svc.close()
+
+
+def test_grpc_sub_tiled_warp_matches_whole(grpc_worker, archive):
+    """P2(c): per-granule dst sub-tiling (`tile_grpc.go:143-198`) must
+    reassemble to the same raster as one whole-tile RPC, including when
+    the payload cap forces auto-sharding."""
+    from gsky_tpu.worker import WorkerClient
+    mas = MASClient(archive["store"])
+    base = dict(collection=archive["root"], bands=[NS],
+                bbox=TILE_BBOX, crs=EPSG3857, width=128, height=128,
+                start_time=1578000000.0 - 90 * 86400,
+                end_time=1578700000.0)
+    whole = TilePipeline(
+        mas, remote=WorkerClient([grpc_worker])).process(
+            GeoTileRequest(**base))
+    # configured sub-tiling: 0.5 fraction -> 2x2 grid of 64px sub-tiles
+    tiled = TilePipeline(
+        mas, remote=WorkerClient([grpc_worker])).process(
+            GeoTileRequest(**base, grpc_tile_x_size=0.5,
+                           grpc_tile_y_size=0.5))
+    for ns in whole.namespaces:
+        np.testing.assert_array_equal(whole.valid[ns], tiled.valid[ns])
+        np.testing.assert_array_equal(
+            np.asarray(whole.data[ns]), np.asarray(tiled.data[ns]))
+    # payload-cap auto-sharding: a response bigger than the recv cap
+    # must shard into sub-tile RPCs and still match the local render
+    small = WorkerClient([grpc_worker], max_msg=1 << 20)
+    big = GeoTileRequest(**{**base, "width": 1024, "height": 1024})
+    mx, my = small._sub_tile_grid(big)
+    assert mx * my * 5 <= (1 << 20) < 1024 * 1024 * 5
+    capped = TilePipeline(mas, remote=small).process(big)
+    local = TilePipeline(mas).process(big)
+    for ns in local.namespaces:
+        np.testing.assert_array_equal(local.valid[ns], capped.valid[ns])
+        l = np.asarray(local.data[ns])
+        r = np.asarray(capped.data[ns])
+        frac = np.mean(~np.isclose(l, r, rtol=1e-6))
+        assert frac < 0.02, f"{ns}: {frac:.1%} pixels differ"
+    small.close()
+
+
+class TestIndexSubdivision:
+    """P2(b): coarse-resolution index queries subdivide into index-tile
+    MAS queries (`tile_indexer.go:201-258`)."""
+
+    def _spy_mas(self, store):
+        mas = MASClient(store)
+        calls = []
+        orig = mas.intersects
+
+        def spy(collection, **kw):
+            calls.append(kw)
+            return orig(collection, **kw)
+
+        mas.intersects = spy
+        return mas, calls
+
+    def test_subdivides_and_matches(self, archive):
+        mas, calls = self._spy_mas(archive["store"])
+        pipe = TilePipeline(mas)
+        # whole-extent bbox at 256px -> res far above a tiny limit
+        ll = BBox(147.9, -35.5, 148.4, -35.1)
+        merc = transform_bbox(ll, EPSG4326, EPSG3857)
+        base = dict(collection=archive["root"], bands=[NS],
+                    bbox=merc, crs=EPSG3857, width=256, height=256,
+                    start_time=1578000000.0 - 90 * 86400,
+                    end_time=1578700000.0)
+        plain = pipe.index(GeoTileRequest(**base))
+        n_plain_calls = len(calls)
+        sub = pipe.index(GeoTileRequest(
+            **base, spatial_extent=(147.0, -36.0, 149.0, -35.0),
+            index_tile_x_size=0.5, index_tile_y_size=0.5,
+            index_res_limit=1e-9))
+        assert len(calls) - n_plain_calls == 4   # 2x2 index tiles
+        # identical granule set (order-insensitive), priorities unique
+        key = lambda g: (g.path, g.ds_name, g.namespace, g.timestamp)
+        assert sorted(map(key, plain)) == sorted(map(key, sub))
+
+    def test_res_below_limit_queries_once(self, archive):
+        mas, calls = self._spy_mas(archive["store"])
+        pipe = TilePipeline(mas)
+        ll = BBox(148.0, -35.4, 148.01, -35.39)   # tiny bbox, fine res
+        merc = transform_bbox(ll, EPSG4326, EPSG3857)
+        pipe.index(GeoTileRequest(
+            collection=archive["root"], bands=[NS], bbox=merc,
+            crs=EPSG3857, width=256, height=256,
+            spatial_extent=(147.0, -36.0, 149.0, -35.0),
+            index_tile_x_size=0.5, index_tile_y_size=0.5,
+            index_res_limit=10.0))
+        assert len(calls) == 1
+
+    def test_disjoint_extent_returns_empty(self, archive):
+        mas, calls = self._spy_mas(archive["store"])
+        pipe = TilePipeline(mas)
+        ll = BBox(10.0, 10.0, 11.0, 11.0)         # far from extent
+        merc = transform_bbox(ll, EPSG4326, EPSG3857)
+        out = pipe.index(GeoTileRequest(
+            collection=archive["root"], bands=[NS], bbox=merc,
+            crs=EPSG3857, width=256, height=256,
+            spatial_extent=(147.0, -36.0, 149.0, -35.0),
+            index_tile_x_size=0.5, index_tile_y_size=0.5,
+            index_res_limit=1e-9))
+        assert out == [] and len(calls) == 0
