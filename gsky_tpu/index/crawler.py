@@ -116,12 +116,45 @@ def extract_geotiff(path: str, namespace: Optional[str] = None,
 
 def extract_netcdf(path: str, approx_stats: bool = False) -> Dict:
     with NetCDF(path) as nc:
-        gt = nc.geotransform()
+        # curvilinear products carry 2-D lon/lat geolocation arrays
+        # instead of an affine grid (`crawl/extractor/info.go:502`,
+        # GeoLocInfo); the record then drives the geolocation-array
+        # warp path in the executor.  Detect BEFORE geotransform():
+        # a genuine swath has no 1-D axis variables at all, and
+        # geotransform() raising must not abort extraction for it
+        gl = nc.geoloc_vars()
+        try:
+            gt = nc.geotransform()
+        except ValueError:
+            if gl is None:
+                raise
+            gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, 1.0)
         ts = nc.timestamps()
+        geo_loc = None
+        gl_polygon = None
+        if gl is not None:
+            gx, gy = gl
+            geo_loc = {"x_var": gx.name, "y_var": gy.name,
+                       "line_offset": 0.0, "pixel_offset": 0.0,
+                       "line_step": 1.0, "pixel_step": 1.0,
+                       "srs": "EPSG:4326"}
+            ax = np.asarray(gx[:], np.float64)
+            ay = np.asarray(gy[:], np.float64)
+            # NOTE: an antimeridian-crossing swath degrades to a
+            # whole-longitude footprint here (over-matching the index is
+            # harmless; GeolocGrid unwraps the seam for the warp itself)
+            with np.errstate(invalid="ignore"):
+                gl_polygon = (
+                    f"POLYGON (({np.nanmin(ax)} {np.nanmin(ay)},"
+                    f"{np.nanmax(ax)} {np.nanmin(ay)},"
+                    f"{np.nanmax(ax)} {np.nanmax(ay)},"
+                    f"{np.nanmin(ax)} {np.nanmax(ay)},"
+                    f"{np.nanmin(ax)} {np.nanmin(ay)}))")
         geo_md = []
         for v in nc.raster_vars():
             crs = nc.crs(v)
             h, w = v.shape[-2], v.shape[-1]
+            is_gl = gl is not None and gl[0].shape == (h, w)
             stamps = [fmt_time(t) for t in ts] if ts is not None else []
             if not stamps:
                 fn_ts = timestamp_from_filename(path)
@@ -136,16 +169,19 @@ def extract_netcdf(path: str, approx_stats: bool = False) -> Dict:
                 "namespace": v.name,
                 "array_type": NP_TO_GDAL.get(np.dtype(v.dtype.newbyteorder("=")),
                                              "Float32"),
-                "proj_wkt": crs.to_wkt(),
-                "proj4": crs.to_proj4(),
+                "proj_wkt": "EPSG:4326" if is_gl else crs.to_wkt(),
+                "proj4": "+proj=longlat +datum=WGS84 +no_defs"
+                if is_gl else crs.to_proj4(),
                 "geotransform": list(gt.to_gdal()),
                 "x_size": w,
                 "y_size": h,
-                "polygon": _polygon_wkt(gt, w, h),
+                "polygon": gl_polygon if is_gl else _polygon_wkt(gt, w, h),
                 "timestamps": stamps,
                 "nodata": v.nodata,
                 "axes": axes or None,
             }
+            if is_gl:
+                ds["geo_loc"] = geo_loc
             if approx_stats and len(v.shape) == 3:
                 means, counts = [], []
                 for t in range(v.shape[0]):

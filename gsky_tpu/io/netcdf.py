@@ -244,6 +244,28 @@ class NetCDF:
                 out.append(v)
         return out
 
+    def geoloc_vars(self) -> Optional[Tuple[NCVar, NCVar]]:
+        """The 2-D (lon, lat) geolocation-array pair of a curvilinear
+        product, or None for regular grids — the detection feeding the
+        crawler's geo_loc record (the reference drives this from
+        config rulesets, `crawl/extractor/info.go:502`; here CF 2-D
+        coordinate variables are recognised directly)."""
+        def find(names, std_names):
+            for v in self.variables.values():
+                sn = v.attrs.get("standard_name", b"")
+                if isinstance(sn, bytes):
+                    sn = sn.decode("latin-1")
+                if (v.name.lower() in names or sn in std_names) \
+                        and len(v.shape) == 2:
+                    return v
+            return None
+
+        gx = find(("lon", "longitude", "lons"), ("longitude",))
+        gy = find(("lat", "latitude", "lats"), ("latitude",))
+        if gx is None or gy is None or gx.shape != gy.shape:
+            return None
+        return gx, gy
+
     def _axis_var(self, names: Sequence[str], std_names: Sequence[str]) -> Optional[NCVar]:
         for v in self.variables.values():
             sn = v.attrs.get("standard_name", b"")

@@ -68,6 +68,7 @@ class _HandleCache:
 
 
 _handles = _HandleCache()
+_geoloc_skips = 0
 
 
 def margin_for(resample: str) -> int:
@@ -101,6 +102,22 @@ def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
     requests read from the coarsest sufficient overview (GeoTIFF pyramid
     IFDs) or a strided hyperslab (NetCDF) instead of full resolution —
     `worker/gdalprocess/warp.go:156-198`."""
+    if granule.geo_loc:
+        # curvilinear granules have no affine pixel grid; they render
+        # through the scene path's geolocation-array ctrl inversion
+        # (executor._geoloc_ctrl), never through windowed affine warps.
+        # Loud, rate-limited: on paths that can't take the scene route
+        # (remote workers, mask-band renders) this granule degrades to
+        # empty, which must not look like absent data
+        global _geoloc_skips
+        _geoloc_skips += 1
+        if _geoloc_skips <= 10 or _geoloc_skips % 1000 == 0:
+            import logging
+            logging.getLogger("gsky.decode").warning(
+                "curvilinear granule %s skipped on the windowed decode "
+                "path (renders only via the scene path; skip #%d)",
+                granule.path, _geoloc_skips)
+        return None
     src_crs = parse_crs(granule.srs) if granule.srs else dst_crs
     gt = GeoTransform.from_gdal(granule.geo_transform)
     try:

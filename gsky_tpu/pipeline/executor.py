@@ -407,47 +407,124 @@ class WarpExecutor:
             return None
         return groups[0]
 
+    def _geoloc_ctrl(self, g, dst_gt: GeoTransform, dst_crs: CRS,
+                     height: int, width: int):
+        """Control grid for a curvilinear granule: dst ctrl points
+        projected to the geolocation CRS, then inverted through the
+        geolocation arrays to fractional source PIXEL coords
+        (`geo.geoloc.GeolocGrid`) — the kernels consume them with an
+        identity affine, exactly like projected grids.  None when the
+        geoloc arrays can't be loaded."""
+        from ..geo.crs import parse_crs
+        from ..geo.geoloc import load_geoloc_grid
+        grid = load_geoloc_grid(g.path, g.geo_loc)
+        if grid is None:
+            return None
+        try:
+            gl_crs = parse_crs(g.geo_loc.get("srs") or "EPSG:4326")
+        except ValueError:
+            return None
+        key = ("glctrl", g.path, g.geo_loc.get("x_var"),
+               dst_gt.to_gdal(), dst_crs, height, width)
+        hit = self._geo_cache_get(key)
+        if hit is not None:
+            return hit
+        step = 16
+        while True:
+            sx, sy, step = self._ctrl_geo_coords(dst_gt, dst_crs, height,
+                                                 width, gl_crs, step)
+            col, row = grid.invert(sx, sy)
+            # the inversion leg needs its own 0.125-px validation (the
+            # projection leg's _ctrl_err_px can't see it): compare the
+            # on-device bilinear reconstruction at ctrl-cell midpoints
+            # against exact inversion there, halving the step for
+            # strongly curved swaths
+            if step <= 2:
+                break
+            gh, gw = sx.shape
+            if gh < 2 or gw < 2:
+                break
+            c = (np.arange(gw - 1, dtype=np.float64) + 0.5) * step + 0.5
+            r = (np.arange(gh - 1, dtype=np.float64) + 0.5) * step + 0.5
+            C, R = np.meshgrid(c, r)
+            mx, my = dst_gt.pixel_to_geo(C, R, np)
+            ex, ey = dst_crs.transform_to(gl_crs, mx, my, np)
+            ecol, erow = grid.invert(np.asarray(ex), np.asarray(ey))
+            icol = 0.25 * (col[:-1, :-1] + col[:-1, 1:] + col[1:, :-1]
+                           + col[1:, 1:])
+            irow = 0.25 * (row[:-1, :-1] + row[:-1, 1:] + row[1:, :-1]
+                           + row[1:, 1:])
+            with np.errstate(invalid="ignore"):
+                err = np.hypot(ecol - icol, erow - irow)
+            if not err.size or np.all(np.isnan(err)) \
+                    or float(np.nanmax(err)) <= 0.125:
+                break
+            step //= 2
+        out = (np.stack([col, row]).astype(np.float32), step)
+        self._geo_cache_put(key, out)
+        return out
+
     def _scene_groups(self, granules, ns_ids, prios, dst_gt, dst_crs,
                       height, width, cache=None):
         """Device inputs for the fused scene kernels, grouped by
-        (source CRS, bucket shape, dtype): each group gets its own
+        (source CRS, bucket shape, dtype) — curvilinear granules group
+        by their geolocation arrays instead: each group gets its own
         (stack, ctrl, params, step); multi-group sets (granules spanning
-        UTM zones) combine via the scored kernels.  None when any scene
-        is uncacheable."""
+        UTM zones, or mixing regular and curvilinear grids) combine via
+        the scored kernels.  None when any scene is uncacheable."""
         from .scene_cache import default_scene_cache
         cache = cache or default_scene_cache
         scenes = []
         for g in granules:
-            s = cache.get(g, self._granule_stride(g, dst_gt, dst_crs,
-                                                  height, width))
+            stride = 1.0 if g.geo_loc else self._granule_stride(
+                g, dst_gt, dst_crs, height, width)
+            s = cache.get(g, stride)
             if s is None:
                 return None
             scenes.append(s)
         by_key: Dict[tuple, List[int]] = {}
         for i, s in enumerate(scenes):
-            by_key.setdefault(
-                (s.crs.name(), s.bucket, str(s.dtype)), []).append(i)
+            g = granules[i]
+            if g.geo_loc:
+                key = ("gl", g.path, g.geo_loc.get("x_var"),
+                       g.geo_loc.get("y_var"), s.bucket, str(s.dtype))
+            else:
+                key = (s.crs.name(), s.bucket, str(s.dtype))
+            by_key.setdefault(key, []).append(i)
 
         groups = []
-        for idxs in by_key.values():
+        for gkey, idxs in by_key.items():
             gs = [scenes[i] for i in idxs]
             s0 = gs[0]
-            sx, sy, step = self._ctrl_geo_coords(dst_gt, dst_crs, height,
-                                                 width, s0.crs, 16)
-            ox, oy = s0.gt.x0, s0.gt.y0
-            ctrl = np.stack([sx - ox, sy - oy]).astype(np.float32)
+            is_gl = gkey[0] == "gl"
+            if is_gl:
+                made = self._geoloc_ctrl(granules[idxs[0]], dst_gt,
+                                         dst_crs, height, width)
+                if made is None:
+                    return None
+                ctrl, step = made
+            else:
+                sx, sy, step = self._ctrl_geo_coords(
+                    dst_gt, dst_crs, height, width, s0.crs, 16)
+                ox, oy = s0.gt.x0, s0.gt.y0
+                ctrl = np.stack([sx - ox, sy - oy]).astype(np.float32)
 
             B = _bucket_pow2(len(gs))
             params = np.zeros((B, 11), np.float64)
             params[:, 10] = -1.0
             for k, (i, s) in enumerate(zip(idxs, gs)):
-                gt = s.gt
-                det = gt.dx * gt.dy - gt.rx * gt.ry
-                inv = (gt.dy / det, -gt.rx / det, -gt.ry / det,
-                       gt.dx / det)
-                a0 = inv[0] * (ox - gt.x0) + inv[1] * (oy - gt.y0)
-                a3 = inv[2] * (ox - gt.x0) + inv[3] * (oy - gt.y0)
-                params[k, :6] = (a0, inv[0], inv[1], a3, inv[2], inv[3])
+                if is_gl:
+                    # ctrl already carries pixel coords: identity affine
+                    params[k, :6] = (0.0, 1.0, 0.0, 0.0, 0.0, 1.0)
+                else:
+                    gt = s.gt
+                    det = gt.dx * gt.dy - gt.rx * gt.ry
+                    inv = (gt.dy / det, -gt.rx / det, -gt.ry / det,
+                           gt.dx / det)
+                    a0 = inv[0] * (ox - gt.x0) + inv[1] * (oy - gt.y0)
+                    a3 = inv[2] * (ox - gt.x0) + inv[3] * (oy - gt.y0)
+                    params[k, :6] = (a0, inv[0], inv[1], a3, inv[2],
+                                     inv[3])
                 params[k, 6] = s.height
                 params[k, 7] = s.width
                 params[k, 8] = s.nodata

@@ -123,6 +123,8 @@ def expand_granules(datasets: Sequence[Dataset],
                     array_type=ds.array_type,
                     is_netcdf=is_nc,
                     var_name=var_name,
+                    geo_loc=ds.geo_loc,
+                    polygon=ds.polygon,
                 ))
     # dedup (the gRPC stage dedups granules, `tile_grpc.go:78-83`)
     seen = set()

@@ -39,6 +39,14 @@ class TilePipeline:
         self.executor = executor or default_executor
         self.decode_workers = decode_workers
         self.remote = remote
+        self._index_pool = None   # lazy; shared across requests
+
+    def _index_fanout(self):
+        import concurrent.futures as cf
+        if self._index_pool is None:
+            self._index_pool = cf.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="gsky-index")
+        return self._index_pool
 
     # -- indexing ------------------------------------------------------------
 
@@ -83,7 +91,6 @@ class TilePipeline:
             return self.mas.intersects(collection, **kw)
         if not sub:                # clipped bbox empty: nothing to ask
             return []
-        import concurrent.futures as cf
 
         def one(wkt4326):
             skw = dict(kw, srs="EPSG:4326", wkt=wkt4326)
@@ -91,8 +98,7 @@ class TilePipeline:
             # response, not render as an empty (or partially empty) tile
             return self.mas.intersects(collection, **skw)
 
-        with cf.ThreadPoolExecutor(min(8, len(sub))) as ex:
-            parts = list(ex.map(one, sub))
+        parts = list(self._index_fanout().map(one, sub))
         # a granule spanning several index tiles comes back once per
         # tile; identity-dedup keeps mosaic priorities unique
         seen = set()

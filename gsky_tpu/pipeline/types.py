@@ -110,6 +110,13 @@ class Granule:
     array_type: str = "Float32"
     is_netcdf: bool = False
     var_name: str = ""
+    # curvilinear products: crawler geo_loc record (x_var/y_var 2-D
+    # geolocation arrays + offsets/steps) — drives the geolocation-array
+    # warp path instead of the affine geo_transform
+    geo_loc: Optional[Dict] = None
+    # dataset footprint WKT in the file's SRS (MAS polygon column) —
+    # lets the RPC fan-out skip sub-tiles a granule can't touch
+    polygon: str = ""
 
 
 @dataclass

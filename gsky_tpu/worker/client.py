@@ -170,12 +170,47 @@ class WorkerClient:
         failures: List[Exception] = []
         mx, my = self._sub_tile_grid(req)
 
+        # granule footprint in dst pixel space, for sub-tile pruning:
+        # a granule touching one sub-tile must not cost an RPC per
+        # sub-tile (`tile_grpc.go` computes granule windows per tile)
+        def dst_px_bbox(g: Granule):
+            if not g.polygon or (mx >= req.width and my >= req.height):
+                return None
+            try:
+                from ..geo import geometry as geom
+                from ..geo.crs import parse_crs
+                from ..geo.transform import transform_bbox
+                src_bbox = geom.from_wkt(g.polygon).bbox()
+                dbox = transform_bbox(src_bbox, parse_crs(g.srs), req.crs)
+                gt = dst_gt
+                c0, r0 = gt.geo_to_pixel(dbox.xmin, dbox.ymax)
+                c1, r1 = gt.geo_to_pixel(dbox.xmax, dbox.ymin)
+                c0, c1 = sorted((c0, c1))
+                r0, r1 = sorted((r0, r1))
+                return (c0 - 2, r0 - 2, c1 + 2, r1 + 2)
+            except Exception:
+                return None
+
         jobs = []                 # (granule idx, ox, oy, tw, th)
-        for i in range(len(granules)):
+        for i, g in enumerate(granules):
+            pb_ = dst_px_bbox(g)
+            touched = False
             for oy in range(0, req.height, my):
                 for ox in range(0, req.width, mx):
-                    jobs.append((i, ox, oy, min(mx, req.width - ox),
-                                 min(my, req.height - oy)))
+                    tw = min(mx, req.width - ox)
+                    th = min(my, req.height - oy)
+                    if pb_ is not None and (
+                            ox + tw < pb_[0] or ox > pb_[2]
+                            or oy + th < pb_[1] or oy > pb_[3]):
+                        continue
+                    jobs.append((i, ox, oy, tw, th))
+                    touched = True
+            if not touched:
+                # disjoint granule: keep one tiny probe RPC so the
+                # result slot stays a real (empty) raster, not None-by-
+                # accident if the footprint estimate was wrong
+                jobs.append((i, 0, 0, min(mx, req.width),
+                             min(my, req.height)))
 
         def one(job):
             i, ox, oy, tw, th = job

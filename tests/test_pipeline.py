@@ -611,3 +611,151 @@ class TestMultiCRSMosaic:
             fd = np.asarray(fused.data[ns])
             wd = np.asarray(window.data[ns])
             assert np.mean(fd != wd) < 0.02  # approx-transform flips
+
+
+class TestGeolocWarp:
+    """Curvilinear (geolocation-array) products end-to-end: crawler
+    detection -> MAS geo_loc record -> ctrl-point inversion -> fused
+    render (`worker/gdalprocess/warp.go:52-67`)."""
+
+    GH, GW = 180, 240
+    L0, B0 = 147.0, -34.0
+
+    def _lonlat(self, ii, jj):
+        # sheared curvilinear grid with an exact analytic inverse
+        lon = self.L0 + 0.004 * jj + 0.0012 * ii
+        lat = self.B0 - 0.003 * ii
+        return lon, lat
+
+    def _inv(self, lon, lat):
+        i = (self.B0 - lat) / 0.003
+        j = (lon - self.L0 - 0.0012 * i) / 0.004
+        return i, j
+
+    def _make(self, tmp_path):
+        from gsky_tpu.io.netcdf import write_netcdf3
+
+        ii, jj = np.mgrid[0:self.GH, 0:self.GW].astype(np.float64)
+        lon, lat = self._lonlat(ii, jj)
+        data = (1000 + ii * 3 + jj * 7).astype(np.float32)
+        data[:6, :6] = -9999.0
+        root = str(tmp_path / "glarch")
+        os.makedirs(root, exist_ok=True)
+        p = os.path.join(root, "swath_20200110.nc")
+        # axis vars are index-valued; the 2-D lon/lat arrays carry the
+        # real georeferencing (CF curvilinear layout)
+        write_netcdf3(p, {"bt": data,
+                          "lon": lon.astype(np.float64),
+                          "lat": lat.astype(np.float64)},
+                      np.arange(self.GW, dtype=np.float64),
+                      np.arange(self.GH, dtype=np.float64),
+                      EPSG4326, nodata=-9999.0)
+        return root, p, data
+
+    def test_crawler_detects_geoloc(self, tmp_path):
+        from gsky_tpu.index.crawler import extract
+
+        root, p, _ = self._make(tmp_path)
+        rec = extract(p)
+        assert not rec.get("error")
+        md = [d for d in rec["geo_metadata"] if d["namespace"] == "bt"]
+        assert len(md) == 1
+        gl = md[0].get("geo_loc")
+        assert gl and gl["x_var"] == "lon" and gl["y_var"] == "lat"
+        # polygon spans the geoloc bbox, not the index axes
+        assert "147" in md[0]["polygon"]
+        # lon/lat must not crawl as raster namespaces themselves
+        assert not any(d["namespace"] in ("lon", "lat")
+                       for d in rec["geo_metadata"])
+
+    def test_render_matches_analytic_inverse(self, tmp_path):
+        from gsky_tpu.index import MASStore, MASClient
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.pipeline import TilePipeline, GeoTileRequest
+
+        root, p, data = self._make(tmp_path)
+        store = MASStore()
+        rec = extract(p)
+        store.ingest(rec)
+        # tile well inside the swath, EPSG:4326 dst
+        bbox = BBox(147.35, -34.40, 147.75, -34.10)
+        req = GeoTileRequest(collection=root, bands=["bt"], bbox=bbox,
+                             crs=EPSG4326, width=128, height=128,
+                             resample="near")
+        pipe = TilePipeline(MASClient(store))
+        grans = pipe.index(req)
+        assert grans and grans[0].geo_loc
+        res = pipe.process(req)
+        got = np.asarray(res.data["bt"])
+        vgot = np.asarray(res.valid["bt"])
+        # exact expectation from the analytic inverse (nearest sample)
+        gt = req.dst_gt()
+        cc, rr = np.meshgrid(np.arange(128) + 0.5, np.arange(128) + 0.5)
+        lon, lat = gt.pixel_to_geo(cc, rr)
+        ei, ej = self._inv(lon, lat)
+        # sample centres sit at integer grid indices: nearest = rint
+        ein = np.rint(ei).astype(int)
+        ejn = np.rint(ej).astype(int)
+        inside = (ein >= 0) & (ein < self.GH) & (ejn >= 0) \
+            & (ejn < self.GW)
+        exp = np.where(inside, data[np.clip(ein, 0, self.GH - 1),
+                                    np.clip(ejn, 0, self.GW - 1)], 0.0)
+        expv = inside & (exp != -9999.0)
+        assert vgot.sum() > 0.8 * 128 * 128
+        # the ctrl-grid bilinear reconstruction may flip pixels exactly
+        # on sample boundaries; demand near-total agreement
+        frac_v = np.mean(vgot != expv)
+        frac_d = np.mean(got[vgot & expv] != exp[vgot & expv])
+        assert frac_v < 0.02, f"validity differs on {frac_v:.1%}"
+        assert frac_d < 0.02, f"values differ on {frac_d:.1%}"
+
+    def test_geoloc_grid_invert_accuracy(self):
+        from gsky_tpu.geo.geoloc import GeolocGrid
+
+        ii, jj = np.mgrid[0:self.GH, 0:self.GW].astype(np.float64)
+        lon, lat = self._lonlat(ii, jj)
+        grid = GeolocGrid(lon, lat)
+        rng = np.random.default_rng(4)
+        qi = rng.uniform(0, self.GH - 1, 400)
+        qj = rng.uniform(0, self.GW - 1, 400)
+        qlon, qlat = self._lonlat(qi, qj)
+        col, row = grid.invert(qlon, qlat)
+        np.testing.assert_allclose(row - 0.5, qi, atol=0.05)
+        np.testing.assert_allclose(col - 0.5, qj, atol=0.05)
+
+    def test_invert_across_antimeridian(self):
+        from gsky_tpu.geo.geoloc import GeolocGrid
+
+        ii, jj = np.mgrid[0:100, 0:150].astype(np.float64)
+        lon = 179.0 + 0.02 * jj          # crosses +180 -> wraps
+        lon = np.where(lon > 180.0, lon - 360.0, lon)
+        lat = -10.0 - 0.02 * ii
+        grid = GeolocGrid(lon, lat)
+        qi = np.array([10.0, 50.0, 90.0])
+        qj = np.array([20.0, 75.0, 140.0])
+        qlon = 179.0 + 0.02 * qj
+        qlon = np.where(qlon > 180.0, qlon - 360.0, qlon)
+        qlat = -10.0 - 0.02 * qi
+        col, row = grid.invert(qlon, qlat)
+        np.testing.assert_allclose(row - 0.5, qi, atol=0.05)
+        np.testing.assert_allclose(col - 0.5, qj, atol=0.05)
+
+    def test_crawl_pure_swath_without_axis_vars(self, tmp_path):
+        """A genuine swath file has 2-D lon/lat and NO 1-D coordinate
+        variables; extraction must not abort on the missing affine."""
+        h5py = pytest.importorskip("h5py")
+        from gsky_tpu.index.crawler import extract
+
+        p = str(tmp_path / "pure_swath_20200110.nc")
+        ii, jj = np.mgrid[0:80, 0:120].astype(np.float64)
+        with h5py.File(p, "w") as f:
+            f.create_dataset("lon", data=150.0 + 0.01 * jj + 0.002 * ii)
+            f.create_dataset("lat", data=-20.0 - 0.01 * ii)
+            d = f.create_dataset(
+                "rad", data=(ii + jj).astype(np.float32))
+            d.attrs["_FillValue"] = np.float32(-9999.0)
+        rec = extract(p)
+        assert not rec.get("error"), rec
+        md = [d for d in rec["geo_metadata"] if d["namespace"] == "rad"]
+        assert md and md[0].get("geo_loc")
+        assert md[0]["geo_loc"]["x_var"] == "lon"
