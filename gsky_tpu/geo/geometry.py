@@ -150,6 +150,42 @@ class Geometry:
 
     # -- antimeridian handling (ST_SplitDatelineWGS84, mas.sql:13-84) -------
 
+    def clip_bbox(self, b: BBox) -> "Geometry":
+        """Polygon intersection with an axis-aligned box (four
+        Sutherland-Hodgman half-plane passes per ring) — the drill
+        indexer's OGR_G_Intersection-with-tile equivalent.  Polygons
+        whose exterior clips away entirely drop; holes clip with their
+        polygon."""
+        def clip_ring(r):
+            c = r
+            for axis, bound, keep_le in ((0, b.xmin, False),
+                                         (0, b.xmax, True),
+                                         (1, b.ymin, False),
+                                         (1, b.ymax, True)):
+                if not len(c):
+                    break
+                c = _clip_ring_halfplane(c, axis, bound, keep_le)
+            return c
+
+        polys = []
+        for rings in self.polys:
+            ext = clip_ring(rings[0]) if rings else np.zeros((0, 2))
+            if not len(ext):
+                continue
+            keep = [ext]
+            for hole in rings[1:]:
+                h = clip_ring(hole)
+                if len(h):
+                    keep.append(h)
+            polys.append(keep)
+        kind = "MultiPolygon" if len(polys) > 1 else "Polygon"
+        return Geometry(kind, polys=polys)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.polys or all(
+            not rings or not len(rings[0]) for rings in self.polys)
+
     def split_dateline(self) -> "Geometry":
         """Split polygons whose longitudes span the antimeridian into a
         MultiPolygon with parts on both sides of ±180 — without this, a
@@ -173,8 +209,8 @@ class Geometry:
             shifted = [r.copy() for r in poly]
             for r in shifted:
                 r[:, 0] = np.where(r[:, 0] < 0, r[:, 0] + 360.0, r[:, 0])
-            east = [_clip_ring_x(r, 180.0, keep_le=True) for r in shifted]
-            west = [_clip_ring_x(r, 180.0, keep_le=False) for r in shifted]
+            east = [_clip_ring_halfplane(r, 0, 180.0, keep_le=True) for r in shifted]
+            west = [_clip_ring_halfplane(r, 0, 180.0, keep_le=False) for r in shifted]
             east = [r for r in east if len(r) >= 4]
             west = [r for r in west if len(r) >= 4]
             if east:
@@ -241,17 +277,20 @@ class Geometry:
 # internal helpers
 # ---------------------------------------------------------------------------
 
-def _clip_ring_x(ring: Ring, x0: float, keep_le: bool) -> Ring:
-    """Sutherland-Hodgman clip of a ring against the half-plane
-    x <= x0 (or x >= x0), closing the result."""
+def _clip_ring_halfplane(ring: Ring, axis: int, bound: float,
+                         keep_le: bool) -> Ring:
+    """Sutherland-Hodgman clip of a ring against an axis-aligned
+    half-plane (coord[axis] <= bound or >= bound), closing the result."""
     def inside(p):
-        return p[0] <= x0 if keep_le else p[0] >= x0
+        return p[axis] <= bound if keep_le else p[axis] >= bound
 
     def cross(p0, p1):
-        t = (x0 - p0[0]) / (p1[0] - p0[0])
-        return np.array([x0, p0[1] + t * (p1[1] - p0[1])])
+        t = (bound - p0[axis]) / (p1[axis] - p0[axis])
+        q = p0 + t * (np.asarray(p1, np.float64) - p0)
+        q[axis] = bound
+        return q
 
-    pts = list(ring)
+    pts = [np.asarray(p, np.float64) for p in ring]
     if len(pts) and np.array_equal(pts[0], pts[-1]):
         pts = pts[:-1]
     out: List[np.ndarray] = []

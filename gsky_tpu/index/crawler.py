@@ -89,6 +89,7 @@ def extract_geotiff(path: str, namespace: Optional[str] = None,
         stem = sanitize_namespace(
             os.path.splitext(os.path.basename(path))[0])
         ts = timestamp_from_filename(path)
+        ts_src = "filename" if ts else ""
         geo_md = []
         for b in range(1, g.count + 1):
             ns = namespace or (stem if g.count == 1 else f"{stem}_b{b}")
@@ -103,6 +104,7 @@ def extract_geotiff(path: str, namespace: Optional[str] = None,
                 "y_size": g.height,
                 "polygon": _polygon_wkt(g.gt, g.width, g.height),
                 "timestamps": [ts] if ts else [],
+                "timestamps_source": ts_src,
                 "nodata": g.nodata,
                 "band": b,
                 "overviews": [{"x_size": i.width, "y_size": i.height}
@@ -156,9 +158,11 @@ def extract_netcdf(path: str, approx_stats: bool = False) -> Dict:
             h, w = v.shape[-2], v.shape[-1]
             is_gl = gl is not None and gl[0].shape == (h, w)
             stamps = [fmt_time(t) for t in ts] if ts is not None else []
+            ts_src = "axis" if stamps else ""
             if not stamps:
                 fn_ts = timestamp_from_filename(path)
                 stamps = [fn_ts] if fn_ts else []
+                ts_src = "filename" if stamps else ""
             axes = []
             if len(v.shape) > 2 and ts is not None:
                 axes.append({"name": "time", "params": list(map(float, ts)),
@@ -177,6 +181,7 @@ def extract_netcdf(path: str, approx_stats: bool = False) -> Dict:
                 "y_size": h,
                 "polygon": gl_polygon if is_gl else _polygon_wkt(gt, w, h),
                 "timestamps": stamps,
+                "timestamps_source": ts_src,
                 "nodata": v.nodata,
                 "axes": axes or None,
             }
@@ -319,23 +324,43 @@ def extract_yaml(path: str, family: str) -> Dict:
     raise ValueError(f"unsupported yaml family: {family}")
 
 
-def extract(path: str, approx_stats: bool = False) -> Dict:
+def extract(path: str, approx_stats: bool = False,
+            rules=None) -> Dict:
+    """Extract one file's MAS record; ``rules`` (a `rulesets.RuleSet`
+    list, or None for the built-in product table) fold pattern-derived
+    timestamps/namespaces/SRS/geoloc overrides into the record
+    (`crawl/extractor/ruleset.go`)."""
     path = os.path.abspath(path)  # MAS scopes queries by path prefix
     low = path.lower()
     try:
         if low.endswith((".nc", ".nc4", ".cdf")):
-            return extract_netcdf(path, approx_stats)
-        if low.endswith((".tif", ".tiff", ".gtiff")):
-            return extract_geotiff(path, approx_stats=approx_stats)
-        # sniff
-        with open(path, "rb") as fp:
-            magic = fp.read(8)
-        if magic[:3] == b"CDF" or magic[:8] == b"\x89HDF\r\n\x1a\n":
-            return extract_netcdf(path, approx_stats)
-        return extract_geotiff(path, approx_stats=approx_stats)
+            rec = extract_netcdf(path, approx_stats)
+        elif low.endswith((".tif", ".tiff", ".gtiff")):
+            rec = extract_geotiff(path, approx_stats=approx_stats)
+        else:
+            # sniff
+            with open(path, "rb") as fp:
+                magic = fp.read(8)
+            if magic[:3] == b"CDF" or magic[:8] == b"\x89HDF\r\n\x1a\n":
+                rec = extract_netcdf(path, approx_stats)
+            else:
+                rec = extract_geotiff(path, approx_stats=approx_stats)
     except Exception as e:
         return {"filename": path, "file_type": "", "error": str(e),
                 "geo_metadata": []}
+    try:
+        from .rulesets import apply_ruleset, match_rule
+        rule, m = match_rule(path, rules)
+        if rule is not None and rule.collection != "default":
+            apply_ruleset(rule, m, rec, path)
+    except Exception:
+        # extract() never raises (per-file error records instead); a
+        # bad user rule (e.g. invalid regex, compiled lazily) must not
+        # kill the whole crawl — the unmodified record still stands
+        import logging
+        logging.getLogger("gsky.crawl").warning(
+            "ruleset application failed for %s", path, exc_info=True)
+    return rec
 
 
 def main(argv=None):
@@ -359,7 +384,16 @@ def main(argv=None):
                          "the workers' 'info' op instead of in-process "
                          "(the online info pipeline, "
                          "processor/info_pipeline.go)")
+    ap.add_argument("-rules", default="",
+                    help="JSON ruleset config ({\"rule_sets\": [...]}, "
+                         "crawl/extractor/ruleset.go schema); built-in "
+                         "product rules append as fallback")
     args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        from .rulesets import load_rulesets
+        rules = load_rulesets(args.rules)
 
     paths: List[str] = []
     for p in args.paths:
@@ -398,7 +432,7 @@ def main(argv=None):
         except Exception as e:
             return {"filename": os.path.abspath(p), "file_type": "",
                     "error": str(e), "geo_metadata": []}
-        return extract(p, args.approx)
+        return extract(p, args.approx, rules=rules)
 
     with cf.ThreadPoolExecutor(args.conc) as ex:
         for rec in ex.map(run_one, paths):

@@ -130,15 +130,37 @@ class DrillPipeline:
         return self.mas.intersects(req.collection, **kw)
 
     def process(self, req: GeoDrillRequest) -> DrillResult:
+        # large-polygon tiling (`drill_indexer.go:115-137`): each tiled
+        # sub-geometry runs the index + per-file reductions separately,
+        # and the (namespace, date) accumulator merges them count-
+        # weighted — identical maths to multiple files covering the
+        # polygon, so memory stays bounded by one tile's window
+        tiles = tiled_geometries(req.geometry_wkt,
+                                 req.index_tile_x_size,
+                                 req.index_tile_y_size)
+        if len(tiles) > 1:
+            import dataclasses
+            acc: Dict[Tuple[str, float],
+                      List[Tuple[float, int]]] = defaultdict(list)
+            approx_seen: set = set()
+            for wkt in tiles:
+                sub = dataclasses.replace(req, geometry_wkt=wkt,
+                                          index_tile_x_size=0.0,
+                                          index_tile_y_size=0.0)
+                self._drill_into(sub, acc, approx_seen)
+            return _merge(acc, req)
+        acc = defaultdict(list)
+        self._drill_into(req, acc)
+        return _merge(acc, req)
+
+    def _drill_into(self, req: GeoDrillRequest, acc,
+                    approx_seen: Optional[set] = None) -> None:
         datasets = self.index(req)
         g4326 = geom.from_wkt(req.geometry_wkt)
 
         mask_ds = [d for d in datasets
                    if d.namespace in set(req.mask_namespaces)]
         data_ds = [d for d in datasets if d not in mask_ds]
-
-        # (namespace, date) -> [(value, count)] accumulated across files
-        acc: Dict[Tuple[str, float], List[Tuple[float, int]]] = defaultdict(list)
 
         for ds in data_ds:
             sel = _selected_times(ds, req)
@@ -181,7 +203,15 @@ class DrillPipeline:
                 vrt_xml = render_vrt(req.vrt_xml, ds.file_path, masks)
             elif req.approx and ds.means and ds.sample_counts \
                     and len(ds.means) >= len(ds.timestamps):
-                # crawler-stats fast path: no file IO at all
+                # crawler-stats fast path: no file IO at all.  The stats
+                # are WHOLE-FILE aggregates, so under polygon tiling a
+                # file spanning several tiles must contribute exactly
+                # once or merged means skew toward multi-tile files
+                if approx_seen is not None:
+                    k = (ds.file_path, ds.ds_name, ds.namespace)
+                    if k in approx_seen:
+                        continue
+                    approx_seen.add(k)
                 for ti in sel:
                     date = ds.timestamps[ti] if ds.timestamps else 0.0
                     acc[(ds.namespace, date)].append(
@@ -200,7 +230,45 @@ class DrillPipeline:
                     acc[(f"{ds.namespace}_d{d + 1}", date)].append(
                         (float(deciles[k, d]), 1))
 
-        return _merge(acc, req)
+
+def tiled_geometries(wkt: str, step_x: float,
+                     step_y: float) -> List[str]:
+    """Split an area geometry into index-tile intersections
+    (`drill_indexer.go:386-520` getTiledGeometries): a grid of
+    (step_x, step_y)-degree tiles over the envelope, each clipped
+    against the polygon; non-area geometries and disabled steps pass
+    through whole.  Degenerate output falls back to the whole
+    geometry (reference behaviour on getTiledGeometries error)."""
+    if step_x <= 0.0 and step_y <= 0.0:
+        return [wkt]
+    try:
+        g = geom.from_wkt(wkt)
+        if g.kind not in ("Polygon", "MultiPolygon") or g.is_empty:
+            return [wkt]
+        b = g.bbox()
+        sx = step_x if step_x > 0 else (b.xmax - b.xmin) or 1.0
+        sy = step_y if step_y > 0 else (b.ymax - b.ymin) or 1.0
+        if b.xmax - b.xmin <= sx and b.ymax - b.ymin <= sy:
+            return [wkt]
+        from ..geo.transform import BBox as _BBox
+        # integer tile counts, not float accumulation: stepping x += sx
+        # emits ~1e-16-wide sliver tiles when the extent divides evenly,
+        # and ALL_TOUCHED burns re-count the whole edge row for them
+        nx = max(int(math.ceil((b.xmax - b.xmin) / sx - 1e-9)), 1)
+        ny = max(int(math.ceil((b.ymax - b.ymin) / sy - 1e-9)), 1)
+        out = []
+        for iy in range(ny):
+            y1 = b.ymax - iy * sy
+            y0 = max(y1 - sy, b.ymin)
+            for ix in range(nx):
+                x0 = b.xmin + ix * sx
+                x1 = min(x0 + sx, b.xmax)
+                c = g.clip_bbox(_BBox(x0, y0, x1, y1))
+                if not c.is_empty:
+                    out.append(c.to_wkt())
+        return out or [wkt]
+    except Exception:
+        return [wkt]
 
 
 def _times_match(data: Dataset, mask: Dataset) -> bool:

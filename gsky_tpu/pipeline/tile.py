@@ -133,8 +133,10 @@ class TilePipeline:
         yres = (ymax - ymin) / res_h
         if max(xres, yres) <= req.index_res_limit:
             return None
-        mx = int(res_w * req.index_tile_x_size) or res_w
-        my = int(res_h * req.index_tile_y_size) or res_h
+        mx = int(res_w * req.index_tile_x_size)
+        my = int(res_h * req.index_tile_y_size)
+        mx = mx if mx > 0 else res_w
+        my = my if my > 0 else res_h
         if mx >= res_w and my >= res_h:
             return None
         subs = []

@@ -70,8 +70,8 @@ class GeoTileRequest:
     # layer extent is known, the MAS query splits into index tiles of
     # 256*index_tile_{x,y}_size pixels each
     spatial_extent: Optional[Tuple[float, float, float, float]] = None
-    index_tile_x_size: float = 1.0
-    index_tile_y_size: float = 1.0
+    index_tile_x_size: float = 0.0
+    index_tile_y_size: float = 0.0
     index_res_limit: float = 0.0
     # P2(c) per-granule dst sub-tiling on the worker RPC path
     # (`tile_grpc.go:143-198`): <=1.0 means a fraction of the dst tile,
@@ -151,6 +151,11 @@ class GeoDrillRequest:
     vrt_url: str = ""
     vrt_xml: str = ""
     mask_namespaces: Sequence[str] = ()   # namespaces feeding .Masks
+    # large-polygon tiling (`drill_indexer.go:115-137` +
+    # getTiledGeometries): the polygon splits into index tiles of this
+    # size in degrees; 0 disables
+    index_tile_x_size: float = 0.0
+    index_tile_y_size: float = 0.0
 
     _exprs: Optional[BandExpressions] = None
 

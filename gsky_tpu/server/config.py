@@ -136,8 +136,10 @@ class Layer:
     # P2(b)/P2(c) spatial decomposition knobs (`utils/config.go:172-177`)
     grpc_tile_x_size: float = 0.0
     grpc_tile_y_size: float = 0.0
-    index_tile_x_size: float = 1.0
-    index_tile_y_size: float = 1.0
+    # <=0 disables: fraction-of-256 semantics in the tile indexer,
+    # degrees in the drill indexer — the reference overloads one field
+    index_tile_x_size: float = 0.0
+    index_tile_y_size: float = 0.0
     index_res_limit: float = 0.0
     feature_info_max_dates: int = 0
     feature_info_bands: List[str] = field(default_factory=list)
@@ -244,8 +246,8 @@ class Layer:
             band_strides=i("band_strides", 1),
             grpc_tile_x_size=f("grpc_tile_x_size"),
             grpc_tile_y_size=f("grpc_tile_y_size"),
-            index_tile_x_size=f("index_tile_x_size", 1.0),
-            index_tile_y_size=f("index_tile_y_size", 1.0),
+            index_tile_x_size=f("index_tile_x_size"),
+            index_tile_y_size=f("index_tile_y_size"),
             index_res_limit=f("index_res_limit"),
             feature_info_max_dates=i("feature_info_max_dates"),
             feature_info_bands=list(j.get("feature_info_bands", []) or []),

@@ -738,7 +738,9 @@ class OWSServer:
                 band_strides=src.band_strides,
                 pixel_count="pixel_count" in proc.drill_algorithm,
                 vrt_url=src.vrt_url, vrt_xml=vrt_xml,
-                mask_namespaces=[src.mask.id] if src.mask else ())
+                mask_namespaces=[src.mask.id] if src.mask else (),
+                index_tile_x_size=src.index_tile_x_size,
+                index_tile_y_size=src.index_tile_y_size)
             dp = DrillPipeline(self._mas(cfg))
             # year-stepped splitting (TimeSplitter parity) bounds the
             # per-window working set for multi-decade drills

@@ -494,3 +494,90 @@ class TestResponseCache:
             assert s == 400
             assert cache.hits == 0
         self._run(app, scenario)
+
+
+class TestRulesets:
+    """Config-driven crawler rulesets (`crawl/extractor/ruleset.go`):
+    pattern-derived timestamps, namespace modes, SRS/bbox overrides,
+    geolocation rules."""
+
+    def test_builtin_products_match(self):
+        from gsky_tpu.index.rulesets import match_rule
+
+        cases = {
+            "LC81390452014295LGN00_B4.TIF": "landsat",
+            "MCD43A4.A2018123.h29v11.006.2018132203233.hdf": "modis1",
+            "T55HFA_20200110T001109_B04.jp2": "sentinel2",
+            "20200110013000-P1S-ABOM_OBS_B01-PRJ_GEOS141_2000"
+            "-HIMAWARI8-AHI.nc": "himawari8",
+            "LS8_OLI_NBAR_3577_15_-40_2016.nc": "agdc_landsat1",
+            "chirps-v2.0.2019.dekads.nc": "chirps2.0",
+            "tmax_6hrs_ERAI_historical_fc-sfc_20010101_20011231.nc":
+                "era-interim",
+            "Elevation_1secSRTM_DEMs_v1.0_DEM-S_Tiles_e147s35dems.nc":
+                "elevation_ga",
+            "something_roms_his.nc": "ereef",
+            "unmatchable_xyz.bin": "default",
+        }
+        for fn, want in cases.items():
+            rule, m = match_rule("/data/" + fn)
+            assert rule is not None and rule.collection == want, \
+                (fn, rule.collection if rule else None)
+
+    def test_timestamp_from_groups(self):
+        from gsky_tpu.index.rulesets import match_rule, \
+            timestamp_from_groups
+
+        rule, m = match_rule("/d/LC81390452014295LGN00_B4.TIF")
+        ts = timestamp_from_groups(m.groupdict())
+        assert ts.startswith("2014-10-22")        # julian day 295
+        rule, m = match_rule("/d/T55HFA_20200110T001109_B04.jp2")
+        ts = timestamp_from_groups(m.groupdict())
+        assert ts == "2020-01-10T00:11:09.000Z"
+
+    def test_ns_path_override_applied(self, tmp_path):
+        from gsky_tpu.geo.crs import parse_crs
+        from gsky_tpu.geo.transform import GeoTransform
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io import write_geotiff
+
+        gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+        p = str(tmp_path / "T55HFA_20200110T001109_B04.jp2")
+        # content is a tiff; the rule matches on the NAME
+        data = np.full((32, 32), 7, np.int16)
+        write_geotiff(p, data, gt, parse_crs("EPSG:32755"))
+        rec = extract(p)
+        assert not rec.get("error")
+        ds = rec["geo_metadata"][0]
+        assert ds["namespace"] == "B04"            # ns_path group
+        assert ds["timestamps"] == ["2020-01-10T00:11:09.000Z"]
+
+    def test_config_rules_take_precedence(self, tmp_path):
+        import json as _json
+
+        from gsky_tpu.index.rulesets import load_rulesets, match_rule
+
+        conf = tmp_path / "rules.json"
+        conf.write_text(_json.dumps({"rule_sets": [
+            {"collection": "mine", "namespace": "ns_path",
+             "pattern": r"^special_(?P<namespace>\w+)\.nc$"}]}))
+        rules = load_rulesets(str(conf))
+        rule, m = match_rule("/x/special_sst.nc", rules)
+        assert rule.collection == "mine"
+        assert m.group("namespace") == "sst"
+        # built-ins still there as fallback
+        rule, _ = match_rule("/x/chirps-v2.0.2019.dekads.nc", rules)
+        assert rule.collection == "chirps2.0"
+
+    def test_geoloc_rule_template(self):
+        from gsky_tpu.index.rulesets import apply_ruleset, match_rule
+
+        rec = {"geo_metadata": [{"namespace": "temp", "timestamps": []}]}
+        rule, m = match_rule("/data/ocean_roms_2020.nc")
+        assert rule.collection == "ereef"
+        apply_ruleset(rule, m, rec, "/data/ocean_roms_2020.nc")
+        gl = rec["geo_metadata"][0]["geo_loc"]
+        assert gl["x_var"] == "lon_v" and gl["y_var"] == "lat_v"
+        # SRS + bbox overrides ride along
+        assert rec["geo_metadata"][0]["proj_wkt"] == "EPSG:4326"
+        assert "POLYGON" in rec["geo_metadata"][0]["polygon"]
