@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Container entry — the `docker/gsky_entry_point.sh:23-39` equivalent:
+# synthesise the sample archive, ingest it, start mas/rpc/ows, smoke-
+# check a tile, then hold the stack up ("demo") or run the acceptance
+# suite against it and exit with its status ("accept").
+set -euo pipefail
+
+MODE="${1:-demo}"
+export DEMO_DIR="${DEMO_DIR:-/tmp/gsky_demo}"
+mkdir -p "$DEMO_DIR"
+
+if [ "$MODE" = "accept" ]; then
+    # stand the stack up in the background, run tools/accept.py, exit
+    (cd /gsky && ./tools/demo.sh) &
+    DEMO_PID=$!
+    for i in $(seq 1 90); do
+        if curl -sf "http://127.0.0.1:8080/ows?service=WMS&request=GetCapabilities" >/dev/null 2>&1; then
+            break
+        fi
+        sleep 1
+    done
+    cd /gsky
+    python tools/accept.py -H 127.0.0.1:8080 -s selftest
+    STATUS=$?
+    kill "$DEMO_PID" 2>/dev/null || true
+    exit "$STATUS"
+fi
+
+exec /gsky/tools/demo.sh
