@@ -151,6 +151,14 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    # pin the jax platform BEFORE any pipeline import can touch a
+    # device: with the axon relay wedged, bare jax init hangs
+    # uninterruptibly (DEVICE.md), so probe in a subprocess and fall
+    # back to CPU exactly as bench.py does
+    from gsky_tpu.device import ensure_platform
+    plat = ensure_platform(retries=1, timeout_s=45.0)
+    if plat["fallback"]:
+        print("accelerator unreachable; selftest on CPU", flush=True)
     import bench as B
     from gsky_tpu.index import MASClient
     from gsky_tpu.server.config import ConfigWatcher
