@@ -9,6 +9,8 @@ auto min-max scaling, and drill reductions.
 """
 
 from .mesh import make_mesh
-from .render import make_sharded_render, make_sharded_drill
+from .render import (make_sharded_drill, make_sharded_render,
+                     make_sharded_render_padded)
 
-__all__ = ["make_mesh", "make_sharded_render", "make_sharded_drill"]
+__all__ = ["make_mesh", "make_sharded_render",
+           "make_sharded_render_padded", "make_sharded_drill"]

@@ -145,6 +145,48 @@ def make_sharded_render(mesh: Mesh, method: str = "near",
     return jax.jit(step)
 
 
+def make_sharded_render_padded(mesh: Mesh, method: str = "near",
+                               expr: Optional[Callable] = None,
+                               combine: str = "gather") -> Callable:
+    """`make_sharded_render` for inputs whose granule count / width do
+    NOT divide the mesh: the granule axis pads with invalid layers (the
+    newest-wins combine ignores them — same trick the single-device
+    mosaic uses for its pow2 buckets) and the width pads then crops.
+    Real granule stacks rarely arrive in mesh-divisible sizes, so this
+    is the entry production callers want; the raw step stays available
+    for pre-sized inputs."""
+    step = make_sharded_render(mesh, method, expr, combine)
+    ng = mesh.shape[AXIS_GRANULE]
+    nx = mesh.shape[AXIS_X]
+
+    def padded(src, valid, rows, cols, lut):
+        src = jnp.asarray(src)
+        valid = jnp.asarray(valid)
+        rows = jnp.asarray(rows)
+        cols = jnp.asarray(cols)
+        T = src.shape[0]
+        w = rows.shape[-1]
+        Tp = -(-T // ng) * ng
+        wp = -(-w // nx) * nx
+        if Tp != T:
+            padT = [(0, Tp - T)] + [(0, 0)] * (src.ndim - 1)
+            src = jnp.pad(src, padT)
+            valid = jnp.pad(valid, padT, constant_values=False)
+            padR = [(0, Tp - T)] + [(0, 0)] * (rows.ndim - 1)
+            # out-of-range coords: padded granules sample nothing even
+            # before their all-False validity is consulted
+            rows = jnp.pad(rows, padR, constant_values=-1e6)
+            cols = jnp.pad(cols, padR, constant_values=-1e6)
+        if wp != w:
+            padW = [(0, 0)] * (rows.ndim - 1) + [(0, wp - w)]
+            rows = jnp.pad(rows, padW, constant_values=-1e6)
+            cols = jnp.pad(cols, padW, constant_values=-1e6)
+        out = step(src, valid, rows, cols, jnp.asarray(lut))
+        return out[:, :w] if wp != w else out
+
+    return padded
+
+
 def make_sharded_drill(mesh: Mesh) -> Callable:
     """Build a jitted SPMD drill step: per-timestep masked means over a
     polygon mask (`worker/gdalprocess/drill.go:128-220`), with the pixel

@@ -458,10 +458,39 @@ def get_layer_dates(layer: Layer, mas: Optional[MASClient] = None):
 # ---------------------------------------------------------------------------
 
 _GDOC_RE = re.compile(r"\$gdoc\$(.*?)\$gdoc\$", re.S)
+_JET_COMMENT_RE = re.compile(r"\{\*.*?\*\}", re.S)
+_JET_INCLUDE_RE = re.compile(
+    r"\{\{-?\s*include\s+\"([^\"]+)\"\s*-?\}\}")
 
 
-def _preprocess(text: str) -> str:
-    """$gdoc$...$gdoc$ heredocs -> JSON strings (`config.go:1067-1122`)."""
+def _expand_template(text: str, base_dir: str, depth: int = 0) -> str:
+    """The Jet template pass (`config.go:1067-1085` runs the config
+    through jet before gdoc escaping).  Configs in the wild use the
+    engine for file composition, so the semantics that matter are
+    supported directly: ``{* ... *}`` comments strip, and
+    ``{{ include "relative/path" }}`` splices another (recursively
+    templated) file.  Unknown ``{{ ... }}`` actions are left verbatim —
+    with the reference's empty VarMap they could only error anyway."""
+    if depth > 8:
+        raise ValueError("config template includes nested too deep")
+    text = _JET_COMMENT_RE.sub("", text)
+
+    def repl(m):
+        inc = m.group(1)
+        p = inc if os.path.isabs(inc) else os.path.join(base_dir, inc)
+        with open(p) as fp:
+            return _expand_template(fp.read(), os.path.dirname(p),
+                                    depth + 1)
+
+    return _JET_INCLUDE_RE.sub(repl, text)
+
+
+def _preprocess(text: str, base_dir: str = "") -> str:
+    """Template pass + $gdoc$...$gdoc$ heredocs -> JSON strings
+    (`config.go:1067-1122`; gdoc escaping runs AFTER the template, as
+    the reference does)."""
+    text = _expand_template(text, base_dir or ".")
+
     def repl(m):
         return json.dumps(m.group(1))
     return _GDOC_RE.sub(repl, text)
@@ -469,7 +498,8 @@ def _preprocess(text: str) -> str:
 
 def load_config_file(path: str, namespace: str = "") -> Config:
     with open(path) as fp:
-        j = json.loads(_preprocess(fp.read()))
+        j = json.loads(_preprocess(fp.read(),
+                                   os.path.dirname(os.path.abspath(path))))
     sc = j.get("service_config", {})
     cfg = Config(
         service_config=ServiceConfig(

@@ -116,3 +116,47 @@ def test_global_mesh_host_major_layout():
     assert m.shape["granule"] == max(1, n // per)
     assert m.shape["x"] == per
     assert m.shape["granule"] * m.shape["x"] == n
+
+
+class TestNonDivisibleSharding:
+    """Real granule stacks don't arrive mesh-divisible: the padded
+    entry must agree with the single-device reference for any (T, w),
+    and prime device counts must still build a working mesh."""
+
+    def test_padded_render_odd_t_and_w(self, mesh):
+        from gsky_tpu.parallel import make_sharded_render_padded
+
+        # T=5 not divisible by the granule dim (2); w=50 not by the x
+        # dim (4) — both pad paths must run on the standard mesh
+        src, valid, rows, cols, lut = _scene(T=5, h=32, w=50, seed=9)
+        render = make_sharded_render_padded(mesh)
+        got = np.asarray(render(src, valid, rows, cols, lut))
+        want = _reference_rgba(src, valid, rows, cols, lut)
+        np.testing.assert_array_equal(got, want)
+
+    def test_padded_render_ring_combine(self, mesh):
+        from gsky_tpu.parallel import make_sharded_render_padded
+
+        src, valid, rows, cols, lut = _scene(T=3, h=32, w=20, seed=10)
+        render = make_sharded_render_padded(mesh, combine="ring")
+        got = np.asarray(render(src, valid, rows, cols, lut))
+        want = _reference_rgba(src, valid, rows, cols, lut)
+        np.testing.assert_array_equal(got, want)
+
+    def test_prime_device_count_mesh(self):
+        from gsky_tpu.parallel import (make_mesh,
+                                       make_sharded_render_padded)
+
+        mesh7 = make_mesh(7)       # non-factorable: (1, 7)
+        assert mesh7.shape["granule"] * mesh7.shape["x"] == 7
+        src, valid, rows, cols, lut = _scene(T=4, h=16, w=30, seed=11)
+        render = make_sharded_render_padded(mesh7)
+        got = np.asarray(render(src, valid, rows, cols, lut))
+        want = _reference_rgba(src, valid, rows, cols, lut)
+        np.testing.assert_array_equal(got, want)
+
+    def test_mesh_shape_mismatch_raises(self):
+        from gsky_tpu.parallel import make_mesh
+
+        with pytest.raises(ValueError):
+            make_mesh(8, shape=(3, 2))

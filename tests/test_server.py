@@ -460,6 +460,32 @@ class TestConfigSystem:
         cfgs = load_config_tree(str(tmp_path), load_dates=False)
         assert 'line "quoted"\nsecond' == cfgs[""].layers[0].abstract
 
+    def test_template_include_and_comments(self, tmp_path):
+        """Jet-pass subset (`config.go:1067-1085`): {{include}} splices
+        files (recursively), {* comments *} strip, and gdoc escaping in
+        included text still applies (template runs first)."""
+        (tmp_path / "palette.json").write_text(
+            '{"interpolate": true, "colours": ['
+            '{"R": 0, "G": 0, "B": 120, "A": 255}]}')
+        (tmp_path / "layer.json").write_text(
+            '{"name": "inc", {* a note *} '
+            '"abstract": $gdoc$from "include"$gdoc$, '
+            '"palette": {{ include "palette.json" }}}')
+        (tmp_path / "config.json").write_text(
+            '{"layers": [ {{include "layer.json"}} ]}')
+        cfgs = load_config_tree(str(tmp_path), load_dates=False)
+        lay = cfgs[""].layers[0]
+        assert lay.name == "inc"
+        assert lay.abstract == 'from "include"'
+        assert lay.palette and lay.palette.colours == [(0, 0, 120, 255)]
+
+    def test_template_include_depth_bound(self, tmp_path):
+        (tmp_path / "config.json").write_text(
+            '{{include "config.json"}}')
+        # the explicit bound, not RecursionError-by-accident
+        with pytest.raises(ValueError, match="nested too deep"):
+            load_config_tree(str(tmp_path), load_dates=False)
+
     def test_reload(self, tmp_path):
         (tmp_path / "config.json").write_text(json.dumps(
             {"layers": [{"name": "a"}]}))
