@@ -1,4 +1,5 @@
+from .sharded import MASShardedStore
 from .store import MASStore
 from .client import MASClient, Dataset
 
-__all__ = ["MASStore", "MASClient", "Dataset"]
+__all__ = ["MASStore", "MASShardedStore", "MASClient", "Dataset"]

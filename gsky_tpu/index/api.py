@@ -125,9 +125,18 @@ def main(argv=None):
     ap.add_argument("-host", default="0.0.0.0")
     ap.add_argument("-ingest", nargs="*", default=[],
                     help="crawler TSV/JSON files to ingest at startup")
+    ap.add_argument("-shard-root", default="",
+                    help="serve a sharded index instead: one sqlite "
+                         "shard per top-level directory under this "
+                         "root (schema-per-shard analogue, "
+                         "mas/MAS_Design.md:11-17)")
     args = ap.parse_args(argv)
 
-    store = MASStore(args.database)
+    if args.shard_root:
+        from .sharded import MASShardedStore
+        store = MASShardedStore(args.shard_root)
+    else:
+        store = MASStore(args.database)
     for path in args.ingest:
         ingest_file(store, path)
     web.run_app(build_app(store), host=args.host, port=args.port,

@@ -88,8 +88,10 @@ class MASClient:
     """address: 'host:port' for HTTP, or a MASStore for in-process."""
 
     def __init__(self, address):
-        if isinstance(address, MASStore):
-            self._store: Optional[MASStore] = address
+        # duck-typed: MASStore or MASShardedStore (anything exposing
+        # the intersects/timestamps/extents surface) binds in-process
+        if hasattr(address, "intersects"):
+            self._store = address
             self.address = "<in-process>"
         else:
             self._store = None

@@ -52,6 +52,13 @@ def parse_time(s: str) -> float:
     raise ValueError(f"cannot parse time {s!r}")
 
 
+def timestamps_token(result) -> str:
+    """The ?timestamps cache token (`mas/api/mas.sql:549-598`): one
+    definition shared by the single store and the sharded router so the
+    protocols cannot drift."""
+    return hashlib.md5(json.dumps(list(result)).encode()).hexdigest()
+
+
 def fmt_time(t: float) -> str:
     return dt.datetime.fromtimestamp(t, dt.timezone.utc).strftime(ISO)
 
@@ -334,7 +341,7 @@ class MASStore:
                 if (t_a is None or t >= t_a) and t <= t_b:
                     stamps.add(t)
         result = [fmt_time(t) for t in sorted(stamps)]
-        query_token = hashlib.md5(json.dumps(result).encode()).hexdigest()
+        query_token = timestamps_token(result)
         if token and token == query_token:
             return {"timestamps": [], "token": token}
         return {"timestamps": result, "token": query_token}

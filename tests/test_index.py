@@ -2,6 +2,7 @@
 
 import asyncio
 import json
+import os
 
 import numpy as np
 import pytest
@@ -581,3 +582,113 @@ class TestRulesets:
         # SRS + bbox overrides ride along
         assert rec["geo_metadata"][0]["proj_wkt"] == "EPSG:4326"
         assert "POLYGON" in rec["geo_metadata"][0]["polygon"]
+
+
+class TestShardedStore:
+    """Schema-per-shard scale path (`mas/MAS_Design.md:11-17`): one
+    sqlite shard per top-level collection directory, routed by gpath."""
+
+    def _build(self, tmp_path):
+        from gsky_tpu.geo.crs import parse_crs
+        from gsky_tpu.geo.transform import GeoTransform
+        from gsky_tpu.index import MASShardedStore
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io import write_geotiff
+
+        root = tmp_path / "data"
+        utm = parse_crs("EPSG:32755")
+        rng = np.random.default_rng(0)
+        for coll, east in (("landsat", 590000.0), ("sentinel", 600000.0)):
+            d = root / coll
+            d.mkdir(parents=True)
+            gt = GeoTransform(east, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+            write_geotiff(str(d / f"{coll}_20200110.tif"),
+                          rng.uniform(1, 9, (64, 64)).astype(np.int16),
+                          gt, utm, nodata=-9)
+        store = MASShardedStore(str(root))
+        for coll in ("landsat", "sentinel"):
+            rec = extract(str(root / coll / f"{coll}_20200110.tif"))
+            assert not rec.get("error")
+            store.ingest(rec)
+        return root, store
+
+    def test_routes_and_fans_out(self, tmp_path):
+        root, store = self._build(tmp_path)
+        # per-collection gpath -> its shard only
+        one = store.intersects(str(root / "landsat"), metadata="gdal")
+        assert len(one["gdal"]) == 1
+        assert "landsat" in one["gdal"][0]["file_path"]
+        # root gpath -> fan-out over both shards
+        both = store.intersects(str(root), metadata="gdal")
+        assert len(both["gdal"]) == 2
+        # two sqlite files on disk, independently rebuildable
+        dbs = sorted(os.listdir(root / ".gsky_mas"))
+        assert dbs == ["landsat.sqlite", "sentinel.sqlite"]
+
+    def test_timestamps_and_extents_merge(self, tmp_path):
+        root, store = self._build(tmp_path)
+        ts = store.timestamps(str(root))
+        assert len(ts["timestamps"]) == 1    # same date in both shards
+        ext = store.extents(str(root))
+        assert set(ext["variables"]) == {"landsat_20200110",
+                                         "sentinel_20200110"}
+        # token short-circuit works through the merge
+        again = store.timestamps(str(root), token=ts["token"])
+        assert again["timestamps"] == []
+
+    def test_reopen_adopts_existing_shards(self, tmp_path):
+        from gsky_tpu.index import MASShardedStore
+
+        root, store = self._build(tmp_path)
+        store2 = MASShardedStore(str(root))
+        both = store2.intersects(str(root), metadata="gdal")
+        assert len(both["gdal"]) == 2
+
+    def test_reads_never_create_junk_shards(self, tmp_path):
+        root, store = self._build(tmp_path)
+        before = sorted(os.listdir(root / ".gsky_mas"))
+        # arbitrary probe gpaths (an open HTTP endpoint sees these)
+        assert store.intersects(str(root / "no-such-collection"),
+                                metadata="gdal") == {"gdal": []}
+        assert store.timestamps(
+            str(root / "typo"))["timestamps"] == []
+        assert store.extents(str(root / "probe123")) == {}
+        assert sorted(os.listdir(root / ".gsky_mas")) == before
+
+    def test_rsynced_shard_adopted_live(self, tmp_path):
+        import shutil
+
+        root, store = self._build(tmp_path)
+        # simulate an independently built shard arriving via rsync
+        src = root / ".gsky_mas" / "landsat.sqlite"
+        shutil.copy(src, root / ".gsky_mas" / "newcoll.sqlite")
+        both = store.intersects(str(root), metadata="gdal")
+        assert len(both["gdal"]) == 3   # visible without restart
+
+    def test_fanout_files_sorted(self, tmp_path):
+        root, store = self._build(tmp_path)
+        files = store.intersects(str(root))["files"]
+        assert files == sorted(files) and len(files) == 2
+
+    def test_pipeline_over_sharded_store(self, tmp_path):
+        import datetime as dt
+
+        from gsky_tpu.geo.crs import EPSG3857, EPSG4326, parse_crs
+        from gsky_tpu.geo.transform import transform_bbox, GeoTransform
+        from gsky_tpu.index import MASClient
+        from gsky_tpu.pipeline import GeoTileRequest, TilePipeline
+
+        root, store = self._build(tmp_path)
+        gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+        merc = transform_bbox(
+            transform_bbox(gt.bbox(64, 64), parse_crs("EPSG:32755"),
+                           EPSG4326), EPSG4326, EPSG3857)
+        t0 = dt.datetime(2020, 1, 9,
+                         tzinfo=dt.timezone.utc).timestamp()
+        req = GeoTileRequest(
+            collection=str(root / "landsat"),
+            bands=["landsat_20200110"], bbox=merc, crs=EPSG3857,
+            width=64, height=64, start_time=t0,
+            end_time=t0 + 3 * 86400)
+        res = TilePipeline(MASClient(store)).process(req)
+        assert res.valid["landsat_20200110"].any()
