@@ -105,10 +105,11 @@ def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
     if granule.geo_loc:
         # curvilinear granules have no affine pixel grid; they render
         # through the scene path's geolocation-array ctrl inversion
-        # (executor._geoloc_ctrl), never through windowed affine warps.
-        # Loud, rate-limited: on paths that can't take the scene route
-        # (remote workers, mask-band renders) this granule degrades to
-        # empty, which must not look like absent data
+        # (executor._geoloc_ctrl) on every route — fused, modular/mask
+        # (tile.render's gl split), and remote (the worker's geoloc
+        # warp branch).  Reaching THIS window decode with a geoloc
+        # granule means a caller missed that routing; log loudly, the
+        # granule degrades to empty
         global _geoloc_skips
         _geoloc_skips += 1
         if _geoloc_skips <= 10 or _geoloc_skips % 1000 == 0:

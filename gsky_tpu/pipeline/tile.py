@@ -312,14 +312,46 @@ class TilePipeline:
             if self.remote is not None:
                 wr = self.remote.warp_many([granules[i] for i in idxs],
                                            req, method)
-            else:
-                ws = decode_all([granules[i] for i in idxs], req.bbox,
+                for k, i in enumerate(idxs):
+                    warped[i] = wr[k]
+                continue
+            # curvilinear granules have no affine window; they warp
+            # from the device scene cache via the geolocation ctrl
+            # path even on this modular (mask-band) route
+            reg = [i for i in idxs if not granules[i].geo_loc]
+            gl = [i for i in idxs if granules[i].geo_loc]
+            if reg:
+                ws = decode_all([granules[i] for i in reg], req.bbox,
                                 req.crs, method, self.decode_workers,
                                 dst_hw=(H, W))
-                wr = self.executor.warp_all(ws, req.dst_gt(), req.crs, H, W,
-                                            method)
-            for k, i in enumerate(idxs):
-                warped[i] = wr[k]
+                wr = self.executor.warp_all(ws, req.dst_gt(), req.crs,
+                                            H, W, method)
+                for k, i in enumerate(reg):
+                    warped[i] = wr[k]
+            if gl:
+                # one batched dispatch, each granule its own namespace
+                # slot so per-granule rasters come back for the mask
+                # machinery; on failure retry per granule so a single
+                # uncacheable file degrades alone
+                sc = self.executor.warp_mosaic_scenes(
+                    [granules[i] for i in gl], list(range(len(gl))),
+                    [1.0] * len(gl), req.dst_gt(), req.crs, H, W,
+                    len(gl), method)
+                if sc is not None:
+                    canv, vals = sc
+                    for k, i in enumerate(gl):
+                        warped[i] = (canv[k], vals[k])
+                else:
+                    for i in gl:
+                        one = self.executor.warp_mosaic_scenes(
+                            [granules[i]], [0], [1.0], req.dst_gt(),
+                            req.crs, H, W, 1, method)
+                        if one is None:
+                            log.warning(
+                                "curvilinear granule %s uncacheable; "
+                                "rendered empty", granules[i].path)
+                            continue
+                        warped[i] = (one[0][0], one[1][0])
         # group warped granules by base namespace
         by_ns: Dict[str, List[Tuple[Granule, np.ndarray, np.ndarray]]] = {}
         mask_by_stamp: Dict[float, np.ndarray] = {}

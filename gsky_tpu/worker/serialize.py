@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import json
 import math
 from typing import Optional, Tuple
 
@@ -24,6 +25,10 @@ def granule_to_pb(g: Granule) -> pb.Granule:
                                      and math.isnan(g.nodata)):
         m.nodata = float(g.nodata)
         m.has_nodata = True
+    if g.geo_loc:
+        m.geo_loc_json = json.dumps(g.geo_loc)
+    if g.polygon:
+        m.polygon = g.polygon
     return m
 
 
@@ -36,7 +41,9 @@ def granule_from_pb(m: pb.Granule) -> Granule:
         geo_transform=list(m.geo_transform),
         nodata=m.nodata if m.has_nodata else None,
         array_type=m.array_type or "Float32",
-        is_netcdf=m.is_netcdf, var_name=m.var_name)
+        is_netcdf=m.is_netcdf, var_name=m.var_name,
+        geo_loc=json.loads(m.geo_loc_json) if m.geo_loc_json else None,
+        polygon=m.polygon)
 
 
 def pack_raster(result: pb.Result, data: np.ndarray,

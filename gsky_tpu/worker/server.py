@@ -83,6 +83,36 @@ class WorkerService:
         from ..pipeline.decode import DecodedWindow
 
         d = task.dst
+        res = pb.Result()
+        g = granule_from_pb(task.granule)
+        if g.geo_loc:
+            # curvilinear granules have no affine window to decode; warp
+            # straight from the device scene cache through the
+            # geolocation ctrl-grid path (executor._geoloc_ctrl).  This
+            # read happens in-process rather than through the decode
+            # pool: the scene must land in THIS process's HBM cache
+            # anyway, and the NetCDF read path here is Python/h5py (the
+            # crash-prone native codec is the TIFF path) — the pool's
+            # isolation buys little for the cost of a second full-scene
+            # copy over IPC.
+            dst_gt = GeoTransform.from_gdal(list(d.geo_transform))
+            sc = self.executor.warp_mosaic_scenes(
+                [g], [0], [1.0], dst_gt, parse_crs(d.srs), d.height,
+                d.width, 1, d.resample or "near")
+            if sc is None:
+                # parity with the local path's loud degradation: a
+                # blank remote tile must not look like absent data
+                log.warning("curvilinear granule %s uncacheable; "
+                            "warp RPC returns empty", g.path)
+                return res
+            canv, vals = sc
+            pack_raster(res, np.asarray(canv[0]), np.asarray(vals[0]))
+            b = dst_gt.bbox(d.width, d.height)
+            res.bbox.extend([b.xmin, b.ymin, b.xmax, b.ymax])
+            res.dtype = "Float32"
+            res.metrics.bytes_read = int(
+                np.asarray(canv[0]).nbytes)
+            return res
         decode = pb.Task()
         decode.CopyFrom(task)
         decode.operation = "decode"
@@ -90,12 +120,11 @@ class WorkerService:
         if dres.error:
             return dres
         win = unpack_raster(dres)
-        res = pb.Result()
         if win is None:  # granule doesn't touch the tile -> empty result
             return res
         data, valid = win
         wdw = DecodedWindow(
-            granule=granule_from_pb(task.granule), data=data, valid=valid,
+            granule=g, data=data, valid=valid,
             window_gt=GeoTransform.from_gdal(list(dres.window_gt)),
             src_crs=parse_crs(dres.src_srs))
         dst_gt = GeoTransform.from_gdal(list(d.geo_transform))

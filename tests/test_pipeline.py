@@ -613,6 +613,20 @@ class TestMultiCRSMosaic:
             assert np.mean(fd != wd) < 0.02  # approx-transform flips
 
 
+
+
+def dataclasses_replace_mask(req):
+    """Clone a request with a mask spec that matches nothing, purely to
+    push render() onto the modular (non-fused) route."""
+    import dataclasses
+
+    from gsky_tpu.pipeline.types import MaskSpec
+    # value "0": bitwise AND with 0 excludes nothing, so the render
+    # result must match the fused path exactly
+    return dataclasses.replace(req, mask=MaskSpec(id="bt", value="0",
+                                                  bit_tests=[]))
+
+
 class TestGeolocWarp:
     """Curvilinear (geolocation-array) products end-to-end: crawler
     detection -> MAS geo_loc record -> ctrl-point inversion -> fused
@@ -722,6 +736,32 @@ class TestGeolocWarp:
         col, row = grid.invert(qlon, qlat)
         np.testing.assert_allclose(row - 0.5, qi, atol=0.05)
         np.testing.assert_allclose(col - 0.5, qj, atol=0.05)
+
+
+    def test_modular_path_renders_geoloc(self, tmp_path):
+        """The mask-band/modular route must also serve curvilinear
+        granules (scene-cache geoloc warp, not the affine decode)."""
+        from gsky_tpu.index import MASStore, MASClient
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.pipeline import TilePipeline, GeoTileRequest
+
+        root, p, data = self._make(tmp_path)
+        store = MASStore()
+        store.ingest(extract(p))
+        bbox = BBox(147.35, -34.40, 147.75, -34.10)
+        req = GeoTileRequest(collection=root, bands=["bt"], bbox=bbox,
+                             crs=EPSG4326, width=96, height=96,
+                             resample="near")
+        pipe = TilePipeline(MASClient(store))
+        fused = pipe.process(req)
+        # force the modular route (what a mask-band request takes)
+        granules = pipe.index(req)
+        modular = pipe.render(
+            dataclasses_replace_mask(req), granules)
+        np.testing.assert_array_equal(
+            np.asarray(fused.valid["bt"]), np.asarray(modular.valid["bt"]))
+        np.testing.assert_array_equal(
+            np.asarray(fused.data["bt"]), np.asarray(modular.data["bt"]))
 
     def test_invert_across_antimeridian(self):
         from gsky_tpu.geo.geoloc import GeolocGrid

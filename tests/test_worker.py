@@ -567,3 +567,46 @@ class TestIndexSubdivision:
             index_tile_x_size=0.5, index_tile_y_size=0.5,
             index_res_limit=1e-9))
         assert out == [] and len(calls) == 0
+
+
+def test_grpc_geoloc_granule_warps(grpc_worker, tmp_path):
+    """Curvilinear granules must round-trip the worker path: geo_loc
+    rides the proto, and the worker warps from its scene cache through
+    the geolocation ctrl grid instead of the (impossible) affine
+    decode."""
+    from gsky_tpu.geo.crs import EPSG4326
+    from gsky_tpu.index import MASClient as MC, MASStore
+    from gsky_tpu.index.crawler import extract
+    from gsky_tpu.io.netcdf import write_netcdf3
+    from gsky_tpu.worker import WorkerClient
+
+    GH, GW = 120, 160
+    ii, jj = np.mgrid[0:GH, 0:GW].astype(np.float64)
+    lon = 147.0 + 0.004 * jj + 0.0012 * ii
+    lat = -34.0 - 0.003 * ii
+    data = (1000 + ii * 3 + jj * 7).astype(np.float32)
+    root = str(tmp_path / "glw")
+    os.makedirs(root)
+    p = os.path.join(root, "swath_20200110.nc")
+    write_netcdf3(p, {"bt": data, "lon": lon, "lat": lat},
+                  np.arange(GW, dtype=np.float64),
+                  np.arange(GH, dtype=np.float64), EPSG4326,
+                  nodata=-9999.0)
+    store = MASStore()
+    store.ingest(extract(p))
+    mas = MC(store)
+    req = GeoTileRequest(
+        collection=root, bands=["bt"],
+        bbox=BBox(147.2, -34.35, 147.5, -34.15), crs=EPSG4326,
+        width=96, height=96, resample="near")
+    local = TilePipeline(mas).process(req)
+    remote = TilePipeline(
+        mas, remote=WorkerClient([grpc_worker])).process(req)
+    assert np.asarray(local.valid["bt"]).sum() > 1000
+    np.testing.assert_array_equal(np.asarray(local.valid["bt"]),
+                                  np.asarray(remote.valid["bt"]))
+    l = np.asarray(local.data["bt"])
+    r = np.asarray(remote.data["bt"])
+    frac = np.mean(l[np.asarray(local.valid["bt"])] !=
+                   r[np.asarray(local.valid["bt"])])
+    assert frac < 0.02, f"{frac:.1%} differ"
