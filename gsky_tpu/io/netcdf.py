@@ -583,6 +583,15 @@ def write_netcdf3(path: str, arrays: Dict[str, np.ndarray],
     """Minimal CF NetCDF-3 writer: variables shaped (y, x) or
     (time, y, x) — the WCS NetCDF output analogue of
     `utils/ogc_encoders.go:277-346` (GDAL NetCDF create path)."""
+    for name, arr in arrays.items():
+        shp = np.asarray(arr).shape
+        want = (len(y), len(x))
+        if shp[-2:] != want:
+            # declaring (y, x) dims over differently-shaped data would
+            # write a silently corrupt file (header/data size mismatch)
+            raise ValueError(
+                f"variable {name!r} shape {shp} does not match the "
+                f"declared (y, x) dims {want}")
     dims: List[Tuple[str, int]] = []
     if times is not None:
         dims.append(("time", len(times)))

@@ -231,6 +231,101 @@ class DrillPipeline:
                         (float(deciles[k, d]), 1))
 
 
+def _geoloc_drill_mask(ds: Dataset, g4326: geom.Geometry, H: int,
+                       W: int):
+    """Polygon membership over a CURVILINEAR swath: every sample carries
+    its own coordinates, so membership is a vectorised containment test
+    on the geolocation arrays — the swath analogue of the affine
+    ALL_TOUCHED burn.  Returns (mask (uint8, window-shaped), window
+    (c0, r0, c1, r1) in RASTER pixels) or None when nothing matches.
+
+    Handles the details the naive test misses: the geometry is taken in
+    the geo_loc record's OWN srs (not ds.srs, which rulesets may
+    override); antimeridian-crossing swaths compare on the grid's
+    unwrapped longitude branch; a bbox prefilter crops the grid before
+    the O(edges x samples) ray cast; geoloc line/pixel offsets+steps map
+    grid indices to raster pixels (subsampled geolocation grids); and
+    point/line/sub-sample-size geometries fall back to marking the
+    samples nearest their vertices, so a tiny drill doesn't silently
+    report "no data"."""
+    from ..geo.geoloc import load_geoloc_grid
+    grid = load_geoloc_grid(ds.file_path, ds.geo_loc)
+    if grid is None:
+        return None
+    gl_srs = ds.geo_loc.get("srs") or "EPSG:4326"
+    try:
+        gl_crs = parse_crs(gl_srs)
+        g = g4326 if gl_crs == EPSG4326 else g4326.transform(
+            lambda x, y: EPSG4326.transform_to(gl_crs, x, y))
+    except ValueError:
+        return None
+    if grid._wraps:
+        # the grid longitudes live on the unwrapped [180, 360) branch
+        g = g.transform(lambda x, y: (np.where(np.asarray(x) < 0.0,
+                                               np.asarray(x) + 360.0,
+                                               np.asarray(x)), y))
+
+    gh, gw = grid.gx.shape
+    inpoly = np.zeros((gh, gw), bool)
+    if g.polys:
+        b = g.bbox()
+        with np.errstate(invalid="ignore"):
+            box = ((grid.gx >= b.xmin) & (grid.gx <= b.xmax)
+                   & (grid.gy >= b.ymin) & (grid.gy <= b.ymax))
+        if box.any():
+            rr = np.nonzero(box.any(axis=1))[0]
+            cc = np.nonzero(box.any(axis=0))[0]
+            sr, er = int(rr[0]), int(rr[-1]) + 1
+            sc, ec = int(cc[0]), int(cc[-1]) + 1
+            inpoly[sr:er, sc:ec] = geom.contains_mask(
+                g, grid.gx[sr:er, sc:ec], grid.gy[sr:er, sc:ec])
+    if not inpoly.any():
+        # point/line drills and polygons smaller than sample spacing:
+        # nearest-sample marking (the ALL_TOUCHED-style floor)
+        pts = []
+        if g.points is not None:
+            pts.append(np.asarray(g.points, np.float64))
+        for poly in g.polys:
+            for ring in poly:
+                if len(ring):
+                    pts.append(np.asarray(ring, np.float64))
+        if not pts:
+            return None
+        pts_a = np.concatenate(pts, axis=0)
+        col, row = grid.invert(pts_a[:, 0], pts_a[:, 1])
+        # invert() returns RASTER pixel coords; back to grid indices
+        gj = np.rint((col - 0.5 - grid.pixel_offset)
+                     / grid.pixel_step).astype(np.int64)
+        gi = np.rint((row - 0.5 - grid.line_offset)
+                     / grid.line_step).astype(np.int64)
+        ok = (gi >= 0) & (gi < gh) & (gj >= 0) & (gj < gw)
+        if not ok.any():
+            return None
+        inpoly[gi[ok], gj[ok]] = True
+
+    rr = np.nonzero(inpoly.any(axis=1))[0]
+    cc = np.nonzero(inpoly.any(axis=0))[0]
+    gr0, gr1 = int(rr[0]), int(rr[-1]) + 1
+    gc0, gc1 = int(cc[0]), int(cc[-1]) + 1
+    # grid indices -> raster pixels via the geoloc offsets/steps; a
+    # subsampled geolocation grid (pixel_step > 1) expands each sample
+    # to its step-sized block of raster pixels
+    ls = max(int(grid.line_step), 1)
+    ps = max(int(grid.pixel_step), 1)
+    r0 = int(grid.line_offset + ls * gr0)
+    c0 = int(grid.pixel_offset + ps * gc0)
+    sub = inpoly[gr0:gr1, gc0:gc1]
+    mask = np.repeat(np.repeat(sub, ls, axis=0), ps, axis=1)
+    r1 = min(r0 + mask.shape[0], H)
+    c1 = min(c0 + mask.shape[1], W)
+    if r0 >= r1 or c0 >= c1:
+        return None
+    mask = mask[:r1 - r0, :c1 - c0].astype(np.uint8)
+    if not mask.any():
+        return None
+    return mask, (c0, r0, c1, r1)
+
+
 def tiled_geometries(wkt: str, step_x: float,
                      step_y: float) -> List[str]:
     """Split an area geometry into index-tile intersections
@@ -329,24 +424,30 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
         except ValueError:  # unparseable SRS / out-of-domain projection
             return None
 
-        # envelope intersect + ALL_TOUCHED mask burn
-        b = g.bbox()
-        c0, r0 = gt.geo_to_pixel(b.xmin, b.ymax)
-        c1, r1 = gt.geo_to_pixel(b.xmax, b.ymin)
-        c0, c1 = sorted((c0, c1))
-        r0, r1 = sorted((r0, r1))
-        c0 = max(int(math.floor(c0)), 0)
-        r0 = max(int(math.floor(r0)), 0)
-        c1 = min(int(math.ceil(c1)), W)
-        r1 = min(int(math.ceil(r1)), H)
-        if c0 >= c1 or r0 >= r1:
-            return None
-        wgt = gt.window(c0, r0)
-        mask = geom.rasterize(g, c1 - c0, r1 - r0,
-                              lambda x, y: wgt.geo_to_pixel(x, y),
-                              all_touched=True)
-        if not mask.any():
-            return None
+        if getattr(ds, "geo_loc", None) and not is_vrt:
+            made = _geoloc_drill_mask(ds, g4326, H, W)
+            if made is None:
+                return None
+            mask, (c0, r0, c1, r1) = made
+        else:
+            # envelope intersect + ALL_TOUCHED mask burn
+            b = g.bbox()
+            c0, r0 = gt.geo_to_pixel(b.xmin, b.ymax)
+            c1, r1 = gt.geo_to_pixel(b.xmax, b.ymin)
+            c0, c1 = sorted((c0, c1))
+            r0, r1 = sorted((r0, r1))
+            c0 = max(int(math.floor(c0)), 0)
+            r0 = max(int(math.floor(r0)), 0)
+            c1 = min(int(math.ceil(c1)), W)
+            r1 = min(int(math.ceil(r1)), H)
+            if c0 >= c1 or r0 >= r1:
+                return None
+            wgt = gt.window(c0, r0)
+            mask = geom.rasterize(g, c1 - c0, r1 - r0,
+                                  lambda x, y: wgt.geo_to_pixel(x, y),
+                                  all_touched=True)
+            if not mask.any():
+                return None
 
         # strided band reads with interpolation (`drill.go:119-214`)
         stride = max(req.band_strides, 1)

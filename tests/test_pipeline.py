@@ -863,3 +863,149 @@ class TestDrillPolygonTiling:
             # the feature targets it is negligible
             for tc, wc in zip(tiled.counts[ns], whole.counts[ns]):
                 assert wc <= tc <= wc * 1.25, (tc, wc)
+
+
+class TestGeolocDrill:
+    """Polygon drill over a curvilinear swath: membership comes from a
+    containment test on the geolocation arrays, not an affine burn."""
+
+    def test_drill_matches_analytic(self, tmp_path, monkeypatch):
+        from gsky_tpu.geo import geometry as geom
+        from gsky_tpu.index import MASStore, MASClient
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io.netcdf import write_netcdf3
+
+        GH, GW, T = 90, 120, 4
+        ii, jj = np.mgrid[0:GH, 0:GW].astype(np.float64)
+        lon = 147.0 + 0.004 * jj + 0.0012 * ii
+        lat = -34.0 - 0.003 * ii
+        rng = np.random.default_rng(2)
+        data = rng.uniform(10, 20, (T, GH, GW)).astype(np.float32)
+        root = str(tmp_path / "gldrill")
+        os.makedirs(root)
+        p = os.path.join(root, "swath.nc")
+        t0 = dt.datetime(2020, 1, 1,
+                         tzinfo=dt.timezone.utc).timestamp()
+        times = t0 + np.arange(T) * 86400.0
+        write_netcdf3(p, {"bt": data, "lon": lon, "lat": lat},
+                      np.arange(GW, dtype=np.float64),
+                      np.arange(GH, dtype=np.float64), EPSG4326,
+                      times=times, nodata=-9999.0)
+        store = MASStore()
+        store.ingest(extract(p))
+        wkt = ("POLYGON((147.2 -34.2,147.45 -34.2,147.45 -34.05,"
+               "147.2 -34.05,147.2 -34.2))")
+        req = GeoDrillRequest(collection=root, bands=["bt"],
+                              geometry_wkt=wkt, start_time=t0,
+                              end_time=t0 + T * 86400.0, approx=False)
+        res = DrillPipeline(MASClient(store)).process(req)
+        assert len(res.dates) == T
+        g = geom.from_wkt(wkt)
+        inpoly = geom.contains_mask(g, lon, lat)
+        assert inpoly.sum() > 100
+        for k in range(T):
+            want = float(data[k][inpoly].mean())
+            assert abs(res.values["bt"][k] - want) < 1e-4, k
+            assert res.counts["bt"][k] == int(inpoly.sum())
+
+    def test_contains_mask_matches_pointwise(self):
+        from gsky_tpu.geo import geometry as geom
+
+        g = geom.from_wkt(
+            "POLYGON((0 0,4 0,4 4,0 4,0 0),(1 1,2 1,2 2,1 2,1 1))")
+        xs, ys = np.meshgrid(np.linspace(-1, 5, 40),
+                             np.linspace(-1, 5, 40))
+        got = geom.contains_mask(g, xs, ys)
+        want = np.array([[g.contains_point(x, y)
+                          for x, y in zip(rx, ry)]
+                         for rx, ry in zip(xs, ys)])
+        np.testing.assert_array_equal(got, want)
+
+    def test_point_drill_on_swath(self, tmp_path):
+        """A point drill over a curvilinear collection marks the nearest
+        sample instead of silently reporting no data."""
+        from gsky_tpu.index import MASStore, MASClient
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io.netcdf import write_netcdf3
+
+        GH, GW = 60, 80
+        ii, jj = np.mgrid[0:GH, 0:GW].astype(np.float64)
+        lon = 147.0 + 0.004 * jj + 0.0012 * ii
+        lat = -34.0 - 0.003 * ii
+        data = (ii * 100 + jj).astype(np.float32)
+        root = str(tmp_path / "glpt")
+        os.makedirs(root)
+        p = os.path.join(root, "swath_20200110.nc")
+        write_netcdf3(p, {"bt": data, "lon": lon, "lat": lat},
+                      np.arange(GW, dtype=np.float64),
+                      np.arange(GH, dtype=np.float64), EPSG4326,
+                      nodata=-9999.0)
+        store = MASStore()
+        store.ingest(extract(p))
+        # the point at grid (i=20, j=30)
+        px = 147.0 + 0.004 * 30 + 0.0012 * 20
+        py = -34.0 - 0.003 * 20
+        req = GeoDrillRequest(collection=root, bands=["bt"],
+                              geometry_wkt=f"POINT({px} {py})",
+                              approx=False)
+        res = DrillPipeline(MASClient(store)).process(req)
+        assert len(res.dates) == 1
+        assert res.values["bt"][0] == pytest.approx(20 * 100 + 30)
+        assert res.counts["bt"][0] == 1
+
+    def test_subsampled_geoloc_grid_steps(self, tmp_path):
+        """pixel/line steps > 1 (subsampled geolocation arrays) map grid
+        indices to raster blocks; stats cover the expanded pixels."""
+        from gsky_tpu.index import MASStore, MASClient
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io.netcdf import write_netcdf3
+
+        GH, GW = 40, 50                  # geoloc grid
+        H, W = GH * 2, GW * 2            # raster, step 2
+        ii, jj = np.mgrid[0:GH, 0:GW].astype(np.float64)
+        lon = 147.0 + 0.01 * jj
+        lat = -34.0 - 0.01 * ii
+        rng = np.random.default_rng(7)
+        data = rng.uniform(5, 9, (H, W)).astype(np.float32)
+        root = str(tmp_path / "glstep")
+        os.makedirs(root)
+        p = os.path.join(root, "swath_20200110.nc")
+        # NC4 via h5py: the geoloc arrays have their OWN (half-res)
+        # dims, which the NC3 writer's single (y, x) layout can't hold
+        h5py = pytest.importorskip("h5py")
+        with h5py.File(p, "w") as f:
+            d = f.create_dataset("bt", data=data)
+            d.attrs["_FillValue"] = np.float32(-9999.0)
+            f.create_dataset("lon2", data=lon)
+            f.create_dataset("lat2", data=lat)
+            f.create_dataset("x", data=np.arange(W, dtype=np.float64))
+            f.create_dataset("y", data=np.arange(H, dtype=np.float64))
+        store = MASStore()
+        rec = extract(p)
+        for ds in rec["geo_metadata"]:
+            if ds["namespace"] == "bt":
+                ds["geo_loc"] = {"x_var": "lon2", "y_var": "lat2",
+                                 "line_offset": 0.0, "pixel_offset": 0.0,
+                                 "line_step": 2.0, "pixel_step": 2.0,
+                                 "srs": "EPSG:4326"}
+                ds["proj_wkt"] = "EPSG:4326"
+                ds["polygon"] = (
+                    f"POLYGON (({lon.min()} {lat.min()},"
+                    f"{lon.max()} {lat.min()},{lon.max()} {lat.max()},"
+                    f"{lon.min()} {lat.max()},{lon.min()} {lat.min()}))")
+        store.ingest(rec)
+        # polygon covering geoloc samples i in [10, 20), j in [15, 25)
+        wkt = (f"POLYGON(({147.0 + 0.01 * 14.6} {-34.0 - 0.01 * 19.4},"
+               f"{147.0 + 0.01 * 24.4} {-34.0 - 0.01 * 19.4},"
+               f"{147.0 + 0.01 * 24.4} {-34.0 - 0.01 * 9.6},"
+               f"{147.0 + 0.01 * 14.6} {-34.0 - 0.01 * 9.6},"
+               f"{147.0 + 0.01 * 14.6} {-34.0 - 0.01 * 19.4}))")
+        req = GeoDrillRequest(collection=root, bands=["bt"],
+                              geometry_wkt=wkt, approx=False)
+        res = DrillPipeline(MASClient(store)).process(req)
+        assert len(res.dates) == 1
+        # samples i 10..19, j 15..24 -> raster block rows 20..39, cols 30..49
+        want = float(data[20:40, 30:50].mean())
+        # 10x10 geoloc samples, each expanding to a 2x2 raster block
+        assert res.counts["bt"][0] == 400
+        assert res.values["bt"][0] == pytest.approx(want, abs=1e-4)
