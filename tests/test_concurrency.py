@@ -4,6 +4,7 @@ notes the reference never had.  Hammers the shared mutable state
 from many threads and asserts results stay correct and deterministic."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -159,3 +160,96 @@ def test_batched_render_matches_unbatched(archive, monkeypatch):
     for i, o in enumerate(out):
         assert o is not None
         np.testing.assert_array_equal(o, plain[i % len(reqs)])
+
+
+def test_drill_stack_cache_single_load_under_contention(tmp_path):
+    """16 threads racing the same drill stack must trigger exactly one
+    load (the inflight latch), and all get the same device buffer."""
+    import threading
+
+    from gsky_tpu.geo.crs import EPSG4326
+    from gsky_tpu.io.netcdf import write_netcdf3
+    from gsky_tpu.pipeline.drill_cache import DrillStackCache
+
+    p = str(tmp_path / "c.nc")
+    rng = np.random.default_rng(0)
+    write_netcdf3(p, {"v": rng.uniform(0, 1, (4, 32, 32)).astype(
+        np.float32)}, 148.0 + np.arange(32) * 0.01,
+        -35.0 - np.arange(32) * 0.01, EPSG4326,
+        times=1.6e9 + np.arange(4) * 86400.0, nodata=-9.0)
+
+    cache = DrillStackCache()
+    loads = []
+    orig = cache._load
+
+    def counting(path, is_nc, var, band0, nodata):
+        loads.append(path)
+        time.sleep(0.05)       # widen the race window
+        return orig(path, is_nc, var, band0, nodata)
+
+    cache._load = counting
+    out = [None] * 16
+
+    def worker(i):
+        out[i] = cache.get(p, True, "v", 1, None)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(16)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(30)
+    assert len(loads) == 1
+    serials = {s.serial for s in out if s is not None}
+    assert len(serials) == 1 and all(s is not None for s in out)
+
+
+def test_sharded_store_concurrent_ingest_and_query(tmp_path):
+    """Concurrent ingest into distinct shards + root fan-out queries
+    must neither crash nor drop records."""
+    import threading
+
+    from gsky_tpu.geo.crs import parse_crs
+    from gsky_tpu.geo.transform import GeoTransform
+    from gsky_tpu.index import MASShardedStore
+    from gsky_tpu.index.crawler import extract
+    from gsky_tpu.io import write_geotiff
+
+    root = tmp_path / "data"
+    utm = parse_crs("EPSG:32755")
+    recs = []
+    for k in range(8):
+        d = root / f"coll{k}"
+        d.mkdir(parents=True)
+        gt = GeoTransform(590000.0 + k * 100, 30.0, 0.0, 6105000.0,
+                          0.0, -30.0)
+        fp = str(d / f"coll{k}_20200110.tif")
+        write_geotiff(fp, np.ones((32, 32), np.int16), gt, utm)
+        recs.append(extract(fp))
+    store = MASShardedStore(str(root))
+    errors = []
+
+    def ingest(rec):
+        try:
+            store.ingest(rec)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def query():
+        try:
+            for _ in range(5):
+                store.intersects(str(root), metadata="gdal")
+                store.timestamps(str(root))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=ingest, args=(r,))
+               for r in recs] + \
+              [threading.Thread(target=query) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(30)
+    assert not errors, errors[:2]
+    final = store.intersects(str(root), metadata="gdal")
+    assert len(final["gdal"]) == 8
