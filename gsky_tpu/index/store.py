@@ -99,8 +99,13 @@ INSERT OR IGNORE INTO gsky_meta(k, v) VALUES ('generation', 0);
 class MASStore:
     """The index.  Thread-safe for concurrent reads."""
 
+    _QUERY_CACHE_MAX = 1024
+
     def __init__(self, db_path: str = ":memory:"):
         self._db_path = db_path
+        from collections import OrderedDict
+        self._query_cache: "OrderedDict" = OrderedDict()
+        self._cache_lock = threading.Lock()
         self._local = threading.local()
         self._memory_conn: Optional[sqlite3.Connection] = None
         # a single :memory: connection is shared across threads, so every
@@ -234,7 +239,28 @@ class MASStore:
                    namespaces: Optional[Sequence[str]] = None,
                    metadata: str = "", limit: int = 0) -> Dict:
         """`mas_intersects` (`mas/api/mas.sql:363-547`).  Returns
-        {"files": [...]} or {"gdal": [...]} when metadata == "gdal"."""
+        {"files": [...]} or {"gdal": [...]} when metadata == "gdal".
+
+        Results cache per (args, generation) — the in-process stand-in
+        for the reference's memcached tier in front of MAS
+        (`mas/api/api.go:43-52`): a tile server asks the same question
+        for every zoom-level repeat, and the polygon refinement below is
+        ~3 ms a call.  Any ingest bumps the generation (even from
+        another process against the same file DB), so cached answers
+        die with the data they were computed from."""
+        ckey = (gpath, srs, wkt, nseg, time, until,
+                tuple(namespaces) if namespaces else None, metadata,
+                limit, self.generation)
+        with self._cache_lock:
+            hit = self._query_cache.get(ckey)
+            if hit is not None:
+                self._query_cache.move_to_end(ckey)
+        if hit is not None:
+            # deep copy on hit: callers mutate responses (sorting file
+            # lists, annotating gdal records) and must never poison the
+            # cached answer for later requests
+            import copy
+            return copy.deepcopy(hit)
         q_geom = None
         if wkt:
             g = geom.from_wkt(wkt)
@@ -297,7 +323,8 @@ class MASStore:
                 break
 
         if metadata != "gdal":
-            return {"files": sorted({r["path"] for r in out_rows})}
+            return self._cache_put(
+                ckey, {"files": sorted({r["path"] for r in out_rows})})
         gdal = []
         for r in out_rows:
             gdal.append({
@@ -317,7 +344,20 @@ class MASStore:
                 "axes": json.loads(r["axes"]) if r["axes"] else None,
                 "geo_loc": json.loads(r["geo_loc"]) if r["geo_loc"] else None,
             })
-        return {"gdal": gdal}
+        return self._cache_put(ckey, {"gdal": gdal})
+
+    def _cache_put(self, ckey, value: Dict) -> Dict:
+        # NOTE: this, api.ResponseCache and executor's geo cache are
+        # three small LRUs with different value lifetimes (raw query
+        # dicts / HTTP byte bodies / numpy+device arrays); kept separate
+        # deliberately — a shared helper would couple their eviction
+        # policies for ~10 lines of savings each
+        import copy
+        with self._cache_lock:
+            self._query_cache[ckey] = copy.deepcopy(value)
+            while len(self._query_cache) > self._QUERY_CACHE_MAX:
+                self._query_cache.popitem(last=False)
+        return value
 
     def timestamps(self, gpath: str, time: str = "", until: str = "",
                    namespaces: Optional[Sequence[str]] = None,

@@ -326,8 +326,8 @@ class WarpExecutor:
             return None
         n_pad = _bucket_pow2(n_ns)
         if len(groups) == 1:
-            stack, ctrl, params, step, _ = groups[0]
-            return warp_scenes_ctrl(stack, jnp.asarray(ctrl),
+            stack, _, params, step, _, ctrl_dev = groups[0]
+            return warp_scenes_ctrl(stack, ctrl_dev,
                                     jnp.asarray(params), method,
                                     n_pad, (height, width), step)
         # multi-CRS granule set (e.g. scenes across UTM zones): one
@@ -335,9 +335,9 @@ class WarpExecutor:
         # priority combine — newest-wins survives the grouping because
         # each partial carries its winners' priorities
         parts = [warp_scenes_ctrl_scored(
-                    stack, jnp.asarray(ctrl), jnp.asarray(params),
+                    stack, ctrl_dev, jnp.asarray(params),
                     method, n_pad, (height, width), step)
-                 for stack, ctrl, params, step, _ in groups]
+                 for stack, _, params, step, _, ctrl_dev in groups]
         canvs = jnp.stack([p[0] for p in parts])
         bests = jnp.stack([p[1] for p in parts])
         return combine_scored(canvs, bests)
@@ -357,7 +357,7 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, ctrl, params, step, skey = made
+        stack, ctrl, params, step, skey, ctrl_dev = made
         sp = np.array([offset, scale, clip], np.float32)
         statics = (method, _bucket_pow2(n_ns), (height, width), step,
                    auto, colour_scale)
@@ -368,7 +368,7 @@ class WarpExecutor:
             key = skey + statics
             return self._batcher.render(key, stack, ctrl, params, sp,
                                         statics)
-        out = render_scenes_ctrl(stack, jnp.asarray(ctrl),
+        out = render_scenes_ctrl(stack, ctrl_dev,
                                  jnp.asarray(params), jnp.asarray(sp),
                                  *statics)
         return _prefetch(out)
@@ -389,11 +389,11 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, ctrl, params, step, _ = made
+        stack, _, params, step, _, ctrl_dev = made
         sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
         sel = jnp.asarray(np.asarray(out_sel, np.int32))
         return _prefetch(render_scenes_bands_ctrl(
-            stack, jnp.asarray(ctrl), jnp.asarray(params), sp, sel,
+            stack, ctrl_dev, jnp.asarray(params), sp, sel,
             method, _bucket_pow2(n_ns), (height, width), step, auto,
             colour_scale))
 
@@ -503,11 +503,27 @@ class WarpExecutor:
                 if made is None:
                     return None
                 ctrl, step = made
+                gl0 = granules[idxs[0]]
+                dkey = ("ctrldev", "gl", gl0.path,
+                        gl0.geo_loc.get("x_var"), gl0.geo_loc.get("y_var"),
+                        dst_gt.to_gdal(), dst_crs, height, width)
             else:
                 sx, sy, step = self._ctrl_geo_coords(
                     dst_gt, dst_crs, height, width, s0.crs, 16)
                 ox, oy = s0.gt.x0, s0.gt.y0
                 ctrl = np.stack([sx - ox, sy - oy]).astype(np.float32)
+                dkey = ("ctrldev", dst_gt.to_gdal(), dst_crs, height,
+                        width, s0.crs, ox, oy)
+            # the ~2 KB ctrl grid re-uploads on every render otherwise;
+            # tile servers see heavy repeats, so keep the DEVICE copy in
+            # the same LRU as the host grids.  The HOST array stays the
+            # group's ctrl: the batcher np.stacks ctrl grids, and a
+            # device array there would force a sync + download per
+            # queued tile — consumers pick the device copy up by dkey
+            ctrl_dev = self._geo_cache_get(dkey)
+            if ctrl_dev is None:
+                ctrl_dev = jnp.asarray(ctrl)
+                self._geo_cache_put(dkey, ctrl_dev)
 
             B = _bucket_pow2(len(gs))
             params = np.zeros((B, 11), np.float64)
@@ -546,7 +562,7 @@ class WarpExecutor:
                     while len(self._stack_cache) > self._STACK_CACHE_MAX:
                         self._stack_cache.popitem(last=False)
             groups.append((stack, ctrl, params.astype(np.float32), step,
-                           skey))
+                           skey, ctrl_dev))
         return groups
 
 
