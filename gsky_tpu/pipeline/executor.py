@@ -183,6 +183,11 @@ class WarpExecutor:
         few medians."""
         from ..geo.crs import parse_crs
         try:
+            key = ("stride", dst_gt.to_gdal(), dst_crs, height, width,
+                   g.srs, tuple(g.geo_transform or ()))
+            hit = self._geo_cache_get(key)
+            if hit is not None:
+                return hit
             src_crs = parse_crs(g.srs) if g.srs else None
             if src_crs is None:
                 return 1.0
@@ -194,7 +199,10 @@ class WarpExecutor:
                 dr = np.nanmedian(np.abs(np.diff(row, axis=0))) / step
                 dc = np.nanmedian(np.abs(np.diff(col, axis=1))) / step
             stride = min(float(dr), float(dc))
-            return stride if np.isfinite(stride) and stride > 1.0 else 1.0
+            stride = stride if np.isfinite(stride) and stride > 1.0 \
+                else 1.0
+            self._geo_cache_put(key, stride)
+            return stride
         except Exception:
             return 1.0
 
