@@ -20,8 +20,10 @@ if [ "$MODE" = "accept" ]; then
         sleep 1
     done
     cd /gsky
-    python tools/accept.py -H 127.0.0.1:8080 -s selftest
-    STATUS=$?
+    STATUS=0
+    # || capture: under set -e a bare failing command would abort the
+    # script before the cleanup below
+    python tools/accept.py -H 127.0.0.1:8080 -s selftest || STATUS=$?
     kill "$DEMO_PID" 2>/dev/null || true
     exit "$STATUS"
 fi

@@ -170,12 +170,15 @@ class Geometry:
         polys = []
         for rings in self.polys:
             ext = clip_ring(rings[0]) if rings else np.zeros((0, 2))
-            if not len(ext):
+            # drop degenerate output (same >=4-point rule as
+            # split_dateline): S-H clipping of concave subjects can emit
+            # sliver rings that an ALL_TOUCHED burn would wrongly count
+            if len(ext) < 4:
                 continue
             keep = [ext]
             for hole in rings[1:]:
                 h = clip_ring(hole)
-                if len(h):
+                if len(h) >= 4:
                     keep.append(h)
             polys.append(keep)
         kind = "MultiPolygon" if len(polys) > 1 else "Polygon"

@@ -256,11 +256,14 @@ class MASStore:
             if hit is not None:
                 self._query_cache.move_to_end(ckey)
         if hit is not None:
-            # deep copy on hit: callers mutate responses (sorting file
-            # lists, annotating gdal records) and must never poison the
-            # cached answer for later requests
-            import copy
-            return copy.deepcopy(hit)
+            # shallow-per-record copy on hit: callers sort the files
+            # list and annotate top-level record dicts, so those copy;
+            # inner lists (timestamps, axes) are treated read-only by
+            # every consumer — a deepcopy here would cost as much as
+            # the query it saves for deep time-series responses
+            if "gdal" in hit:
+                return {"gdal": [dict(r) for r in hit["gdal"]]}
+            return {"files": list(hit["files"])}
         q_geom = None
         if wkt:
             g = geom.from_wkt(wkt)
@@ -352,9 +355,12 @@ class MASStore:
         # dicts / HTTP byte bodies / numpy+device arrays); kept separate
         # deliberately — a shared helper would couple their eviction
         # policies for ~10 lines of savings each
-        import copy
+        if "gdal" in value:
+            kept = {"gdal": [dict(r) for r in value["gdal"]]}
+        else:
+            kept = {"files": list(value["files"])}
         with self._cache_lock:
-            self._query_cache[ckey] = copy.deepcopy(value)
+            self._query_cache[ckey] = kept
             while len(self._query_cache) > self._QUERY_CACHE_MAX:
                 self._query_cache.popitem(last=False)
         return value

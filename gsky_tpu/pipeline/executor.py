@@ -67,10 +67,15 @@ class WarpExecutor:
     # a recompute/re-upload storm exactly when traffic is heaviest)
     _GEO_CACHE_MAX = 256
     _STACK_CACHE_MAX = 32
+    # per-granule scalar strides get their own (much larger) map: one
+    # tiny entry per granule geotransform must not flush the multi-MB
+    # projection grids out of the 256-slot LRU above
+    _STRIDE_CACHE_MAX = 8192
 
     def __init__(self):
         self._geo_cache: OrderedDict = OrderedDict()
         self._stack_cache: OrderedDict = OrderedDict()
+        self._stride_cache: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
         from .batcher import RenderBatcher
         self._batcher = RenderBatcher()
@@ -183,11 +188,13 @@ class WarpExecutor:
         few medians."""
         from ..geo.crs import parse_crs
         try:
-            key = ("stride", dst_gt.to_gdal(), dst_crs, height, width,
+            key = (dst_gt.to_gdal(), dst_crs, height, width,
                    g.srs, tuple(g.geo_transform or ()))
-            hit = self._geo_cache_get(key)
-            if hit is not None:
-                return hit
+            with self._lock:
+                hit = self._stride_cache.get(key)
+                if hit is not None:
+                    self._stride_cache.move_to_end(key)
+                    return hit
             src_crs = parse_crs(g.srs) if g.srs else None
             if src_crs is None:
                 return 1.0
@@ -201,7 +208,10 @@ class WarpExecutor:
             stride = min(float(dr), float(dc))
             stride = stride if np.isfinite(stride) and stride > 1.0 \
                 else 1.0
-            self._geo_cache_put(key, stride)
+            with self._lock:
+                self._stride_cache[key] = stride
+                while len(self._stride_cache) > self._STRIDE_CACHE_MAX:
+                    self._stride_cache.popitem(last=False)
             return stride
         except Exception:
             return 1.0

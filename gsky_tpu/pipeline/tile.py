@@ -27,6 +27,8 @@ from .types import GeoTileRequest, Granule, TileResult
 
 log = logging.getLogger("gsky.tile")
 
+_index_pool = None   # module-level fan-out pool (see _index_fanout)
+
 
 class TilePipeline:
     def __init__(self, mas: MASClient, executor: Optional[WarpExecutor] = None,
@@ -39,14 +41,18 @@ class TilePipeline:
         self.executor = executor or default_executor
         self.decode_workers = decode_workers
         self.remote = remote
-        self._index_pool = None   # lazy; shared across requests
 
-    def _index_fanout(self):
-        import concurrent.futures as cf
-        if self._index_pool is None:
-            self._index_pool = cf.ThreadPoolExecutor(
+    @staticmethod
+    def _index_fanout():
+        # one MODULE-level pool: the OWS server rebuilds pipelines on
+        # config reload, and a per-pipeline pool would strand 8
+        # non-daemon threads per discarded instance
+        global _index_pool
+        if _index_pool is None:
+            import concurrent.futures as cf
+            _index_pool = cf.ThreadPoolExecutor(
                 max_workers=8, thread_name_prefix="gsky-index")
-        return self._index_pool
+        return _index_pool
 
     # -- indexing ------------------------------------------------------------
 

@@ -222,7 +222,11 @@ class WorkerClient:
                 return None
 
         parts = list(self._fanout.map(one, jobs))
-        if len(jobs) == len(granules):        # one RPC per granule
+        # an explicit flag, NOT a job-count comparison: footprint
+        # pruning can leave exactly one sub-tile per granule, and those
+        # sub-rasters must still assemble into full-tile canvases
+        sharded = mx < req.width or my < req.height
+        if not sharded:                       # one whole-tile RPC each
             out: List[Optional[Tuple[np.ndarray, np.ndarray]]] = parts
         else:
             out = [None] * len(granules)

@@ -610,3 +610,55 @@ def test_grpc_geoloc_granule_warps(grpc_worker, tmp_path):
     frac = np.mean(l[np.asarray(local.valid["bt"])] !=
                    r[np.asarray(local.valid["bt"])])
     assert frac < 0.02, f"{frac:.1%} differ"
+
+
+def test_sub_tiled_assembly_when_one_job_per_granule():
+    """Footprint pruning can leave exactly one sub-tile RPC per granule;
+    the results must still assemble into FULL-tile canvases at the right
+    offsets (a job-count == granule-count coincidence previously
+    returned raw sub-rasters)."""
+    from gsky_tpu.worker.client import WorkerClient
+
+    c = WorkerClient.__new__(WorkerClient)
+    c._max_msg = 64 << 20
+
+    calls = []
+
+    def fake_warp(granule, dst_gt, crs, width, height, resample):
+        calls.append((dst_gt.x0, dst_gt.y0, width, height))
+        d = np.full((height, width), float(granule.band), np.float32)
+        return d, np.ones((height, width), bool)
+
+    c.warp = fake_warp
+
+    class _Map:
+        @staticmethod
+        def map(fn, it):
+            return [fn(x) for x in it]
+
+    c._fanout = _Map()
+    gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+    # two granules, each pruned to ONE sub-tile of the 2x2 grid
+    def gran(band, poly):
+        return Granule(path="p", ds_name="d", namespace="n",
+                       base_namespace="n", band=band, time_index=None,
+                       timestamp=0.0, srs="EPSG:4326",
+                       geo_transform=list(gt.to_gdal()), nodata=None,
+                       polygon=poly)
+
+    g1 = gran(1, "POLYGON((10 -10,20 -10,20 -20,10 -20,10 -10))")
+    g2 = gran(2, "POLYGON((40 -40,50 -40,50 -50,40 -50,40 -40))")
+    req = GeoTileRequest(collection="c", bands=["n"],
+                         bbox=gt.bbox(64, 64), crs=EPSG4326,
+                         width=64, height=64, grpc_tile_x_size=0.5,
+                         grpc_tile_y_size=0.5)
+    out = c.warp_many([g1, g2], req, "near")
+    assert len(calls) == 2          # pruning left one sub-tile each
+    for k, g in enumerate((g1, g2)):
+        d, v = out[k]
+        assert d.shape == (64, 64) and v.shape == (64, 64)
+        assert v.sum() == 32 * 32   # one quadrant filled
+        assert d[v].min() == d[v].max() == float(g.band)
+    # granule 1's quadrant is the top-left, granule 2's bottom-right
+    assert out[0][1][:32, :32].all()
+    assert out[1][1][32:, 32:].all()
