@@ -187,6 +187,13 @@ class GeoTIFF:
             self._fp = path_or_fp
             self.path = getattr(path_or_fp, "name", "<memory>")
         self._fp_lock = threading.Lock()
+        try:
+            cur = self._fp.tell()
+            self._fp.seek(0, 2)
+            self._file_size = self._fp.tell()
+            self._fp.seek(cur)
+        except OSError:
+            self._file_size = 1 << 40
         self._parse_header()
         self._parse_geo()
 
@@ -266,6 +273,11 @@ class GeoTIFF:
                 continue
             fmt, size = _FIELD[typ]
             total = size * cnt
+            if total > self._file_size:
+                # corrupt count: reading it would pre-allocate the
+                # declared bytes in C (uninterruptible for huge values)
+                raise ValueError(
+                    f"corrupt TIFF: tag {tag} declares {total} bytes")
             payload = ent[4 + struct.calcsize(count_fmt):]
             if total <= inline:
                 data = payload[:total]
@@ -381,6 +393,10 @@ class GeoTIFF:
         c0, r0, w, h = window
         if c0 < 0 or r0 < 0 or c0 + w > W or r0 + h > H:
             raise ValueError(f"window {window} outside raster {W}x{H}")
+        if w * h > (1 << 31):
+            # corrupt headers can declare absurd dims; allocating the
+            # output first would stall uninterruptibly
+            raise ValueError(f"window {w}x{h} implausibly large")
         samples = int(ifd.val(T_SAMPLES, 1))
         planar = int(ifd.val(T_PLANAR, 1))
         bits = ifd.arr(T_BITS) or (8,)
@@ -437,10 +453,20 @@ class GeoTIFF:
 
     def _decode_block(self, offset: int, nbytes: int, comp: int, pred: int,
                       rows: int, cols: int, samples: int, dt: np.dtype) -> np.ndarray:
+        expected = rows * cols * samples * dt.itemsize
+        # bound every size a corrupt header controls: fp.read and the
+        # decompress output buffer both PRE-ALLOCATE their full size
+        if offset < 0 or nbytes < 0 \
+                or offset + nbytes > self._file_size:
+            raise ValueError(
+                f"corrupt TIFF: block [{offset}, {offset + nbytes}) "
+                f"beyond file size {self._file_size}")
+        if expected > (1 << 31):
+            raise ValueError(
+                f"corrupt TIFF: block declares {expected} bytes")
         with self._fp_lock:  # shared handles are read from worker threads
             self._fp.seek(offset)
             raw = self._fp.read(nbytes)
-        expected = rows * cols * samples * dt.itemsize
         data = _decompress(raw, comp, expected)
         if len(data) < expected:
             data = data + b"\0" * (expected - len(data))

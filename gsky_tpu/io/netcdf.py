@@ -370,6 +370,7 @@ class _NC3File:
         self.path = path
         self._fp = open(path, "rb")
         self._fp_lock = threading.Lock()
+        self._size = os.fstat(self._fp.fileno()).st_size
         b = self._fp.read(4)
         if b[:3] != b"CDF" or b[3] not in (1, 2):
             raise ValueError("not a NetCDF classic file")
@@ -383,6 +384,13 @@ class _NC3File:
         self._parse_vars()
 
     def read_at(self, pos: int, n: int) -> bytes:
+        # bound by the actual file: a corrupt header can declare
+        # petabyte dims, and fp.read(n) PRE-ALLOCATES n bytes in C —
+        # an uninterruptible multi-GB stall before any short read
+        if pos < 0 or n < 0 or pos + n > self._size:
+            raise ValueError(
+                f"corrupt NetCDF: read [{pos}, {pos + n}) beyond "
+                f"file size {self._size}")
         with self._fp_lock:  # shared handles are read from worker threads
             self._fp.seek(pos)
             return self._fp.read(n)

@@ -630,3 +630,65 @@ class TestOverviews:
         assert cache.get(g, stride=1.0) is None      # 512^2 too big
         ovr = cache.get(g, stride=4.0)               # 128^2 fits
         assert ovr is not None and ovr.width == 128
+
+
+class TestCorruptFileRobustness:
+    """Corrupt headers must produce error records, never crashes or
+    uninterruptible giant allocations (fp.read/decompress/np.zeros all
+    pre-allocate whatever a corrupt header declares — a fuzz run
+    found multi-GB stalls before the size bounds existed)."""
+
+    def test_corrupted_files_always_return_records(self, tmp_path):
+        import random
+        import time as _time
+
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io.netcdf import write_netcdf3
+
+        gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+        t_path = str(tmp_path / "a_20200110.tif")
+        write_geotiff(t_path, np.ones((64, 64), np.int16), gt,
+                      parse_crs("EPSG:32755"))
+        n_path = str(tmp_path / "b_20200110.nc")
+        write_netcdf3(n_path, {"v": np.ones((32, 32), np.float32)},
+                      np.arange(32.0), np.arange(32.0), EPSG4326)
+        rng = random.Random(3)
+        for src in (t_path, n_path):
+            raw = open(src, "rb").read()
+            for trial in range(60):
+                data = bytearray(raw)
+                mode = trial % 3
+                if mode == 0:
+                    data = data[:rng.randrange(1, len(raw))]
+                elif mode == 1:
+                    for _ in range(rng.randrange(1, 8)):
+                        i = rng.randrange(len(data))
+                        data[i] ^= 1 << rng.randrange(8)
+                else:
+                    i = rng.randrange(len(data))
+                    data[i:i + 16] = bytes(rng.randrange(256)
+                                           for _ in range(16))
+                p = str(tmp_path / f"f{trial}{src[-4:]}")
+                open(p, "wb").write(bytes(data))
+                t0 = _time.time()
+                rec = extract(p)
+                assert isinstance(rec, dict)
+                assert _time.time() - t0 < 10.0
+
+    def test_declared_oversize_bounds(self, tmp_path):
+        from gsky_tpu.io.netcdf import NetCDF, write_netcdf3
+
+        # a tag/dim declaring bytes beyond the file must raise cleanly
+        p = str(tmp_path / "t.tif")
+        gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+        write_geotiff(p, np.ones((16, 16), np.int16), gt,
+                      parse_crs("EPSG:32755"))
+        with GeoTIFF(p) as g:
+            # block read beyond the file: must raise, not pre-allocate
+            with pytest.raises(ValueError, match="beyond file size"):
+                g._decode_block(0, 1 << 40, 1, 1, 16, 16, 1,
+                                np.dtype("<i2"))
+            # block whose decode buffer would be multi-GB: same
+            with pytest.raises(ValueError, match="declares"):
+                g._decode_block(0, 16, 1, 1, 1 << 20, 1 << 12, 1,
+                                np.dtype("<i2"))
