@@ -216,6 +216,17 @@ class Geometry:
             west = [_clip_ring_halfplane(r, 0, 180.0, keep_le=False) for r in shifted]
             east = [r for r in east if len(r) >= 4]
             west = [r for r in west if len(r) >= 4]
+            # wide-but-not-crossing footprints (e.g. a rule-driven
+            # whole-world bbox with vertices AT ±180) collapse under the
+            # shift: -180 and +180 land on the same meridian, the
+            # shifted exterior has ~zero area, and the clip yields
+            # slivers.  A genuinely crossing footprint unwraps to a
+            # small-but-real area instead — so a degenerate SHIFTED
+            # exterior means "wasn't crossing": keep the polygon whole.
+            shifted_area = abs(_shoelace(shifted[0]))
+            if shifted_area <= 1e-9 * max(abs(_shoelace(ext)), 1e-30):
+                out_polys.append(poly)
+                continue
             if east:
                 out_polys.append(east)
             if west:

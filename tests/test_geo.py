@@ -346,3 +346,28 @@ class TestReviewRegressions:
         g = G.Geometry("Polygon", polys=[[ring]])
         mask = G.rasterize(g, 1000, 1000, lambda x, y: (x, y), all_touched=False)
         assert mask.sum() == pytest.approx(np.pi * 400 * 400, rel=0.005)
+
+
+class TestDatelineSplitDegenerate:
+    def test_world_polygon_survives_split(self):
+        """A whole-world footprint (rule-driven bbox with vertices AT
+        ±180) used to collapse to a zero-width sliver under the
+        shift+clip — indexed products then matched nothing."""
+        from gsky_tpu.geo import geometry as geom
+
+        g = geom.from_wkt("POLYGON ((-180 -90,180 -90,180 90,"
+                          "-180 90,-180 -90))")
+        s = g.split_dateline()
+        assert abs(s.area() - 360 * 180) < 1e-6
+        assert s.contains_point(147.2, -34.1)
+
+    def test_true_crossing_still_splits(self):
+        from gsky_tpu.geo import geometry as geom
+
+        g = geom.from_wkt("POLYGON ((179 -10,-179 -10,-179 10,"
+                          "179 10,179 -10))")
+        s = g.split_dateline()
+        assert len(s.polys) == 2
+        assert s.contains_point(179.5, 0.0)
+        assert s.contains_point(-179.5, 0.0)
+        assert not s.contains_point(0.0, 0.0)

@@ -1009,3 +1009,48 @@ class TestGeolocDrill:
         # 10x10 geoloc samples, each expanding to a 2x2 raster block
         assert res.counts["bt"][0] == 400
         assert res.values["bt"][0] == pytest.approx(want, abs=1e-4)
+
+    def test_ruleset_geoloc_drives_render(self, tmp_path):
+        """eReefs-style products: the 2-D coord vars are named lon_v/
+        lat_v, which auto-detection does NOT recognise — only the
+        built-in 'ereef' RULESET wires them up, and the render must
+        work off that record end to end."""
+        from gsky_tpu.index import MASStore, MASClient
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io.netcdf import write_netcdf3
+        from gsky_tpu.pipeline import TilePipeline, GeoTileRequest
+
+        GH, GW = 80, 100
+        ii, jj = np.mgrid[0:GH, 0:GW].astype(np.float64)
+        lon = 147.0 + 0.004 * jj + 0.001 * ii
+        lat = -34.0 - 0.003 * ii
+        data = (ii + jj).astype(np.float32)
+        root = str(tmp_path / "ereef")
+        os.makedirs(root)
+        p = os.path.join(root, "ocean_roms_his_20200110.nc")
+        write_netcdf3(p, {"temp": data, "lon_v": lon, "lat_v": lat},
+                      np.arange(GW, dtype=np.float64),
+                      np.arange(GH, dtype=np.float64), EPSG4326,
+                      nodata=-9999.0)
+        rec = extract(p)           # built-in rules applied
+        md = [d for d in rec["geo_metadata"] if d["namespace"] == "temp"]
+        assert md and md[0].get("geo_loc"), "ereef rule did not fire"
+        assert md[0]["geo_loc"]["x_var"] == "lon_v"
+        store = MASStore()
+        store.ingest(rec)
+        req = GeoTileRequest(
+            collection=root, bands=["temp"],
+            bbox=BBox(147.1, -34.2, 147.35, -34.05), crs=EPSG4326,
+            width=64, height=64, resample="near")
+        res = TilePipeline(MASClient(store)).process(req)
+        v = np.asarray(res.valid["temp"])
+        assert v.sum() > 500
+        d = np.asarray(res.data["temp"])
+        # spot-check one pixel against the analytic inverse
+        gt = req.dst_gt()
+        x, y = gt.pixel_to_geo(32.5, 32.5)
+        ei = (-34.0 - y) / 0.003
+        ej = (x - 147.0 - 0.001 * ei) / 0.004
+        if v[32, 32]:
+            assert d[32, 32] == pytest.approx(
+                float(np.rint(ei) + np.rint(ej)), abs=1.0)
