@@ -1054,3 +1054,53 @@ class TestGeolocDrill:
         if v[32, 32]:
             assert d[32, 32] == pytest.approx(
                 float(np.rint(ei) + np.rint(ej)), abs=1.0)
+
+
+class TestCoarseZoomInteraction:
+    """P2(b) index subdivision and overview-level reads fire on the
+    same coarse requests; together they must still render correctly."""
+
+    def test_subdivided_index_with_overview_reads(self, tmp_path):
+        import datetime as dtm
+
+        from gsky_tpu.index import MASStore, MASClient
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io import write_geotiff
+        from gsky_tpu.pipeline import TilePipeline, GeoTileRequest
+        from gsky_tpu.pipeline.scene_cache import SceneCache
+
+        utm = parse_crs("EPSG:32755")
+        SZ = 1024
+        gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+        yy, xx = np.mgrid[0:SZ, 0:SZ]
+        data = (200 + (xx + yy)).astype(np.int16)
+        root = str(tmp_path / "coarse")
+        os.makedirs(root)
+        p = os.path.join(root, "LC08_20200110_T1.tif")
+        write_geotiff(p, data, gt, utm, nodata=-999, overviews=(2, 4))
+        store = MASStore()
+        store.ingest(extract(p))
+        ll = transform_bbox(gt.bbox(SZ, SZ), utm, EPSG4326)
+        merc = transform_bbox(ll, EPSG4326, EPSG3857)
+        t0 = dtm.datetime(2020, 1, 9,
+                          tzinfo=dtm.timezone.utc).timestamp()
+        base = dict(collection=root, bands=["LC08_20200110_T1"],
+                    bbox=merc, crs=EPSG3857, width=128, height=128,
+                    start_time=t0, end_time=t0 + 3 * 86400,
+                    resample="near")
+        pipe = TilePipeline(MASClient(store))
+        plain = pipe.process(GeoTileRequest(**base))
+        # coarse + subdivision + tiny res limit: 4 index tiles fire AND
+        # the 1024-px scene renders onto 128 px -> overview level 4
+        cache = SceneCache()
+        pipe2 = TilePipeline(MASClient(store))
+        sub = pipe2.process(GeoTileRequest(
+            **base, spatial_extent=(ll.xmin, ll.ymin, ll.xmax, ll.ymax),
+            index_tile_x_size=0.5, index_tile_y_size=0.5,
+            index_res_limit=1e-9))
+        ns = "LC08_20200110_T1"
+        pv, sv = np.asarray(plain.valid[ns]), np.asarray(sub.valid[ns])
+        np.testing.assert_array_equal(pv, sv)
+        pd, sd = np.asarray(plain.data[ns]), np.asarray(sub.data[ns])
+        np.testing.assert_array_equal(pd, sd)
+        assert sv.sum() > 5000
