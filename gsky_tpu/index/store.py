@@ -106,6 +106,8 @@ class MASStore:
         from collections import OrderedDict
         self._query_cache: "OrderedDict" = OrderedDict()
         self._cache_lock = threading.Lock()
+        self.query_hits = 0
+        self.query_misses = 0
         self._local = threading.local()
         self._memory_conn: Optional[sqlite3.Connection] = None
         # a single :memory: connection is shared across threads, so every
@@ -254,7 +256,10 @@ class MASStore:
         with self._cache_lock:
             hit = self._query_cache.get(ckey)
             if hit is not None:
+                self.query_hits += 1
                 self._query_cache.move_to_end(ckey)
+            else:
+                self.query_misses += 1
         if hit is not None:
             # shallow-per-record copy on hit: callers sort the files
             # list and annotate top-level record dicts, so those copy;

@@ -60,6 +60,8 @@ class DrillStackCache:
         self._neg: Dict[tuple, None] = {}
         self._max_neg = max_negative
         self._inflight: Dict[tuple, threading.Event] = {}
+        self.hits = 0
+        self.misses = 0
 
     def get(self, path: str, is_nc: bool, var_name: str, band0: int,
             nodata: Optional[float]) -> Optional[DeviceStack]:
@@ -83,6 +85,7 @@ class DrillStackCache:
             with self._lock:
                 hit = self._stacks.get(key)
                 if hit is not None:
+                    self.hits += 1
                     self._order.remove(key)
                     self._order.append(key)
                     return hit
@@ -96,6 +99,7 @@ class DrillStackCache:
 
         stack = None
         permanent_no = False
+        self.misses += 1
         try:
             stack, permanent_no = self._load(path, is_nc, var_name,
                                              band0, nodata)

@@ -67,6 +67,8 @@ class SceneCache:
         self._max_bytes = max_bytes
         self._max_scene_px = max_scene_px
         self._inflight: Dict[tuple, threading.Event] = {}
+        self.hits = 0
+        self.misses = 0
 
     def _key(self, g: Granule) -> tuple:
         return (g.path, g.band, g.var_name, g.time_index)
@@ -115,6 +117,7 @@ class SceneCache:
             with self._lock:
                 hit = self._scenes.get(key)
                 if hit is not None:
+                    self.hits += 1
                     self._order.remove(key)
                     self._order.append(key)
                     return hit
@@ -125,6 +128,7 @@ class SceneCache:
             ev.wait()
 
         scene = None
+        self.misses += 1
         try:
             scene = self._load(g, level)
             if scene is not None:

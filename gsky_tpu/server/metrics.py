@@ -29,6 +29,27 @@ RESERVED_QUERY_PARAMS = {
 }
 
 
+def _cache_stats() -> Dict:
+    """Cumulative hit/miss counters of the process-wide caches — the
+    observability the reference gets from memcached stats in front of
+    MAS (`mas/api/api.go:43-52`), extended to the device-resident
+    tiers.  Lazy + guarded: metrics must never fail a request."""
+    out: Dict = {}
+    try:
+        from ..pipeline.scene_cache import default_scene_cache
+        out["scene"] = {"hits": default_scene_cache.hits,
+                        "misses": default_scene_cache.misses}
+    except Exception:
+        pass
+    try:
+        from ..pipeline.drill_cache import default_drill_cache
+        out["drill_stack"] = {"hits": default_drill_cache.hits,
+                              "misses": default_drill_cache.misses}
+    except Exception:
+        pass
+    return out
+
+
 class MetricsCollector:
     def __init__(self, logger: "MetricsLogger"):
         self._logger = logger
@@ -77,6 +98,7 @@ class MetricsCollector:
     def log(self, status: int = 200):
         self.info["http_status"] = status
         self.info["req_duration"] = int((time.time() - self._t0) * 1e9)
+        self.info["cache"] = _cache_stats()
         self._logger.write(self.info)
 
 

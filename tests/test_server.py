@@ -582,3 +582,21 @@ class TestWCSStreaming:
             assert b.nodata == -9999.0
             for bi in range(1, a.count + 1):
                 np.testing.assert_array_equal(a.read(bi), b.read(bi))
+
+
+class TestCacheMetrics:
+    def test_cache_block_in_metrics(self, tmp_path):
+        from gsky_tpu.server.metrics import MetricsLogger
+
+        logger = MetricsLogger(log_dir=str(tmp_path))
+        c = logger.collector()
+        c.log(200)
+        logger._fp.flush()
+        import glob, json as _json
+        files = glob.glob(str(tmp_path / "*.log"))
+        assert files
+        with open(files[0]) as fp:
+            rec = _json.loads(fp.readline())
+        assert "cache" in rec
+        assert "scene" in rec["cache"]
+        assert {"hits", "misses"} <= set(rec["cache"]["scene"])
