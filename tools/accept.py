@@ -171,6 +171,27 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
     store, utm, paths = B.build_archive(root)
     mas_client = MASClient(store)
 
+    # a curvilinear (geolocation-array) swath layer rides along: the
+    # acceptance run must exercise the geoloc warp through the full
+    # HTTP server, not just unit tests
+    import numpy as _np
+
+    from gsky_tpu.index.crawler import extract as _extract
+    from gsky_tpu.io.netcdf import write_netcdf3 as _wnc
+
+    swath_dir = os.path.join(root, "swath")
+    os.makedirs(swath_dir)
+    _gh, _gw = 120, 160
+    _ii, _jj = _np.mgrid[0:_gh, 0:_gw].astype(_np.float64)
+    _lon = 148.0 + 0.0015 * _jj + 0.0005 * _ii
+    _lat = -35.15 - 0.0012 * _ii
+    _wnc(os.path.join(swath_dir, "swath_20200110.nc"),
+         {"bt": (1000.0 + _ii + _jj).astype(_np.float32),
+          "lon": _lon, "lat": _lat},
+         _np.arange(_gw, dtype=_np.float64),
+         _np.arange(_gh, dtype=_np.float64), EPSG4326, nodata=-9999.0)
+    store.ingest(_extract(os.path.join(swath_dir, "swath_20200110.nc")))
+
     conf_dir = os.path.join(root, "conf")
     os.makedirs(conf_dir)
     config = {
@@ -180,6 +201,11 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
             "data_source": root,
             "rgb_products": [f"LC08_20200{110 + k}_T1"
                              for k in range(B.N_SCENES)],
+            "time_generator": "mas",
+        }, {
+            "name": "swath", "title": "curvilinear swath",
+            "data_source": swath_dir,
+            "rgb_products": ["bt"],
             "time_generator": "mas",
         }],
         "processes": [{
@@ -244,6 +270,24 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
                 f"&time=2020-01-10T00:00:00.000Z")
 
     rc = suite_wms_urls(host, urls, conc)
+
+    # one curvilinear GetMap (geolocation-array warp through the server)
+    print("Testing WMS GetMap (curvilinear swath): ", end="", flush=True)
+    try:
+        status, body = _get(
+            f"http://{host}/ows?service=WMS&request=GetMap&version=1.3.0"
+            f"&layers=swath&crs=EPSG:4326"
+            f"&bbox=-35.28,148.05,-35.17,148.2"
+            f"&width=128&height=128&format=image/png"
+            f"&time=2020-01-10T00:00:00.000Z")
+        ok = status == 200 and body[:8] == b"\x89PNG\r\n\x1a\n" \
+            and len(body) > 500
+    except Exception as e:  # noqa: BLE001
+        ok = False
+        print(f"error: {e} ", end="")
+    print("Passed" if ok else "Failed")
+    if not ok:
+        rc = 1
 
     # one WCS export
     print("Testing WCS GetCoverage: ", end="", flush=True)
