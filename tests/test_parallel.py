@@ -1,6 +1,8 @@
 """Sharded render/drill over the virtual 8-device CPU mesh: the SPMD
 path must agree with the single-device ops it parallelises."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -160,3 +162,42 @@ class TestNonDivisibleSharding:
 
         with pytest.raises(ValueError):
             make_mesh(8, shape=(3, 2))
+
+
+def test_init_multihost_single_process():
+    """init_multihost with an explicit 1-process layout must bring up
+    the jax distributed runtime and leave global_mesh + a sharded render
+    working.  Run in a subprocess: distributed init is process-global
+    and must not leak into other tests."""
+    import subprocess
+    import sys
+
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+import numpy as np
+from gsky_tpu.parallel.distributed import init_multihost, global_mesh
+from gsky_tpu.parallel import make_sharded_render_padded
+init_multihost(coordinator="localhost:37631", num_processes=1,
+               process_id=0)
+assert jax.process_count() == 1
+mesh = global_mesh()
+assert mesh.shape["granule"] * mesh.shape["x"] == 4
+rng = np.random.default_rng(0)
+src = rng.uniform(0, 9, (3, 1, 8, 8)).astype(np.float32)
+valid = np.ones((3, 1, 8, 8), bool)
+rows = rng.uniform(0, 7, (3, 8, 12)).astype(np.float32)
+cols = rng.uniform(0, 7, (3, 8, 12)).astype(np.float32)
+lut = np.zeros((256, 4), np.uint8)
+out = make_sharded_render_padded(mesh)(src, valid, rows, cols, lut)
+assert np.asarray(out).shape == (8, 12, 4)
+print("MULTIHOST-INIT-OK")
+"""
+    env = {k: v for k, v in os.environ.items()
+           if k != "JAX_PLATFORMS"}
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=180,
+                       env=env)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "MULTIHOST-INIT-OK" in r.stdout
