@@ -223,8 +223,12 @@ class Geometry:
             # slivers.  A genuinely crossing footprint unwraps to a
             # small-but-real area instead — so a degenerate SHIFTED
             # exterior means "wasn't crossing": keep the polygon whole.
-            shifted_area = abs(_shoelace(shifted[0]))
-            if shifted_area <= 1e-9 * max(abs(_shoelace(ext)), 1e-30):
+            # EXACT zero, not a relative epsilon: an ultra-thin but
+            # genuinely-crossing sliver has a tiny REAL shifted area and
+            # must still split; only the all-vertices-on-one-meridian
+            # collapse (the +/-180 world-footprint case) shifts to an
+            # exactly degenerate exterior
+            if abs(_shoelace(shifted[0])) == 0.0:
                 out_polys.append(poly)
                 continue
             if east:

@@ -100,6 +100,10 @@ class MASStore:
     """The index.  Thread-safe for concurrent reads."""
 
     _QUERY_CACHE_MAX = 1024
+    # process-wide totals across store instances, reachable by the
+    # metrics layer without a handle on the per-server store
+    total_query_hits = 0
+    total_query_misses = 0
 
     def __init__(self, db_path: str = ":memory:"):
         self._db_path = db_path
@@ -257,9 +261,11 @@ class MASStore:
             hit = self._query_cache.get(ckey)
             if hit is not None:
                 self.query_hits += 1
+                MASStore.total_query_hits += 1
                 self._query_cache.move_to_end(ckey)
             else:
                 self.query_misses += 1
+                MASStore.total_query_misses += 1
         if hit is not None:
             # shallow-per-record copy on hit: callers sort the files
             # list and annotate top-level record dicts, so those copy;

@@ -261,6 +261,11 @@ class GeoTIFF:
         else:
             n = struct.unpack(e + "H", fp.read(2))[0]
             entry_size, count_fmt, off_fmt = 12, "I", "I"
+        if entry_size * n > self._file_size:
+            # a corrupt (esp. BigTIFF u64) entry count must not drive a
+            # terabyte pre-allocation in fp.read
+            raise ValueError(
+                f"corrupt TIFF: IFD declares {n} entries")
         raw = fp.read(entry_size * n)
         next_off = struct.unpack(e + off_fmt, fp.read(struct.calcsize(off_fmt)))[0]
         tags = {}

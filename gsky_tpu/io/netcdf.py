@@ -406,9 +406,19 @@ class _NC3File:
     def _offset(self) -> int:
         return self._u64() if self._64bit else self._u32()
 
+    def _header_read(self, n: int) -> bytes:
+        """Header-controlled reads go through the same file-size bound
+        as data reads: a corrupt length field must not make fp.read
+        pre-allocate gigabytes (uninterruptible in C)."""
+        if n < 0 or n > self._size:
+            raise ValueError(
+                f"corrupt NetCDF: header field declares {n} bytes "
+                f"(file is {self._size})")
+        return self._fp.read(n)
+
     def _name(self) -> str:
         n = self._u32()
-        s = self._fp.read(n).decode("utf-8")
+        s = self._header_read(n).decode("utf-8")
         self._fp.read((4 - n % 4) % 4)
         return s
 
@@ -438,7 +448,7 @@ class _NC3File:
             cnt = self._u32()
             dt = _NC3_DTYPES[typ]
             nb = dt.itemsize * cnt
-            raw = self._fp.read(nb)
+            raw = self._header_read(nb)
             self._fp.read((4 - nb % 4) % 4)
             if typ == 2:
                 out[name] = raw.decode("latin-1")

@@ -90,16 +90,19 @@ class DrillStackCache:
                     self._order.append(key)
                     return hit
                 if key in self._neg:
+                    # a cached negative answer is a hit of the cache's
+                    # decision, not an uncounted branch
+                    self.hits += 1
                     return None
                 ev = self._inflight.get(key)
                 if ev is None:
                     self._inflight[key] = threading.Event()
+                    self.misses += 1      # under _lock: exact counts
                     break
             ev.wait()
 
         stack = None
         permanent_no = False
-        self.misses += 1
         try:
             stack, permanent_no = self._load(path, is_nc, var_name,
                                              band0, nodata)

@@ -124,11 +124,11 @@ class SceneCache:
                 ev = self._inflight.get(key)
                 if ev is None:
                     self._inflight[key] = threading.Event()
+                    self.misses += 1      # under _lock: exact counts
                     break
             ev.wait()
 
         scene = None
-        self.misses += 1
         try:
             scene = self._load(g, level)
             if scene is not None:

@@ -47,6 +47,12 @@ def _cache_stats() -> Dict:
                               "misses": default_drill_cache.misses}
     except Exception:
         pass
+    try:
+        from ..index.store import MASStore
+        out["mas_query"] = {"hits": MASStore.total_query_hits,
+                            "misses": MASStore.total_query_misses}
+    except Exception:
+        pass
     return out
 
 

@@ -371,3 +371,15 @@ class TestDatelineSplitDegenerate:
         assert s.contains_point(179.5, 0.0)
         assert s.contains_point(-179.5, 0.0)
         assert not s.contains_point(0.0, 0.0)
+
+    def test_ultra_thin_crossing_sliver_still_splits(self):
+        """A ~4e-7-degree-wide genuinely-crossing footprint must split
+        (the degenerate-shift guard is exact-zero, not an epsilon)."""
+        from gsky_tpu.geo import geometry as geom
+
+        g = geom.from_wkt(
+            "POLYGON ((179.9999999 -10,-179.9999999 -10,"
+            "-179.9999999 10,179.9999999 10,179.9999999 -10))")
+        s = g.split_dateline()
+        assert len(s.polys) == 2
+        assert not s.contains_point(0.0, 0.0)

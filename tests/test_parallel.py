@@ -179,7 +179,9 @@ jax.config.update("jax_num_cpu_devices", 4)
 import numpy as np
 from gsky_tpu.parallel.distributed import init_multihost, global_mesh
 from gsky_tpu.parallel import make_sharded_render_padded
-init_multihost(coordinator="localhost:37631", num_processes=1,
+import os as _os
+port = 20000 + _os.getpid() % 20000
+init_multihost(coordinator=f"localhost:{port}", num_processes=1,
                process_id=0)
 assert jax.process_count() == 1
 mesh = global_mesh()

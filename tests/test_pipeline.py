@@ -1067,8 +1067,6 @@ class TestCoarseZoomInteraction:
         from gsky_tpu.index.crawler import extract
         from gsky_tpu.io import write_geotiff
         from gsky_tpu.pipeline import TilePipeline, GeoTileRequest
-        from gsky_tpu.pipeline.scene_cache import SceneCache
-
         utm = parse_crs("EPSG:32755")
         SZ = 1024
         gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
@@ -1092,7 +1090,6 @@ class TestCoarseZoomInteraction:
         plain = pipe.process(GeoTileRequest(**base))
         # coarse + subdivision + tiny res limit: 4 index tiles fire AND
         # the 1024-px scene renders onto 128 px -> overview level 4
-        cache = SceneCache()
         pipe2 = TilePipeline(MASClient(store))
         sub = pipe2.process(GeoTileRequest(
             **base, spatial_extent=(ll.xmin, ll.ymin, ll.xmax, ll.ymax),
