@@ -23,6 +23,7 @@ checks reject them per-pixel (no NaN fringe at swath edges).
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -178,6 +179,7 @@ class GeolocGrid:
 # -- loading ------------------------------------------------------------
 
 _grid_cache: Dict[tuple, GeolocGrid] = {}
+_grid_cache_lock = threading.Lock()
 
 
 def load_geoloc_grid(path: str, geo_loc: Dict) -> Optional[GeolocGrid]:
@@ -185,7 +187,8 @@ def load_geoloc_grid(path: str, geo_loc: Dict) -> Optional[GeolocGrid]:
     x_var/y_var + offsets/steps), cached per file+vars.  None when the
     arrays can't be read."""
     key = (path, geo_loc.get("x_var"), geo_loc.get("y_var"))
-    hit = _grid_cache.get(key)
+    with _grid_cache_lock:
+        hit = _grid_cache.get(key)
     if hit is not None:
         return hit
     try:
@@ -201,7 +204,10 @@ def load_geoloc_grid(path: str, geo_loc: Dict) -> Optional[GeolocGrid]:
             pixel_step=float(geo_loc.get("pixel_step", 1.0)))
     except Exception:
         return None
-    if len(_grid_cache) > 16:
-        _grid_cache.pop(next(iter(_grid_cache)))
-    _grid_cache[key] = grid
+    # eviction + insert under one lock: two racing loaders must not both
+    # pop the same key (the loser's KeyError used to fail the request)
+    with _grid_cache_lock:
+        while len(_grid_cache) > 16:
+            _grid_cache.pop(next(iter(_grid_cache)))
+        _grid_cache[key] = grid
     return grid

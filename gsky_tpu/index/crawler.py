@@ -353,6 +353,13 @@ def extract(path: str, approx_stats: bool = False,
         rule, m = match_rule(path, rules)
         if rule is not None and rule.collection != "default":
             apply_ruleset(rule, m, rec, path)
+            # visible trail when a builtin rule rewrites a record: a
+            # pattern mis-tag (whole-world bbox, geoloc vars that don't
+            # exist) would otherwise surface only as a silently empty
+            # render much later
+            import logging
+            logging.getLogger("gsky.crawl").info(
+                "ruleset %r applied to %s", rule.collection, path)
     except Exception:
         # extract() never raises (per-file error records instead); a
         # bad user rule (e.g. invalid regex, compiled lazily) must not

@@ -285,8 +285,14 @@ BUILTIN_RULESETS: List[RuleSet] = [
     RuleSet(collection="barra", pattern=(
         r"(?P<year>\d{4})(?P<month>\d\d)(?P<day>\d\d)T"
         r"(?P<hour>\d\d)(?P<minute>\d\d)Z\.nc")),
+    # the reference's pattern is the bare substring "roms"
+    # (`ruleset.go` inherits the mis-tag risk on any basename containing
+    # it); anchored here to a separated token + .nc suffix so unrelated
+    # NetCDFs don't acquire a whole-world footprint + lon_v/lat_v
+    # geolocation they don't have
     RuleSet(collection="ereef", srs_text="EPSG:4326",
-            proj4_text=_WGS84_PROJ4, pattern=r"roms",
+            proj4_text=_WGS84_PROJ4,
+            pattern=r"(?:^|[_.-])roms(?=[_.-]).*\.nc$",
             bbox=[-180.0, 90.0, 180.0, -90.0],
             geo_loc=GeoLocRule(
                 x_dataset_pattern=r"(?P<filename>.*)",

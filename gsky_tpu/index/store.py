@@ -101,9 +101,12 @@ class MASStore:
 
     _QUERY_CACHE_MAX = 1024
     # process-wide totals across store instances, reachable by the
-    # metrics layer without a handle on the per-server store
+    # metrics layer without a handle on the per-server store; guarded by
+    # a CLASS-level lock — per-instance locks don't serialise increments
+    # across the many MASStore instances a sharded store fans out to
     total_query_hits = 0
     total_query_misses = 0
+    _totals_lock = threading.Lock()
 
     def __init__(self, db_path: str = ":memory:"):
         self._db_path = db_path
@@ -261,11 +264,13 @@ class MASStore:
             hit = self._query_cache.get(ckey)
             if hit is not None:
                 self.query_hits += 1
-                MASStore.total_query_hits += 1
+                with MASStore._totals_lock:
+                    MASStore.total_query_hits += 1
                 self._query_cache.move_to_end(ckey)
             else:
                 self.query_misses += 1
-                MASStore.total_query_misses += 1
+                with MASStore._totals_lock:
+                    MASStore.total_query_misses += 1
         if hit is not None:
             # shallow-per-record copy on hit: callers sort the files
             # list and annotate top-level record dicts, so those copy;

@@ -64,9 +64,11 @@ def encode_png(bands: Sequence[np.ndarray],
 
 
 def encode_rgba_png(rgba: np.ndarray) -> bytes:
-    """(H, W, 4) uint8 -> PNG bytes (the device palette path output)."""
+    """(H, W, 4) uint8 -> PNG bytes (the device palette / packed-RGB
+    path output — already interleaved, no host assembly pass)."""
     buf = io.BytesIO()
-    Image.fromarray(np.asarray(rgba, np.uint8), "RGBA").save(buf, "PNG")
+    Image.fromarray(np.asarray(rgba, np.uint8), "RGBA").save(
+        buf, "PNG", compress_level=1)
     return buf.getvalue()
 
 

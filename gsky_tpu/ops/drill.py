@@ -31,6 +31,24 @@ import numpy as np
 _BIG = 3.0e38
 
 
+def masked_mean_impl(data, valid, clip_lower, clip_upper,
+                     pixel_count: bool, xp):
+    """Array-namespace-generic body of `masked_mean` (xp = jnp on
+    device, np for host-read cold-path data — ONE implementation so the
+    two paths can't drift)."""
+    data = data.astype(xp.float32)
+    inclip = valid & (data >= clip_lower) & (data <= clip_upper)
+    n_inclip = xp.sum(inclip, axis=-1)
+    if pixel_count:
+        total = xp.sum(valid, axis=-1)
+        value = xp.where(total > 0, n_inclip / xp.maximum(total, 1), 0.0)
+        # reference: sum of 1.0 per in-clip pixel / total valid
+        return value.astype(xp.float32), total.astype(xp.int32)
+    s = xp.sum(xp.where(inclip, data, 0.0), axis=-1, dtype=xp.float32)
+    value = xp.where(n_inclip > 0, s / xp.maximum(n_inclip, 1), 0.0)
+    return value.astype(xp.float32), n_inclip.astype(xp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("pixel_count",))
 def masked_mean(data, valid, clip_lower=-3.0e38, clip_upper=3.0e38,
                 pixel_count: bool = False):
@@ -41,17 +59,45 @@ def masked_mean(data, valid, clip_lower=-3.0e38, clip_upper=3.0e38,
     contributing.  Pixel-count mode (reference `drill.go:155-171`):
     value = fraction #{valid within clip} / #{valid}, count = #{valid}.
     """
-    data = data.astype(jnp.float32)
-    inclip = valid & (data >= clip_lower) & (data <= clip_upper)
-    n_inclip = jnp.sum(inclip, axis=-1)
-    if pixel_count:
-        total = jnp.sum(valid, axis=-1)
-        value = jnp.where(total > 0, n_inclip / jnp.maximum(total, 1), 0.0)
-        # reference: sum of 1.0 per in-clip pixel / total valid
-        return value.astype(jnp.float32), total.astype(jnp.int32)
-    s = jnp.sum(jnp.where(inclip, data, 0.0), axis=-1)
-    value = jnp.where(n_inclip > 0, s / jnp.maximum(n_inclip, 1), 0.0)
-    return value.astype(jnp.float32), n_inclip.astype(jnp.int32)
+    return masked_mean_impl(data, valid, clip_lower, clip_upper,
+                            pixel_count, jnp)
+
+
+def deciles_impl(data, valid, n_deciles: int, xp):
+    """Array-namespace-generic body of `deciles` — the index/padding
+    maths exists once for both the device and host reduction paths."""
+    data = data.astype(xp.float32)
+    B, N = data.shape
+    D = n_deciles
+    buf = xp.sort(xp.where(valid, data, xp.float32(_BIG)), axis=-1)
+    n = xp.sum(valid, axis=-1)  # (B,)
+    step = n // (D + 1)
+    is_even = (n % (D + 1)) == 0
+    i = xp.arange(D)
+    # main path: idx = (i+1)*step, averaged with idx+1 when evenly divisible
+    nmax = xp.maximum(n - 1, 0)[:, None]  # last VALID index, not padding
+    idx = (i[None, :] + 1) * step[:, None]
+    idx = xp.clip(idx, 0, nmax)
+    idx2 = xp.clip(idx + 1, 0, nmax)  # reference indexes past the end
+    # here (panic for n == D+1); clamping to the last valid value instead
+    v1 = xp.take_along_axis(buf, idx, axis=-1)
+    v2 = xp.take_along_axis(buf, idx2, axis=-1)
+    main = xp.where(is_even[:, None], (v1 + v2) / 2.0, v1)
+    # padding path (n < D+1, n > 0): decile i takes buf[j] where j is the
+    # i-th element of the sorted multiset {k mod n repeated}; equivalently
+    # j = i // ceil(D/n) distributed cyclically.  Reference builds
+    # padding[k] = #{i in [0,D): i % n == k} and emits buf[k] that many
+    # times in order, i.e. j(i) = smallest k with sum(padding[:k+1]) > i.
+    nn = xp.maximum(n, 1)
+    count_k = (D - xp.arange(D)[None, :] - 1) // nn[:, None] + 1  # per k<n
+    count_k = xp.where(xp.arange(D)[None, :] < nn[:, None], count_k, 0)
+    cum = xp.cumsum(count_k, axis=-1)
+    j = xp.sum((i[None, None, :] >= cum[:, :, None]).astype(xp.int32),
+               axis=1)  # (B, D): how many cums <= i
+    j = xp.clip(j, 0, N - 1)
+    pad = xp.take_along_axis(buf, j, axis=-1)
+    out = xp.where((step > 0)[:, None], main, pad)
+    return xp.where((n > 0)[:, None], out, 0.0)
 
 
 @functools.partial(jax.jit, static_argnames=("n_deciles",))
@@ -61,38 +107,7 @@ def deciles(data, valid, n_deciles: int):
     data (B, N) f32, valid (B, N) bool -> (B, n_deciles) f32.
     Bands with zero valid pixels return zeros (the caller zeroes them via
     the count anyway, `drill.go:186-193`)."""
-    data = data.astype(jnp.float32)
-    B, N = data.shape
-    D = n_deciles
-    buf = jnp.sort(jnp.where(valid, data, jnp.float32(_BIG)), axis=-1)
-    n = jnp.sum(valid, axis=-1)  # (B,)
-    step = n // (D + 1)
-    is_even = (n % (D + 1)) == 0
-    i = jnp.arange(D)
-    # main path: idx = (i+1)*step, averaged with idx+1 when evenly divisible
-    nmax = jnp.maximum(n - 1, 0)[:, None]  # last VALID index, not padding
-    idx = (i[None, :] + 1) * step[:, None]
-    idx = jnp.clip(idx, 0, nmax)
-    idx2 = jnp.clip(idx + 1, 0, nmax)  # reference indexes past the end
-    # here (panic for n == D+1); clamping to the last valid value instead
-    v1 = jnp.take_along_axis(buf, idx, axis=-1)
-    v2 = jnp.take_along_axis(buf, idx2, axis=-1)
-    main = jnp.where(is_even[:, None], (v1 + v2) / 2.0, v1)
-    # padding path (n < D+1, n > 0): decile i takes buf[j] where j is the
-    # i-th element of the sorted multiset {k mod n repeated}; equivalently
-    # j = i // ceil(D/n) distributed cyclically.  Reference builds
-    # padding[k] = #{i in [0,D): i % n == k} and emits buf[k] that many
-    # times in order, i.e. j(i) = smallest k with sum(padding[:k+1]) > i.
-    nn = jnp.maximum(n, 1)
-    count_k = (D - jnp.arange(D)[None, :] - 1) // nn[:, None] + 1  # per k<n
-    count_k = jnp.where(jnp.arange(D)[None, :] < nn[:, None], count_k, 0)
-    cum = jnp.cumsum(count_k, axis=-1)
-    j = jnp.sum((i[None, None, :] >= cum[:, :, None]).astype(jnp.int32),
-                axis=1)  # (B, D): how many cums <= i
-    j = jnp.clip(j, 0, N - 1)
-    pad = jnp.take_along_axis(buf, j, axis=-1)
-    out = jnp.where((step > 0)[:, None], main, pad)
-    return jnp.where((n > 0)[:, None], out, 0.0)
+    return deciles_impl(data, valid, n_deciles, jnp)
 
 
 @functools.partial(jax.jit, static_argnames=("out_hw",))

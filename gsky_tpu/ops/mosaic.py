@@ -135,14 +135,15 @@ def mosaic_stack(rasters, nodata_masks, timestamps,
         from .pallas_tpu import (_MOSAIC_T_MAX, mosaic_first_valid_pallas,
                                  run_with_fallback)
         if stack.shape[0] <= _MOSAIC_T_MAX:
-            # materialise inside the thunk: jit dispatch is async, so a
-            # runtime kernel fault would otherwise surface downstream,
-            # past the fallback's try/except
+            # sync_token: the first dispatch per shape materialises
+            # inside the fallback guard (a runtime kernel fault must
+            # fall back, not surface downstream of the async dispatch);
+            # proven shapes dispatch async — no per-call host sync
             return run_with_fallback(
                 "mosaic_first_valid",
-                lambda: jax.block_until_ready(
-                    mosaic_first_valid_pallas(stack, valid)),
-                lambda: mosaic_first_valid(stack, valid))
+                lambda: mosaic_first_valid_pallas(stack, valid),
+                lambda: mosaic_first_valid(stack, valid),
+                sync_token=tuple(stack.shape))
     return mosaic_first_valid(stack, valid)
 
 

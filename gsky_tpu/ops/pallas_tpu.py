@@ -64,18 +64,43 @@ def use_pallas() -> bool:
 # stop retrying (a Mosaic compile failure is deterministic per shape, but
 # one bad shape must never take down the pipeline — BENCH_r03 post-mortem)
 _FAILED: set = set()
+# (name, token) -> successful-dispatch count: proven pairs skip the
+# materialising sync on most calls (see run_with_fallback)
+_PROVEN: dict = {}
+# every Nth dispatch of a proven (name, token) re-materialises inside
+# the guard: a load-dependent runtime fault (HBM pressure, relay
+# hiccup) surfacing downstream of async dispatches would otherwise
+# never reach the blacklist and every later request would re-dispatch
+# the faulting kernel — this bounds that failure window to < _RESYNC
+# requests before the kernel falls back to XLA for good
+_RESYNC = 64
 
 
-def run_with_fallback(name, pallas_thunk, xla_thunk):
+def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
     """Run `pallas_thunk()` when the Pallas path is enabled and healthy,
     else `xla_thunk()`.  Any Pallas failure (VMEM OOM, Mosaic lowering
     bug, relay hiccup) is logged once, the kernel is blacklisted for the
     process, and the XLA fallback result is returned — callers always get
-    numbers."""
+    numbers.
+
+    ``sync_token`` (e.g. the input shape): when given, the pallas result
+    is materialised (block_until_ready) inside the guard on the FIRST
+    call per (name, token) — a runtime fault on a new shape falls back
+    here rather than surfacing downstream of the async dispatch — and on
+    every ``_RESYNC``-th call thereafter, so a kernel that starts
+    faulting under load still reaches the blacklist; in between,
+    dispatches stay async so the pipeline doesn't serialise on a host
+    sync per call."""
     if name in _FAILED or not use_pallas():
         return xla_thunk()
     try:
-        return pallas_thunk()
+        r = pallas_thunk()
+        if sync_token is not None:
+            cnt = _PROVEN.get((name, sync_token), 0)
+            if cnt % _RESYNC == 0:
+                r = jax.block_until_ready(r)
+            _PROVEN[(name, sync_token)] = cnt + 1
+        return r
     except Exception as e:  # noqa: BLE001 - any compile/runtime failure
         _FAILED.add(name)
         import warnings

@@ -133,8 +133,11 @@ class DrillPipeline:
         # large-polygon tiling (`drill_indexer.go:115-137`): each tiled
         # sub-geometry runs the index + per-file reductions separately,
         # and the (namespace, date) accumulator merges them count-
-        # weighted — identical maths to multiple files covering the
-        # polygon, so memory stays bounded by one tile's window
+        # weighted, so memory stays bounded by one tile's window.
+        # Known deviation from the untiled result (shared with the
+        # reference): adjacent clipped sub-polygons both ALL_TOUCHED-burn
+        # the shared boundary row, so edge pixels count in two tiles and
+        # the merged mean skews by O(perimeter/area)
         tiles = tiled_geometries(req.geometry_wkt,
                                  req.index_tile_x_size,
                                  req.index_tile_y_size)
@@ -473,7 +476,11 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
             from . import drill_cache as DC
             if DC.enabled():
                 try:
-                    st = DC.default_drill_cache.get(
+                    # async by default: a cold request answers from host
+                    # reads while the stack uploads in the background
+                    getter = DC.default_drill_cache.get if DC.sync_mode() \
+                        else DC.default_drill_cache.get_async
+                    st = getter(
                         ds.file_path, is_nc, var if is_nc else "", band0,
                         ds.nodata)
                     dev = _drill_device(st, sel, read_idx, mask,
@@ -518,9 +525,33 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
         h.close()
 
 
+def _stats_host(dataf: np.ndarray, validf: np.ndarray,
+                req: GeoDrillRequest):
+    """The device reductions run in NUMPY for HOST-read window data:
+    a cold drill (stack not yet device-resident) must not ship the
+    (B, window) block through the device link just to reduce it — the
+    reference's reductions are host-side too (`drill.go:128-220`).
+    Steady-state requests still reduce on device from the resident
+    stack (`_drill_device`).  Same implementation bodies as the device
+    path (`ops.drill.*_impl` parameterised on the array namespace), so
+    cold and warm responses cannot drift."""
+    vals, counts = D.masked_mean_impl(
+        dataf, validf, req.clip_lower, req.clip_upper, req.pixel_count,
+        np)
+    if req.deciles:
+        dec = D.deciles_impl(dataf, validf, req.deciles,
+                             np).astype(np.float32)
+    else:
+        dec = np.zeros((dataf.shape[0], 0), np.float32)
+    return vals.astype(np.float32), counts.astype(np.int32), dec
+
+
 def _stats_tail(dataf, validf, req: GeoDrillRequest):
     """Masked mean + deciles over (B, N) data/valid — device or host
-    arrays (jnp.asarray is a no-op for resident device buffers)."""
+    arrays (jnp.asarray is a no-op for resident device buffers; numpy
+    inputs reduce in numpy, see `_stats_host`)."""
+    if isinstance(dataf, np.ndarray):
+        return _stats_host(dataf, validf, req)
     from ..ops.pallas_tpu import masked_stats_pallas, run_with_fallback
 
     def _via_pallas():

@@ -22,6 +22,7 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -48,8 +49,14 @@ class DeviceStack:
 class DrillStackCache:
     def __init__(self, max_bytes: int = 4 << 30,
                  max_item_bytes: int = 1 << 30,
-                 max_negative: int = 4096):
+                 max_negative: int = 4096,
+                 max_background_loads: int = 2):
         self._lock = threading.Lock()
+        # bound on concurrent get_async loader threads: a cold drill
+        # over a many-file collection must not fan out one full-raster
+        # load (+ host buffer + upload) per file at once — unscheduled
+        # misses stay on the host path and retry on a later request
+        self._bg_slots = threading.BoundedSemaphore(max_background_loads)
         self._stacks: Dict[tuple, DeviceStack] = {}
         self._order: List[tuple] = []
         self._bytes = 0
@@ -63,24 +70,33 @@ class DrillStackCache:
         self.hits = 0
         self.misses = 0
 
-    def get(self, path: str, is_nc: bool, var_name: str, band0: int,
-            nodata: Optional[float]) -> Optional[DeviceStack]:
-        """Cached (T, H, W) stack for one file variable/band, uploading
-        on first use.  None when uncacheable (too big, 64-bit, or
-        unreadable — unreadable retries next request).  Concurrent first
-        requests load once.  ``nodata`` is part of the identity: two
-        collections indexing the same file with different overrides get
-        distinct (correct) masks."""
+    @staticmethod
+    def _key(path: str, var_name: str, band0: int,
+             nodata: Optional[float]):
+        """(key, mtime) or None when the file can't be stat'd.  NaN
+        can't be a dict-key component (NaN != NaN would miss every
+        hit); absent/NaN nodata normalises to a sentinel."""
         try:
             mtime = os.stat(path).st_mtime_ns
         except OSError:
             return None
-        # NaN can't be a dict-key component (NaN != NaN would miss every
-        # hit); absent/NaN nodata normalises to a sentinel
         nd_key = "nan" if nodata is None or \
             (isinstance(nodata, float) and np.isnan(nodata)) \
             else float(nodata)
-        key = (path, mtime, var_name, band0, nd_key)
+        return (path, mtime, var_name, band0, nd_key), mtime
+
+    def get(self, path: str, is_nc: bool, var_name: str, band0: int,
+            nodata: Optional[float]) -> Optional[DeviceStack]:
+        """Cached (T, H, W) stack for one file variable/band, uploading
+        on first use (BLOCKING until the upload lands).  None when
+        uncacheable (too big, 64-bit, or unreadable — unreadable retries
+        next request).  Concurrent first requests load once.  ``nodata``
+        is part of the identity: two collections indexing the same file
+        with different overrides get distinct (correct) masks."""
+        made = self._key(path, var_name, band0, nodata)
+        if made is None:
+            return None
+        key, mtime = made
         while True:
             with self._lock:
                 hit = self._stacks.get(key)
@@ -100,7 +116,74 @@ class DrillStackCache:
                     self.misses += 1      # under _lock: exact counts
                     break
             ev.wait()
+        return self._load_into(key, mtime, path, is_nc, var_name, band0,
+                               nodata)
 
+    def get_async(self, path: str, is_nc: bool, var_name: str,
+                  band0: int,
+                  nodata: Optional[float]) -> Optional[DeviceStack]:
+        """Resident stack, or None immediately — scheduling a
+        background load on a first miss so a LATER request hits.  The
+        cold request then runs at host-read speed instead of blocking
+        on a multi-second stack upload through the device link (the
+        cfg5 cold-path fix): first drill ~= the CPU baseline, steady
+        state on-device."""
+        made = self._key(path, var_name, band0, nodata)
+        if made is None:
+            return None
+        key, mtime = made
+        with self._lock:
+            hit = self._stacks.get(key)
+            if hit is not None:
+                self.hits += 1
+                self._order.remove(key)
+                self._order.append(key)
+                return hit
+            if key in self._neg:
+                self.hits += 1
+                return None
+            if key in self._inflight:
+                return None          # load already on its way
+            if not self._bg_slots.acquire(blocking=False):
+                return None          # loader pool saturated: retry later
+            self._inflight[key] = threading.Event()
+            self.misses += 1
+
+        def load_and_release():
+            try:
+                self._load_into(key, mtime, path, is_nc, var_name,
+                                band0, nodata)
+            finally:
+                self._bg_slots.release()
+
+        threading.Thread(target=load_and_release,
+                         name="gsky-drill-upload", daemon=True).start()
+        return None
+
+    def wait_idle(self, timeout_s: float = 60.0) -> bool:
+        """Block until no loads are in flight (benches/tests separating
+        cold from warm).  True when idle within the timeout."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                evs = list(self._inflight.values())
+            if not evs:
+                return True
+            for ev in evs:
+                if not ev.wait(max(deadline - time.monotonic(), 0.0)):
+                    return False
+
+    def clear(self) -> None:
+        """Drop every resident stack (bench cold-path measurement)."""
+        with self._lock:
+            self._stacks.clear()
+            self._order.clear()
+            self._neg.clear()
+            self._bytes = 0
+
+    def _load_into(self, key, mtime, path, is_nc, var_name, band0,
+                   nodata) -> Optional[DeviceStack]:
+        """Load + insert under the inflight latch taken by the caller."""
         stack = None
         permanent_no = False
         try:
@@ -184,9 +267,14 @@ class DrillStackCache:
 
 
 # module-level default (shared across requests); anything CPU-bound can
-# disable via GSKY_DRILL_CACHE=0
+# disable via GSKY_DRILL_CACHE=0; GSKY_DRILL_CACHE=sync restores the
+# blocking first-request upload (deterministic paths for tests)
 def enabled() -> bool:
     return os.environ.get("GSKY_DRILL_CACHE", "1") != "0"
+
+
+def sync_mode() -> bool:
+    return os.environ.get("GSKY_DRILL_CACHE", "1") == "sync"
 
 
 default_drill_cache = DrillStackCache()

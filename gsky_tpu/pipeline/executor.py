@@ -59,6 +59,17 @@ def _bucket_pow2(n: int, lo: int = 1) -> int:
     return b
 
 
+def _inv_gt_params(gt: GeoTransform, ox: float, oy: float):
+    """Origin-folded inverse geotransform (src-CRS coords relative to
+    (ox, oy) -> granule pixel): the 6-tuple every scene kernel takes in
+    params[:6] — col = p0 + p1*sx + p2*sy, row = p3 + p4*sx + p5*sy."""
+    det = gt.dx * gt.dy - gt.rx * gt.ry
+    inv = (gt.dy / det, -gt.rx / det, -gt.ry / det, gt.dx / det)
+    a0 = inv[0] * (ox - gt.x0) + inv[1] * (oy - gt.y0)
+    a3 = inv[2] * (ox - gt.x0) + inv[3] * (oy - gt.y0)
+    return (a0, inv[0], inv[1], a3, inv[2], inv[3])
+
+
 class WarpExecutor:
     """Batches decoded granule windows into device dispatches."""
 
@@ -301,13 +312,7 @@ class WarpExecutor:
             for k, (i, wdw) in enumerate(zip(idxs, gs)):
                 h0, w0 = wdw.data.shape
                 src[k, :h0, :w0] = np.where(wdw.valid, wdw.data, np.nan)
-                gt = wdw.window_gt
-                det = gt.dx * gt.dy - gt.rx * gt.ry
-                inv = (gt.dy / det, -gt.rx / det, -gt.ry / det,
-                       gt.dx / det)
-                a0 = inv[0] * (ox - gt.x0) + inv[1] * (oy - gt.y0)
-                a3 = inv[2] * (ox - gt.x0) + inv[3] * (oy - gt.y0)
-                params[k, :6] = (a0, inv[0], inv[1], a3, inv[2], inv[3])
+                params[k, :6] = _inv_gt_params(wdw.window_gt, ox, oy)
                 params[k, 6] = h0
                 params[k, 7] = w0
                 params[k, 8] = np.nan   # validity is NaN-encoded in src
@@ -414,6 +419,88 @@ class WarpExecutor:
             stack, ctrl_dev, jnp.asarray(params), sp, sel,
             method, _bucket_pow2(n_ns), (height, width), step, auto,
             colour_scale))
+
+    def render_rgba_byte(self, granules, out_sel: Sequence[int],
+                         dst_gt: GeoTransform, dst_crs: CRS,
+                         height: int, width: int, method: str = "near",
+                         offset: float = 0.0, scale: float = 0.0,
+                         clip: float = 0.0, colour_scale: int = 0,
+                         auto: bool = True, cache=None):
+        """Channel-packed RGB fast path: when the request is one RGB
+        scene (one temporal granule per output band, all bands sharing
+        grid/dtype/nodata — the Sentinel-2 true-colour shape), the three
+        band scenes pack into a (sh, sw, 3) device array (cached) and
+        `ops.warp.render_rgba_ctrl` renders the PNG-ready (H, W, 4)
+        RGBA tile in one dispatch, computing warp indices once for all
+        three bands.  Returns a device uint8 (H, W, 4) or None (caller
+        falls back to the per-band path)."""
+        if len(granules) != 3 or len(out_sel) != 3 \
+                or sorted(out_sel) != [0, 1, 2]:
+            return None
+        g0 = granules[0]
+        if g0.geo_loc:
+            return None
+        for g in granules[1:]:
+            if g.geo_loc or g.srs != g0.srs \
+                    or g.geo_transform != g0.geo_transform:
+                return None
+        from ..geo.crs import parse_crs
+        from .scene_cache import default_scene_cache
+        cache = cache or default_scene_cache
+        try:
+            src_crs = parse_crs(g0.srs) if g0.srs else None
+        except ValueError:
+            return None
+        if src_crs is None:
+            return None
+        stride = self._granule_stride(g0, dst_gt, dst_crs, height, width)
+        # out_sel maps expression order -> ns index == granule index here
+        # (one granule per namespace); channel k comes from the granule
+        # whose ns id equals out_sel[k]
+        chans = []
+        for ns in out_sel:
+            s = cache.get(granules[ns], stride)
+            if s is None:
+                return None
+            chans.append(s)
+        s0 = chans[0]
+        for s in chans[1:]:
+            if s.bucket != s0.bucket or s.dtype != s0.dtype \
+                    or s.crs != s0.crs \
+                    or not (np.isnan(s.nodata) and np.isnan(s0.nodata)
+                            or s.nodata == s0.nodata) \
+                    or (s.height, s.width) != (s0.height, s0.width):
+                return None
+        sx, sy, step = self._ctrl_geo_coords(dst_gt, dst_crs, height,
+                                             width, s0.crs, 16)
+        ox, oy = s0.gt.x0, s0.gt.y0
+        dkey = ("ctrldev", dst_gt.to_gdal(), dst_crs, height, width,
+                s0.crs, ox, oy)
+        ctrl_dev = self._geo_cache_get(dkey)
+        if ctrl_dev is None:
+            ctrl_dev = jnp.asarray(
+                np.stack([sx - ox, sy - oy]).astype(np.float32))
+            self._geo_cache_put(dkey, ctrl_dev)
+        skey = ("rgb",) + tuple(s.serial for s in chans)
+        with self._lock:
+            packed = self._stack_cache.get(skey)
+            if packed is not None:
+                self._stack_cache.move_to_end(skey)
+        if packed is None:
+            packed = jnp.stack([s.dev for s in chans], axis=-1)
+            with self._lock:
+                self._stack_cache[skey] = packed
+                self._stack_cache.move_to_end(skey)
+                while len(self._stack_cache) > self._STACK_CACHE_MAX:
+                    self._stack_cache.popitem(last=False)
+        param = np.array(_inv_gt_params(s0.gt, ox, oy)
+                         + (s0.height, s0.width, s0.nodata, 0.0, 0.0),
+                         np.float32)
+        from ..ops.warp import render_rgba_ctrl
+        sp = np.array([offset, scale, clip], np.float32)
+        return _prefetch(render_rgba_ctrl(
+            packed, ctrl_dev, jnp.asarray(param), jnp.asarray(sp),
+            method, (height, width), step, auto, colour_scale))
 
     def _scene_inputs(self, granules, ns_ids, prios, dst_gt, dst_crs,
                       height, width, cache=None):
@@ -551,14 +638,7 @@ class WarpExecutor:
                     # ctrl already carries pixel coords: identity affine
                     params[k, :6] = (0.0, 1.0, 0.0, 0.0, 0.0, 1.0)
                 else:
-                    gt = s.gt
-                    det = gt.dx * gt.dy - gt.rx * gt.ry
-                    inv = (gt.dy / det, -gt.rx / det, -gt.ry / det,
-                           gt.dx / det)
-                    a0 = inv[0] * (ox - gt.x0) + inv[1] * (oy - gt.y0)
-                    a3 = inv[2] * (ox - gt.x0) + inv[3] * (oy - gt.y0)
-                    params[k, :6] = (a0, inv[0], inv[1], a3, inv[2],
-                                     inv[3])
+                    params[k, :6] = _inv_gt_params(s.gt, ox, oy)
                 params[k, 6] = s.height
                 params[k, 7] = s.width
                 params[k, 8] = s.nodata

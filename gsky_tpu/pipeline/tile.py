@@ -242,6 +242,53 @@ class TilePipeline:
             req.height, req.width, len(ns_names), req.resample,
             offset, scale, clip, colour_scale, auto)
 
+    def _bands_prep(self, req: GeoTileRequest, n_bands: int = 0,
+                    stats: Optional[Dict[str, int]] = None):
+        """Shared index + namespace/selection resolution for the fused
+        multi-band paths: (granules, ns_index, out_sel) or None.  ONE
+        index pass feeds both rungs of the RGB ladder."""
+        if self.remote is not None or req.mask is not None:
+            return None
+        exprs = req.band_exprs
+        if not exprs.expressions or \
+                (n_bands and len(exprs.expressions) != n_bands) or \
+                any(ce._ast[0] != "var" for ce in exprs.expressions):
+            return None
+        granules = self.index(req)
+        if not granules:
+            return None
+        if stats is not None:
+            stats["granules"] = len(granules)
+            stats["files"] = len({g.path for g in granules})
+        ns_index: Dict[str, int] = {}
+        for g in granules:
+            if g.namespace not in ns_index:
+                ns_index[g.namespace] = len(ns_index)
+        out_sel = []
+        for ce in exprs.expressions:
+            var = ce.variables[0]
+            if var in ns_index:
+                out_sel.append(ns_index[var])
+                continue
+            cands = [k for k in ns_index if k.split("#")[0] == var]
+            if len(cands) != 1:
+                return None
+            out_sel.append(ns_index[cands[0]])
+        return granules, ns_index, out_sel
+
+    def _bands_dispatch(self, req: GeoTileRequest, granules, ns_index,
+                        out_sel, offset, scale, clip, colour_scale,
+                        auto):
+        ns_ids = [ns_index[g.namespace] for g in granules]
+        order = M.priority_order([g.timestamp for g in granules])
+        prio = [0.0] * len(granules)
+        for rank, i in enumerate(order):
+            prio[i] = float(len(granules) - rank)
+        return self.executor.render_bands_byte(
+            granules, ns_ids, prio, req.dst_gt(), req.crs,
+            req.height, req.width, len(ns_index), out_sel, req.resample,
+            offset, scale, clip, colour_scale, auto)
+
     def render_bands_byte(self, req: GeoTileRequest,
                           offset: float = 0.0, scale: float = 0.0,
                           clip: float = 0.0, colour_scale: int = 0,
@@ -253,43 +300,65 @@ class TilePipeline:
         None when the request doesn't qualify (mask band, remote
         workers, non-trivial expressions, unmatched namespaces,
         uncacheable scenes)."""
-        if self.remote is not None or req.mask is not None:
+        made = self._bands_prep(req, stats=stats)
+        if made is None:
             return None
-        exprs = req.band_exprs
-        if not exprs.expressions or \
-                any(ce._ast[0] != "var" for ce in exprs.expressions):
+        granules, ns_index, out_sel = made
+        return self._bands_dispatch(req, granules, ns_index, out_sel,
+                                    offset, scale, clip, colour_scale,
+                                    auto)
+
+    def _rgba_try(self, req: GeoTileRequest, granules, ns_index, out_sel,
+                  offset, scale, clip, colour_scale, auto):
+        """The channel-packed RGBA dispatch over an ALREADY-indexed
+        granule set, or None when the set doesn't fit the single-scene
+        true-colour shape."""
+        if len(granules) != 3 or len(ns_index) != 3 \
+                or sorted(out_sel) != [0, 1, 2]:
             return None
-        granules = self.index(req)
-        if not granules:
+        return self.executor.render_rgba_byte(
+            granules, out_sel, req.dst_gt(), req.crs, req.height,
+            req.width, req.resample, offset, scale, clip, colour_scale,
+            auto)
+
+    def render_rgba_byte(self, req: GeoTileRequest,
+                         offset: float = 0.0, scale: float = 0.0,
+                         clip: float = 0.0, colour_scale: int = 0,
+                         auto: bool = True,
+                         stats: Optional[Dict[str, int]] = None):
+        """One-dispatch RGB GetMap for the single-scene true-colour
+        shape: index -> channel-packed warp + per-band scaling + alpha
+        on device (`executor.render_rgba_byte`).  Returns the PNG-ready
+        uint8 (H, W, 4) jax array, or None when the request doesn't
+        qualify (callers then use `render_bands_byte` / `process`)."""
+        made = self._bands_prep(req, n_bands=3, stats=stats)
+        if made is None:
             return None
-        if stats is not None:
-            stats["granules"] = len(granules)
-            stats["files"] = len({g.path for g in granules})
-        ns_names: List[str] = []
-        ns_index: Dict[str, int] = {}
-        for g in granules:
-            if g.namespace not in ns_index:
-                ns_index[g.namespace] = len(ns_names)
-                ns_names.append(g.namespace)
-        out_sel = []
-        for ce in exprs.expressions:
-            var = ce.variables[0]
-            if var in ns_index:
-                out_sel.append(ns_index[var])
-                continue
-            cands = [k for k in ns_index if k.split("#")[0] == var]
-            if len(cands) != 1:
-                return None
-            out_sel.append(ns_index[cands[0]])
-        ns_ids = [ns_index[g.namespace] for g in granules]
-        order = M.priority_order([g.timestamp for g in granules])
-        prio = [0.0] * len(granules)
-        for rank, i in enumerate(order):
-            prio[i] = float(len(granules) - rank)
-        return self.executor.render_bands_byte(
-            granules, ns_ids, prio, req.dst_gt(), req.crs,
-            req.height, req.width, len(ns_names), out_sel, req.resample,
-            offset, scale, clip, colour_scale, auto)
+        granules, ns_index, out_sel = made
+        return self._rgba_try(req, granules, ns_index, out_sel, offset,
+                              scale, clip, colour_scale, auto)
+
+    def render_rgb_auto(self, req: GeoTileRequest,
+                        offset: float = 0.0, scale: float = 0.0,
+                        clip: float = 0.0, colour_scale: int = 0,
+                        auto: bool = True,
+                        stats: Optional[Dict[str, int]] = None):
+        """RGB fast-path ladder over ONE index pass: the channel-packed
+        RGBA kernel when the granule set fits it, else the per-band
+        planes kernel.  Returns ("rgba", dev (H,W,4)) /
+        ("planes", dev (3,H,W)) / None."""
+        made = self._bands_prep(req, n_bands=3, stats=stats)
+        if made is None:
+            return None
+        granules, ns_index, out_sel = made
+        out = self._rgba_try(req, granules, ns_index, out_sel, offset,
+                             scale, clip, colour_scale, auto)
+        if out is not None:
+            return ("rgba", out)
+        out = self._bands_dispatch(req, granules, ns_index, out_sel,
+                                   offset, scale, clip, colour_scale,
+                                   auto)
+        return None if out is None else ("planes", out)
 
     def process(self, req: GeoTileRequest) -> TileResult:
         granules = self.index(req)

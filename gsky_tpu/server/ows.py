@@ -40,7 +40,8 @@ from ..index.client import MASClient
 from ..index.store import fmt_time, parse_time
 from ..io.geotiff import GeoTIFF, write_geotiff
 from ..io.netcdf import write_netcdf3
-from ..io.png import empty_tile_png, encode_jpeg, encode_png
+from ..io.png import (empty_tile_png, encode_jpeg, encode_png,
+                      encode_rgba_png)
 from ..ops.palette import gradient_palette, with_nodata_entry
 from ..ops.raster import DTYPE_NP
 from ..ops.scale import scale_params_auto, scale_to_byte
@@ -318,6 +319,14 @@ class OWSServer:
                                       style.clip_value,
                                       style.colour_scale, auto, stats),
                     timeout=lay.wms_timeout)
+            elif n_exprs == 3:
+                # channel-packed single-scene RGB kernel first (indices
+                # computed once for all bands, one RGBA pull), then the
+                # general per-band path
+                sb = await asyncio.wait_for(
+                    asyncio.to_thread(self._render_rgb, pipe, req, style,
+                                      auto, stats),
+                    timeout=lay.wms_timeout)
             else:
                 sb = await asyncio.wait_for(
                     asyncio.to_thread(pipe.render_bands_byte, req,
@@ -328,8 +337,18 @@ class OWSServer:
                     timeout=lay.wms_timeout)
             if sb is not None:
                 td = time.time()
-                arr = np.asarray(sb)  # the one device pull
-                scaled = [arr] if arr.ndim == 2 else list(arr)
+                rgba = None
+                if isinstance(sb, tuple):   # tagged RGB-ladder result
+                    kind, dev = sb
+                    arr = np.asarray(dev)   # the one device pull
+                    if kind == "rgba":
+                        rgba = arr          # (H, W, 4)
+                        scaled = [arr[..., 0], arr[..., 1], arr[..., 2]]
+                    else:                   # "planes": (3, H, W)
+                        scaled = list(arr)
+                else:
+                    arr = np.asarray(sb)  # the one device pull
+                    scaled = [arr] if arr.ndim == 2 else list(arr)
                 collector.info["device"]["duration"] = \
                     int((time.time() - td) * 1e9)
                 collector.info["device"]["platform"] = _jax_platform()
@@ -337,6 +356,12 @@ class OWSServer:
                     stats.get("granules", 0)
                 collector.info["indexer"]["num_files"] = \
                     stats.get("files", 0)
+                if rgba is not None and \
+                        p.format.lower() not in ("image/jpeg",
+                                                 "image/jpg"):
+                    collector.info["rpc"]["duration"] = \
+                        int((time.time() - t0) * 1e9)
+                    return _png(encode_rgba_png(rgba))
         if scaled is None:
             res = await asyncio.wait_for(
                 asyncio.to_thread(_render_with_fusion, pipe, req, lay,
@@ -369,6 +394,15 @@ class OWSServer:
             palette = with_nodata_entry(
                 gradient_palette(spec.colours, spec.interpolate))
         return _png(encode_png(scaled, palette))
+
+    @staticmethod
+    def _render_rgb(pipe, req, style, auto: bool, stats):
+        """RGB fast-path ladder (one index pass): channel-packed RGBA
+        kernel, then the per-band planes kernel.  Returns
+        ("rgba", dev (H,W,4)) / ("planes", dev (3,H,W)) / None."""
+        return pipe.render_rgb_auto(req, style.offset_value,
+                                    style.scale_value, style.clip_value,
+                                    style.colour_scale, auto, stats)
 
     async def _feature_info(self, cfg: Config, p):
         if not p.layers:

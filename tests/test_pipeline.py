@@ -230,6 +230,8 @@ class TestDrill:
             geometry_wkt=self.WKT, start_time=t(9), end_time=t(13),
             approx=False, deciles=3)
         dp = DrillPipeline(mas)
+        dp.process(req)                        # primes the async upload
+        assert default_drill_cache.wait_idle(60)
         res_dev = dp.process(req)              # cached-stack path
         # guard against a vacuous pass: the fixture's stack must be
         # device-resident (earlier tests may have already cached it)
@@ -260,6 +262,8 @@ class TestDrill:
             geometry_wkt=wkt, start_time=t(9), end_time=t(13),
             approx=False)
         dp = DrillPipeline(mas)
+        dp.process(req)                        # primes the async upload
+        assert default_drill_cache.wait_idle(60)
         res_dev = dp.process(req)
         assert default_drill_cache._order  # device path engaged
         monkeypatch.setenv("GSKY_DRILL_CACHE", "0")
@@ -270,6 +274,26 @@ class TestDrill:
             np.testing.assert_allclose(
                 res_dev.values[ns], res_host.values[ns], rtol=1e-6)
             assert res_dev.counts[ns] == res_host.counts[ns]
+
+    def test_drill_stack_cache_async_miss_then_hit(self, archive):
+        """get_async: first call misses (returns None, schedules a
+        background upload); after wait_idle the stack is resident."""
+        from gsky_tpu.pipeline.drill_cache import DrillStackCache
+
+        nc = None
+        for fn in os.listdir(archive["root"]):
+            if fn.endswith(".nc"):
+                nc = os.path.join(archive["root"], fn)
+                break
+        assert nc
+        cache = DrillStackCache()
+        assert cache.get_async(nc, True, "phot_veg", 1, None) is None
+        assert cache.wait_idle(30)
+        hit = cache.get_async(nc, True, "phot_veg", 1, None)
+        assert hit is not None and hit.shape[0] >= 1
+        assert cache.hits == 1 and cache.misses == 1
+        cache.clear()
+        assert cache.get_async(nc, True, "phot_veg", 1, None) is None
 
     def test_drill_stack_cache_reuse_and_eviction(self, archive):
         from gsky_tpu.pipeline.drill_cache import DrillStackCache
@@ -436,6 +460,119 @@ class TestFusedBandsRender:
             bands=["total = phot_veg + bare_soil"],
             bbox=TILE_BBOX, crs=EPSG3857, width=64, height=64)
         assert pipe.render_bands_byte(req) is None
+
+
+class TestPackedRgbRender:
+    @pytest.fixture(scope="class")
+    def rgb_archive(self, tmp_path_factory):
+        """One 3-band RGB GeoTIFF, crawler-indexed (the Sentinel-2
+        true-colour shape)."""
+        from gsky_tpu.index import MASStore
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io import write_geotiff
+
+        root = str(tmp_path_factory.mktemp("rgb"))
+        utm = parse_crs("EPSG:32755")
+        rng = np.random.default_rng(11)
+        gt = GeoTransform(590000.0, 30.0, 0.0, 6105000.0, 0.0, -30.0)
+        rgb = rng.uniform(200, 3000, (3, 512, 512)).astype(np.int16)
+        rgb[:, :64, :64] = -999
+        p = os.path.join(root, "S2_20200110_T1.tif")
+        write_geotiff(p, rgb, gt, utm, nodata=-999)
+        store = MASStore()
+        rec = extract(p)
+        assert not rec.get("error"), rec
+        store.ingest(rec)
+        return {"store": store, "root": root, "utm": utm}
+
+    def _req(self, rgb_archive, resample, order=(1, 2, 3)):
+        utm = rgb_archive["utm"]
+        core = BBox(592000.0, 6098000.0, 598000.0, 6102000.0)
+        merc = transform_bbox(transform_bbox(core, utm, EPSG4326),
+                              EPSG4326, EPSG3857)
+        return GeoTileRequest(
+            collection=rgb_archive["root"],
+            bands=[f"S2_20200110_T1_b{k}" for k in order],
+            bbox=merc, crs=EPSG3857, width=128, height=128,
+            start_time=t(9), end_time=t(11), resample=resample)
+
+    @pytest.mark.parametrize("resample", ["near", "bilinear", "cubic"])
+    def test_matches_per_band_path(self, rgb_archive, resample):
+        """The channel-packed RGBA kernel must byte-match the per-band
+        fused path plus the host interleave/alpha rules of encode_png."""
+        pipe = TilePipeline(MASClient(rgb_archive["store"]))
+        req = self._req(rgb_archive, resample)
+        rgba = pipe.render_rgba_byte(req, auto=True)
+        assert rgba is not None
+        rgba = np.asarray(rgba)
+        assert rgba.shape == (128, 128, 4)
+
+        planes = np.asarray(pipe.render_bands_byte(req, auto=True))
+        for i in range(3):
+            if resample == "near":
+                np.testing.assert_array_equal(rgba[..., i], planes[i])
+            else:
+                # interpolated taps: the two XLA programs reassociate
+                # f32 sums differently; allow rare one-level flips
+                mism = rgba[..., i].astype(int) - planes[i].astype(int)
+                frac = np.mean(mism != 0)
+                assert frac < 0.005, f"band {i}: {frac:.2%} differ"
+                assert np.abs(mism[mism != 0]).max() <= 1
+        # alpha rule self-consistency: 0 exactly where all three
+        # channels carry the nodata byte
+        nodata = np.all(rgba[..., :3] == 255, axis=-1)
+        np.testing.assert_array_equal(rgba[..., 3],
+                                      np.where(nodata, 0, 255))
+
+    def test_band_order_respected(self, rgb_archive):
+        """Expression order (B, G, R) must permute channels."""
+        pipe = TilePipeline(MASClient(rgb_archive["store"]))
+        fwd = np.asarray(pipe.render_rgba_byte(
+            self._req(rgb_archive, "near"), auto=True))
+        rev = np.asarray(pipe.render_rgba_byte(
+            self._req(rgb_archive, "near", order=(3, 2, 1)), auto=True))
+        np.testing.assert_array_equal(fwd[..., 0], rev[..., 2])
+        np.testing.assert_array_equal(fwd[..., 2], rev[..., 0])
+
+    def test_multi_granule_falls_back(self, tmp_path):
+        """Granule sets beyond the single-scene shape must decline the
+        packed path — and the ladder must land them on the per-band
+        planes kernel in the same index pass."""
+        from gsky_tpu.index import MASStore
+        from gsky_tpu.index.crawler import extract
+        from gsky_tpu.io.netcdf import write_netcdf3
+
+        root = str(tmp_path)
+        rng = np.random.default_rng(12)
+        H = W = 96
+        xs = 148.0 + (np.arange(W) + 0.5) * 0.002
+        ys = -35.0 - (np.arange(H) + 0.5) * 0.002
+        times = np.asarray([t(10), t(12)])
+        p = os.path.join(root, "rgb_stack.nc")
+        write_netcdf3(
+            p, {v: rng.uniform(0, 1, (2, H, W)).astype(np.float32)
+                for v in ("red", "green", "blue")},
+            xs, ys, EPSG4326, times, nodata=-9.0)
+        store = MASStore()
+        store.ingest(extract(p))
+        pipe = TilePipeline(MASClient(store))
+        merc = transform_bbox(BBox(148.02, -35.15, 148.15, -35.02),
+                              EPSG4326, EPSG3857)
+        req = GeoTileRequest(
+            collection=root, bands=["red", "green", "blue"],
+            bbox=merc, crs=EPSG3857, width=64, height=64,
+            start_time=t(9), end_time=t(13))
+        # six granules (two timestamps x three vars) in the window
+        assert pipe.render_rgba_byte(req) is None
+        made = pipe.render_rgb_auto(req, auto=True)
+        assert made is not None and made[0] == "planes"
+        assert np.asarray(made[1]).shape == (3, 64, 64)
+
+    def test_ladder_picks_rgba(self, rgb_archive):
+        made = TilePipeline(MASClient(rgb_archive["store"])) \
+            .render_rgb_auto(self._req(rgb_archive, "near"), auto=True)
+        assert made is not None and made[0] == "rgba"
+        assert np.asarray(made[1]).shape == (128, 128, 4)
 
 
 class TestTimeSplitter:
