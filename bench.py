@@ -246,10 +246,8 @@ def bench_cfg1_single_nearest(store, utm, tmp):
 def bench_cfg2_rgb_bilinear(tmp_rgb):
     """Config 2: 3-band RGB composite, bilinear."""
     from gsky_tpu.index import MASClient
-    from gsky_tpu.io.png import encode_png
+    from gsky_tpu.io.png import encode_png, encode_rgba_png
     from gsky_tpu.pipeline import TilePipeline
-
-    from gsky_tpu.io.png import encode_rgba_png
 
     store, utm, _ = build_rgb_archive(tmp_rgb)
     pipe = TilePipeline(MASClient(store))

@@ -68,6 +68,12 @@ def ensure_platform(retries: int = 2, timeout_s: float = 60.0,
     import jax
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
+        # GSKY_CPU_DEVICES=N: virtual CPU mesh for the SPMD path
+        # (GSKY_SPMD=1) in server processes — the container's
+        # sitecustomize swallows XLA_FLAGS, so the knob lives here
+        n = os.environ.get("GSKY_CPU_DEVICES", "")
+        if n.isdigit() and int(n) > 1:
+            jax.config.update("jax_num_cpu_devices", int(n))
         platform = "cpu"
         fallback = want != "cpu" and attempts > 0
     else:

@@ -99,6 +99,10 @@ def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
             cnt = _PROVEN.get((name, sync_token), 0)
             if cnt % _RESYNC == 0:
                 r = jax.block_until_ready(r)
+            # bounded: WMS/WCS request sizes are arbitrary, so a
+            # long-lived server would otherwise grow this forever
+            while len(_PROVEN) >= 4096:
+                _PROVEN.pop(next(iter(_PROVEN)))
             _PROVEN[(name, sync_token)] = cnt + 1
         return r
     except Exception as e:  # noqa: BLE001 - any compile/runtime failure

@@ -214,16 +214,20 @@ def warp_gather_batch(src, valid, rows, cols, method: str = "near"):
         src, valid, rows, cols)
 
 
-def _bilerp_grid(ctrl, h: int, w: int, step: int):
+def _bilerp_grid(ctrl, h: int, w: int, step: int, x0=0):
     """Upsample a control-point grid (gh, gw) to full (h, w) dst
     resolution — the on-device analogue of GDAL's approx transformer
     (`worker/gdalprocess/warp.go:219` uses err 0.125 px): the host
     projects only every ``step``-th pixel centre; the dense grid is
     bilinear interpolation, whose error over a few-hundred-metre block is
-    far below a pixel for any smooth projection."""
+    far below a pixel for any smooth projection.
+
+    ``x0``: global x of this grid's first column — the SPMD render
+    shards the output width, and each shard reconstructs only its strip
+    of the dense grid from the (replicated, tiny) ctrl points."""
     gh, gw = ctrl.shape
     yy = jnp.arange(h, dtype=jnp.float32)[:, None] / step
-    xx = jnp.arange(w, dtype=jnp.float32)[None, :] / step
+    xx = (x0 + jnp.arange(w, dtype=jnp.float32)[None, :]) / step
     y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, gh - 2)
     x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, gw - 2)
     ty = yy - y0

@@ -11,6 +11,8 @@ auto min-max scaling, and drill reductions.
 from .mesh import make_mesh
 from .render import (make_sharded_drill, make_sharded_render,
                      make_sharded_render_padded)
+from .spmd import SpmdRenderer, default_spmd, spmd_enabled
 
 __all__ = ["make_mesh", "make_sharded_render",
-           "make_sharded_render_padded", "make_sharded_drill"]
+           "make_sharded_render_padded", "make_sharded_drill",
+           "SpmdRenderer", "default_spmd", "spmd_enabled"]

@@ -552,6 +552,16 @@ def _stats_tail(dataf, validf, req: GeoDrillRequest):
     inputs reduce in numpy, see `_stats_host`)."""
     if isinstance(dataf, np.ndarray):
         return _stats_host(dataf, validf, req)
+    from ..parallel.spmd import default_spmd
+    spmd = default_spmd()
+    if spmd is not None and not req.deciles:
+        # mesh path: bands over `granule`, pixels over `x` + psum
+        # (deciles need a global sort — those requests stay single-
+        # device)
+        v, c = spmd.masked_stats(dataf, validf, req.clip_lower,
+                                 req.clip_upper, req.pixel_count)
+        return (np.asarray(v), np.asarray(c),
+                np.zeros((dataf.shape[0], 0), np.float32))
     from ..ops.pallas_tpu import masked_stats_pallas, run_with_fallback
 
     def _via_pallas():

@@ -24,6 +24,7 @@ from ..geo.transform import GeoTransform
 from ..ops.warp import (combine_scored, render_scenes_bands_ctrl,
                         render_scenes_ctrl, warp_gather_batch,
                         warp_scenes_ctrl, warp_scenes_ctrl_scored)
+from ..parallel.spmd import default_spmd
 from .decode import DecodedWindow
 
 # padded source-window shape buckets (H and W independently bucketed)
@@ -350,6 +351,15 @@ class WarpExecutor:
         n_pad = _bucket_pow2(n_ns)
         if len(groups) == 1:
             stack, _, params, step, _, ctrl_dev = groups[0]
+            spmd = default_spmd()
+            if spmd is not None:
+                # mesh path (GSKY_SPMD=1): granule axis over `granule`,
+                # width over `x` — the production fused mosaic on
+                # 1..N chips (SURVEY §2.8 P5/P6 on ICI)
+                canv, best = spmd.mosaic_scored(
+                    stack, ctrl_dev, params, method, n_pad,
+                    (height, width), step)
+                return canv, best > -jnp.inf
             return warp_scenes_ctrl(stack, ctrl_dev,
                                     jnp.asarray(params), method,
                                     n_pad, (height, width), step)
@@ -384,6 +394,10 @@ class WarpExecutor:
         sp = np.array([offset, scale, clip], np.float32)
         statics = (method, _bucket_pow2(n_ns), (height, width), step,
                    auto, colour_scale)
+        spmd = default_spmd()
+        if spmd is not None:
+            return _prefetch(spmd.render_composite(
+                stack, ctrl_dev, params, sp, *statics))
         from .batcher import batching_enabled
         if batching_enabled():
             # scene-serial key (not id()): address reuse after eviction

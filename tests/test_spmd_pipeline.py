@@ -1,0 +1,139 @@
+"""SPMD production-path tests (VERDICT r4 #4): the REAL pipeline —
+fixture archive -> MAS query -> scene cache -> fused render — executed
+over the 8-virtual-device CPU mesh (`GSKY_SPMD=1`), asserting
+bit-identity with the single-device result; same for the WCS-path
+mosaic carrier and the drill reductions."""
+
+import datetime as dt
+import os
+
+import numpy as np
+import pytest
+
+from gsky_tpu.geo.crs import EPSG3857, EPSG4326, parse_crs
+from gsky_tpu.geo.transform import BBox, transform_bbox
+from gsky_tpu.index import MASClient
+from gsky_tpu.pipeline import (DrillPipeline, GeoDrillRequest,
+                               GeoTileRequest, TilePipeline)
+from gsky_tpu.pipeline.executor import WarpExecutor
+
+from fixtures import make_archive
+
+TILE_BBOX = transform_bbox(BBox(148.02, -35.32, 148.12, -35.22),
+                           EPSG4326, EPSG3857)
+
+
+def t(day: int) -> float:
+    return dt.datetime(2020, 1, day, tzinfo=dt.timezone.utc).timestamp()
+
+
+@pytest.fixture(scope="module")
+def archive(tmp_path_factory):
+    return make_archive(str(tmp_path_factory.mktemp("spmd_arch")))
+
+
+@pytest.fixture()
+def spmd_on(monkeypatch):
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh (conftest)")
+    monkeypatch.setenv("GSKY_SPMD", "1")
+
+
+def _tile_req(archive, w=96, h=96):
+    return GeoTileRequest(
+        collection=archive["root"], bands=["phot_veg"],
+        bbox=TILE_BBOX, crs=EPSG3857, width=w, height=h,
+        start_time=t(9), end_time=t(13))
+
+
+class TestSpmdRender:
+    def test_composite_matches_single_device(self, archive, spmd_on,
+                                             monkeypatch):
+        """Full-pipeline GetMap byte tile: mesh result == single-device
+        result.  Winner selection and min-max extrema are EXACT (unique
+        priorities, exact min/max); the only permitted deviation is XLA
+        fusing the affine coordinate math differently between the two
+        programs (FMA contraction), which can flip a floor() at a pixel
+        boundary — bounded here at 0.1% of pixels."""
+        mas = MASClient(archive["store"])
+        out_s = TilePipeline(mas, executor=WarpExecutor()) \
+            .render_composite_byte(_tile_req(archive), auto=True)
+        assert out_s is not None
+        out_s = np.asarray(out_s)
+
+        monkeypatch.setenv("GSKY_SPMD", "0")
+        out_1 = TilePipeline(mas, executor=WarpExecutor()) \
+            .render_composite_byte(_tile_req(archive), auto=True)
+        assert out_1 is not None
+        mism = np.mean(out_s != np.asarray(out_1))
+        assert mism <= 0.001, f"{mism:.3%} bytes differ"
+
+    def test_composite_nondivisible_width(self, archive, spmd_on,
+                                          monkeypatch):
+        """Width 97 does not divide the x axis: the padded strip must
+        neither corrupt pixels nor perturb the auto min-max."""
+        mas = MASClient(archive["store"])
+        req = _tile_req(archive, w=97, h=64)
+        out_s = np.asarray(TilePipeline(mas, executor=WarpExecutor())
+                           .render_composite_byte(req, auto=True))
+        assert out_s.shape == (64, 97)
+        monkeypatch.setenv("GSKY_SPMD", "0")
+        out_1 = np.asarray(TilePipeline(mas, executor=WarpExecutor())
+                           .render_composite_byte(req, auto=True))
+        mism = np.mean(out_s != out_1)
+        assert mism <= 0.001, f"{mism:.3%} bytes differ"
+
+    def test_process_path_mosaic(self, archive, spmd_on, monkeypatch):
+        """The modular/WCS path (process() -> TileResult) through the
+        sharded scored mosaic == single-device canvases."""
+        mas = MASClient(archive["store"])
+        req = _tile_req(archive)
+        res_s = TilePipeline(mas, executor=WarpExecutor()).process(req)
+        monkeypatch.setenv("GSKY_SPMD", "0")
+        res_1 = TilePipeline(mas, executor=WarpExecutor()).process(req)
+        for ns in res_1.namespaces:
+            vm = np.mean(np.asarray(res_s.valid[ns])
+                         != np.asarray(res_1.valid[ns]))
+            assert vm <= 0.001, f"{ns}: {vm:.3%} validity differs"
+            ok = np.asarray(res_1.valid[ns]) \
+                & np.asarray(res_s.valid[ns])
+            a = np.asarray(res_s.data[ns])[ok]
+            b = np.asarray(res_1.data[ns])[ok]
+            # FMA-contraction boundary flips pick the adjacent source
+            # pixel; everything else matches exactly
+            close = np.isclose(a, b, rtol=1e-6)
+            assert np.mean(~close) <= 0.001
+
+
+class TestSpmdDrill:
+    WKT = ("POLYGON((148.03 -35.31,148.11 -35.31,148.11 -35.23,"
+           "148.03 -35.23,148.03 -35.31))")
+
+    def test_drill_means_match(self, archive, spmd_on, monkeypatch):
+        """Device-resident drill through the sharded psum reductions:
+        counts exact, means to f32 reassociation."""
+        from gsky_tpu.pipeline.drill_cache import default_drill_cache
+
+        monkeypatch.setenv("GSKY_DRILL_CACHE", "sync")
+        mas = MASClient(archive["store"])
+        req = GeoDrillRequest(
+            collection=archive["root"], bands=["phot_veg"],
+            geometry_wkt=self.WKT, start_time=t(9), end_time=t(13),
+            approx=False)
+        dp = DrillPipeline(mas)
+        res_s = dp.process(req)
+        assert res_s.dates
+        monkeypatch.setenv("GSKY_SPMD", "0")
+        res_1 = dp.process(req)
+        assert res_s.dates == res_1.dates
+        for ns in res_1.values:
+            assert res_s.counts[ns] == res_1.counts[ns]
+            np.testing.assert_allclose(res_s.values[ns],
+                                       res_1.values[ns], rtol=1e-5)
+
+
+def test_spmd_disabled_by_default():
+    from gsky_tpu.parallel.spmd import default_spmd
+    assert os.environ.get("GSKY_SPMD", "0") != "1"
+    assert default_spmd() is None
