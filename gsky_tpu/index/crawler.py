@@ -116,6 +116,87 @@ def extract_geotiff(path: str, namespace: Optional[str] = None,
     return {"filename": path, "file_type": "GeoTIFF", "geo_metadata": geo_md}
 
 
+def extract_gmt(path: str, approx_stats: bool = False) -> Dict:
+    """MAS record for a GMT grid (`gmtdataset.cpp:226-404` role): one
+    band, geographic by GMT convention (rulesets may override srs)."""
+    from ..geo.crs import EPSG4326
+    from ..io.gmt import GMTGrid
+
+    with GMTGrid(path) as g:
+        stem = sanitize_namespace(
+            os.path.splitext(os.path.basename(path))[0])
+        ts = timestamp_from_filename(path)
+        ds = {
+            # the GMT: prefix keeps .nc/.grd-named GMT grids off the
+            # NetCDF decode path (granule routing keys on ds_name)
+            "ds_name": f'GMT:"{path}"',
+            "namespace": stem,
+            "array_type": NP_TO_GDAL.get(np.dtype(g.dtype), "Float32"),
+            "proj_wkt": EPSG4326.to_wkt(),
+            "proj4": EPSG4326.to_proj4(),
+            "geotransform": list(g.gt.to_gdal()),
+            "x_size": g.width,
+            "y_size": g.height,
+            "polygon": _polygon_wkt(g.gt, g.width, g.height),
+            "timestamps": [ts] if ts else [],
+            "timestamps_source": "filename" if ts else "",
+            # GMT holes are NaN, which nodata_mask's finite check
+            # already rejects; recording NaN here would round-trip the
+            # store as NULL->0.0 and mask real zero-valued pixels
+            "nodata": None,
+            "band": 1,
+            "overviews": None,
+        }
+        if approx_stats:
+            ds.update(_approx_stats(g.read(1), g.nodata))
+    return {"filename": path, "file_type": "GMT", "geo_metadata": [ds]}
+
+
+def extract_raster(path: str, approx_stats: bool = False) -> Dict:
+    """MAS record via the format registry's adapter tier (JP2, PNG,
+    HDF4-via-GDAL, ... — whatever `io.registry` resolves): the
+    `GDALOpen`-for-everything-else role of `warp.go:89-101`.
+    Georeferencing comes from the handle (world file / driver); srs
+    defaults to EPSG:4326 and rulesets override per product."""
+    from ..geo.crs import EPSG4326
+    from ..io.registry import open_raster
+
+    h = open_raster(path)
+    try:
+        stem = sanitize_namespace(
+            os.path.splitext(os.path.basename(path))[0])
+        ts = timestamp_from_filename(path)
+        gt = getattr(h, "gt", None) or GeoTransform(0, 1, 0, 0, 0, 1)
+        crs = getattr(h, "crs", None) or EPSG4326
+        count = getattr(h, "bands", 1)
+        geo_md = []
+        for b in range(1, count + 1):
+            ns = stem if count == 1 else f"{stem}_b{b}"
+            ds = {
+                "ds_name": f"{path}:{b}" if count > 1 else path,
+                "namespace": ns,
+                "array_type": "Float32",
+                "proj_wkt": crs.to_wkt(),
+                "proj4": crs.to_proj4(),
+                "geotransform": list(gt.to_gdal()),
+                "x_size": h.width,
+                "y_size": h.height,
+                "polygon": _polygon_wkt(gt, h.width, h.height),
+                "timestamps": [ts] if ts else [],
+                "timestamps_source": "filename" if ts else "",
+                "nodata": h.nodata,
+                "band": b,
+                "overviews": None,
+            }
+            if approx_stats:
+                ds.update(_approx_stats(h.read(b), h.nodata))
+            geo_md.append(ds)
+    finally:
+        h.close()
+    return {"filename": path, "file_type": "Raster",
+            "geo_metadata": geo_md}
+
+
 def extract_netcdf(path: str, approx_stats: bool = False) -> Dict:
     with NetCDF(path) as nc:
         # curvilinear products carry 2-D lon/lat geolocation arrays
@@ -332,9 +413,23 @@ def extract(path: str, approx_stats: bool = False,
     (`crawl/extractor/ruleset.go`)."""
     path = os.path.abspath(path)  # MAS scopes queries by path prefix
     low = path.lower()
+
+    def _nc_or_gmt():
+        # GMT grids share the CDF magic; the variable layout decides.
+        # Non-NetCDF files wearing these extensions (e.g. Surfer .grd)
+        # fall through to the adapter tier instead of a NetCDF error
+        with open(path, "rb") as fp:
+            m = fp.read(8)
+        if m[:3] != b"CDF" and m[:8] != b"\x89HDF\r\n\x1a\n":
+            return extract_raster(path, approx_stats=approx_stats)
+        from ..io.gmt import is_gmt
+        if is_gmt(path):
+            return extract_gmt(path, approx_stats)
+        return extract_netcdf(path, approx_stats)
+
     try:
-        if low.endswith((".nc", ".nc4", ".cdf")):
-            rec = extract_netcdf(path, approx_stats)
+        if low.endswith((".nc", ".nc4", ".cdf", ".grd")):
+            rec = _nc_or_gmt()
         elif low.endswith((".tif", ".tiff", ".gtiff")):
             rec = extract_geotiff(path, approx_stats=approx_stats)
         else:
@@ -342,9 +437,13 @@ def extract(path: str, approx_stats: bool = False,
             with open(path, "rb") as fp:
                 magic = fp.read(8)
             if magic[:3] == b"CDF" or magic[:8] == b"\x89HDF\r\n\x1a\n":
-                rec = extract_netcdf(path, approx_stats)
-            else:
+                rec = _nc_or_gmt()
+            elif magic[:4] in (b"II*\0", b"MM\0*", b"II+\0", b"MM\0+"):
                 rec = extract_geotiff(path, approx_stats=approx_stats)
+            else:
+                # adapter tier: JP2 / PNG / whatever the registry
+                # resolves (GDALOpen-for-the-rest, `warp.go:89-101`)
+                rec = extract_raster(path, approx_stats=approx_stats)
     except Exception as e:
         return {"filename": path, "file_type": "", "error": str(e),
                 "geo_metadata": []}
@@ -407,7 +506,9 @@ def main(argv=None):
         if p == "-":
             paths += [line.strip() for line in sys.stdin if line.strip()]
         elif os.path.isdir(p):
-            exts = [".tif", ".tiff", ".nc", ".nc4"]
+            exts = [".tif", ".tiff", ".nc", ".nc4",
+                    # registry-served formats: GMT grids + adapter tier
+                    ".grd", ".jp2", ".j2k", ".png", ".jpg", ".jpeg"]
             if args.sentinel2_yaml or args.landsat_yaml:
                 exts += [".yaml", ".yml"]
             for root, _, files in os.walk(p):

@@ -615,48 +615,6 @@ def write_netcdf3(path: str, arrays: Dict[str, np.ndarray],
         dims.append(("time", len(times)))
     dims.append(("y", len(y)))
     dims.append(("x", len(x)))
-    dimid = {name: i for i, (name, _) in enumerate(dims)}
-
-    def name_pad(s: bytes) -> bytes:
-        return struct.pack(">I", len(s)) + s + b"\0" * ((4 - len(s) % 4) % 4)
-
-    def nc3_pack(arr: np.ndarray) -> Tuple[int, bytes, bool]:
-        """-> (nc_type, big-endian bytes, was_unsigned).  NetCDF-3 has no
-        unsigned types: u1/u2/u4 are bit-reinterpreted into the signed
-        type of the same width with the _Unsigned convention."""
-        k = np.dtype(arr.dtype).newbyteorder("=").str[1:]
-        if k in ("u1", "u2", "u4"):
-            typ = {"u1": 1, "u2": 3, "u4": 4}[k]
-            raw = arr.astype(f">u{arr.dtype.itemsize}").view(
-                _NC3_DTYPES[typ]).tobytes()
-            return typ, raw, True
-        if k == "i8":
-            if arr.size and (arr.max() > 2**31 - 1 or arr.min() < -2**31):
-                raise ValueError("int64 values exceed NetCDF-3 int range")
-            arr = arr.astype(np.int32)
-            k = "i4"
-        if k not in ("i1", "i2", "i4", "f4", "f8"):
-            raise ValueError(f"dtype {arr.dtype} not representable in "
-                             "NetCDF-3 classic")
-        typ = {"i1": 1, "i2": 3, "i4": 4, "f4": 5, "f8": 6}[k]
-        return typ, arr.astype(_NC3_DTYPES[typ]).tobytes(), False
-
-    def atts(d: Dict[str, object]) -> bytes:
-        if not d:
-            return struct.pack(">II", 0, 0)
-        out = struct.pack(">II", 0x0C, len(d))
-        for k, v in d.items():
-            out += name_pad(k.encode())
-            if isinstance(v, str):
-                raw = v.encode("latin-1")
-                out += struct.pack(">II", 2, len(raw)) + raw \
-                    + b"\0" * ((4 - len(raw) % 4) % 4)
-            else:
-                arr = np.atleast_1d(np.asarray(v))
-                typ, raw, _ = nc3_pack(arr)
-                out += struct.pack(">II", typ, len(arr)) + raw \
-                    + b"\0" * ((4 - len(raw) % 4) % 4)
-        return out
 
     # variable table entries: coordinate vars + data vars (all non-record)
     variables = []  # (name, dims, attrs, np_array)
@@ -685,21 +643,75 @@ def write_netcdf3(path: str, arrays: Dict[str, np.ndarray],
             else ("y", "x")
         variables.append((vname, vdims, va, arr))
 
-    # layout pass
+    write_netcdf3_raw(path, dims, variables,
+                      dict(global_attrs or {"Conventions": "CF-1.6"}))
+
+
+def _nc3_name_pad(s: bytes) -> bytes:
+    return struct.pack(">I", len(s)) + s + b"\0" * ((4 - len(s) % 4) % 4)
+
+
+def _nc3_pack(arr: np.ndarray) -> Tuple[int, bytes, bool]:
+    """-> (nc_type, big-endian bytes, was_unsigned).  NetCDF-3 has no
+    unsigned types: u1/u2/u4 are bit-reinterpreted into the signed
+    type of the same width with the _Unsigned convention."""
+    k = np.dtype(arr.dtype).newbyteorder("=").str[1:]
+    if k in ("u1", "u2", "u4"):
+        typ = {"u1": 1, "u2": 3, "u4": 4}[k]
+        raw = arr.astype(f">u{arr.dtype.itemsize}").view(
+            _NC3_DTYPES[typ]).tobytes()
+        return typ, raw, True
+    if k == "i8":
+        if arr.size and (arr.max() > 2**31 - 1 or arr.min() < -2**31):
+            raise ValueError("int64 values exceed NetCDF-3 int range")
+        arr = arr.astype(np.int32)
+        k = "i4"
+    if k not in ("i1", "i2", "i4", "f4", "f8"):
+        raise ValueError(f"dtype {arr.dtype} not representable in "
+                         "NetCDF-3 classic")
+    typ = {"i1": 1, "i2": 3, "i4": 4, "f4": 5, "f8": 6}[k]
+    return typ, arr.astype(_NC3_DTYPES[typ]).tobytes(), False
+
+
+def _nc3_atts(d: Dict[str, object]) -> bytes:
+    if not d:
+        return struct.pack(">II", 0, 0)
+    out = struct.pack(">II", 0x0C, len(d))
+    for k, v in d.items():
+        out += _nc3_name_pad(k.encode())
+        if isinstance(v, str):
+            raw = v.encode("latin-1")
+            out += struct.pack(">II", 2, len(raw)) + raw \
+                + b"\0" * ((4 - len(raw) % 4) % 4)
+        else:
+            arr = np.atleast_1d(np.asarray(v))
+            typ, raw, _ = _nc3_pack(arr)
+            out += struct.pack(">II", typ, len(arr)) + raw \
+                + b"\0" * ((4 - len(raw) % 4) % 4)
+    return out
+
+
+def write_netcdf3_raw(path: str, dims, variables, global_attrs):
+    """Low-level NetCDF-3 classic writer: ``dims`` is an ordered list
+    of (name, size); ``variables`` a list of (name, dim_names, attrs,
+    array) — the layout engine shared by the CF writer above and the
+    GMT grid writer (`io.gmt.write_gmt`), which needs non-CF dimension
+    names (side/xysize)."""
+    dimid = {name: i for i, (name, _) in enumerate(dims)}
     header = b"CDF\x01" + struct.pack(">I", 0)  # numrecs 0 (no record vars)
     header += struct.pack(">II", 0x0A, len(dims))
     for dname, dsize in dims:
-        header += name_pad(dname.encode()) + struct.pack(">I", dsize)
-    header += atts(dict(global_attrs or {"Conventions": "CF-1.6"}))
+        header += _nc3_name_pad(dname.encode()) + struct.pack(">I", dsize)
+    header += _nc3_atts(dict(global_attrs or {}))
 
     var_entries = []
     for vname, vdims, vattrs, arr in variables:
-        typ, raw, _ = nc3_pack(np.asarray(arr))
-        ent = name_pad(vname.encode())
+        typ, raw, _ = _nc3_pack(np.asarray(arr))
+        ent = _nc3_name_pad(vname.encode())
         ent += struct.pack(">I", len(vdims))
         for dn in vdims:
             ent += struct.pack(">I", dimid[dn])
-        ent += atts(vattrs)
+        ent += _nc3_atts(vattrs)
         vsize = len(raw) + ((4 - len(raw) % 4) % 4)
         ent += struct.pack(">II", typ, vsize)
         var_entries.append((ent, typ, vsize, raw))

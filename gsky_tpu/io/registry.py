@@ -1,0 +1,123 @@
+"""Pluggable raster-format registry.
+
+The reference warps ANY GDAL-openable dataset — `GDALOpen` + driver
+dispatch (`worker/gdalprocess/warp.go:89-101`).  The TPU-native stack
+keeps fast from-scratch readers for the hot formats (GeoTIFF, NetCDF-3,
+NetCDF-4/HDF5, GMT grids) and widens the format universe through this
+registry: each entry sniffs magic bytes (the GDALOpenInfo header test)
+and returns a handle with the uniform "tiff-like" interface the decode,
+scene-cache and drill paths consume —
+
+    .width .height .nodata .overviews
+    .read(band, (col0, row0, w, h)) -> np.ndarray
+    .close()
+
+plus optionally .gt (GeoTransform) and .crs for the crawler.
+
+Resolution order: native readers first (fast paths), then optional
+adapters — rasterio or GDAL when importable in the deployment image,
+else the PIL image adapter (JPEG2000/PNG/JPEG/BMP + ESRI world-file
+georeferencing).  Register custom formats with `register()`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Tuple
+
+_Entry = Tuple[str, Callable[[str, bytes], bool], Callable[[str], object]]
+
+_lock = threading.Lock()
+_formats: List[_Entry] = []
+
+
+def register(name: str, sniff: Callable[[str, bytes], bool],
+             opener: Callable[[str], object],
+             prepend: bool = False) -> None:
+    """Add a format: ``sniff(path, magic16)`` decides cheaply,
+    ``opener(path)`` returns a tiff-like handle."""
+    with _lock:
+        if prepend:
+            _formats.insert(0, (name, sniff, opener))
+        else:
+            _formats.append((name, sniff, opener))
+
+
+def formats() -> List[str]:
+    with _lock:
+        return [name for name, _, _ in _formats]
+
+
+def open_raster(path: str):
+    """Open ``path`` with the first matching format.  Raises ValueError
+    listing the sniffed magic when nothing claims the file."""
+    try:
+        with open(path, "rb") as fp:
+            magic = fp.read(16)
+    except OSError as e:
+        raise ValueError(f"cannot read {path}: {e}") from e
+    with _lock:
+        entries = list(_formats)
+    for name, sniff, opener in entries:
+        try:
+            claimed = sniff(path, magic)
+        except Exception:
+            claimed = False
+        if claimed:
+            return opener(path)
+    raise ValueError(
+        f"no registered reader for {path} (magic {magic[:8]!r}; "
+        f"formats: {formats()})")
+
+
+# -- built-in formats --------------------------------------------------------
+
+def _sniff_tiff(path: str, magic: bytes) -> bool:
+    return magic[:4] in (b"II*\0", b"MM\0*", b"II+\0", b"MM\0+")
+
+
+def _open_tiff(path: str):
+    from .geotiff import GeoTIFF
+    return GeoTIFF(path)
+
+
+def _sniff_gmt(path: str, magic: bytes) -> bool:
+    if magic[:3] != b"CDF":
+        return False
+    from .gmt import is_gmt
+    return is_gmt(path)
+
+
+def _open_gmt(path: str):
+    from .gmt import GMTGrid
+    return GMTGrid(path)
+
+
+register("geotiff", _sniff_tiff, _open_tiff)
+register("gmt", _sniff_gmt, _open_gmt)
+# NetCDF proper stays on the dedicated NetCDF facade (variables +
+# hyperslabs, not a flat band model) — decode/drill route it by
+# granule metadata before consulting the registry.
+
+
+def _register_adapters() -> None:
+    """Optional adapter tier, best first.  rasterio/GDAL are not in the
+    default image (gated imports); the PIL adapter always lands."""
+    try:
+        import rasterio  # noqa: F401
+        from .adapter import RasterioRaster, sniff_rasterio
+        register("rasterio", sniff_rasterio,
+                 lambda p: RasterioRaster(p))
+    except ImportError:
+        pass
+    try:
+        from osgeo import gdal  # noqa: F401
+        from .adapter import GdalRaster, sniff_gdal
+        register("gdal", sniff_gdal, lambda p: GdalRaster(p))
+    except ImportError:
+        pass
+    from .adapter import ImageRaster, sniff_image
+    register("pil-image", sniff_image, lambda p: ImageRaster(p))
+
+
+_register_adapters()

@@ -51,7 +51,11 @@ class _HandleCache:
             h = self._handles.get(path)
             if h is not None:
                 return h
-        h = NetCDF(path) if is_netcdf else GeoTIFF(path)
+        # non-NetCDF granules resolve through the format registry
+        # (GeoTIFF fast path, GMT grids, adapter tier) — the GDALOpen
+        # driver-dispatch role (`worker/gdalprocess/warp.go:89-101`)
+        from ..io.registry import open_raster
+        h = NetCDF(path) if is_netcdf else open_raster(path)
         with self._lock:
             if path in self._handles:
                 h.close()

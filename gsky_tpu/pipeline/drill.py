@@ -395,9 +395,9 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
     """Masked reductions for the selected bands of one file (or of a
     rendered VRT wrapping it, `drill.go:363-423`)."""
     is_vrt = bool(vrt_xml)
-    is_nc = not is_vrt and (
-        ds.file_path.lower().endswith((".nc", ".nc4"))
-        or ds.ds_name.upper().startswith("NETCDF:"))
+    is_nc = not is_vrt and not ds.ds_name.upper().startswith("GMT:") \
+        and (ds.file_path.lower().endswith((".nc", ".nc4"))
+             or ds.ds_name.upper().startswith("NETCDF:"))
     try:
         if is_vrt:
             from ..io.vrt import VRTRaster
@@ -409,7 +409,8 @@ def _drill_file(ds: Dataset, sel: List[int], g4326: geom.Geometry,
             v = h.variables[var]
             H, W = v.shape[-2], v.shape[-1]
         else:
-            h = GeoTIFF(ds.file_path)
+            from ..io.registry import open_raster
+            h = open_raster(ds.file_path)
             H, W = h.height, h.width
     except (OSError, ValueError, KeyError, ET.ParseError):
         return None

@@ -243,10 +243,15 @@ class DrillStackCache:
                         h.read_slice(var_name, t, (0, 0, W, H))
                         for t in range(T)])
             else:
-                from ..io.geotiff import T_BITS
                 W, H = h.width, h.height
-                bits = h.ifd.arr(T_BITS) or (32,)
-                itemsize = max(int(bits[0]) // 8, 1)
+                ifd = getattr(h, "ifd", None)
+                if ifd is not None:
+                    from ..io.geotiff import T_BITS
+                    bits = ifd.arr(T_BITS) or (32,)
+                    itemsize = max(int(bits[0]) // 8, 1)
+                else:       # registry handle (GMT/adapter)
+                    itemsize = np.dtype(
+                        getattr(h, "dtype", np.float32)).itemsize
                 if itemsize > 4:
                     return None, True
                 nd = nodata if nodata is not None else h.nodata

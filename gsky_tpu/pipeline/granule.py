@@ -46,8 +46,13 @@ def expand_granules(datasets: Sequence[Dataset],
     out: List[Granule] = []
     axsel = {a.name: a for a in axes}
     for ds in datasets:
-        is_nc = ds.ds_name.upper().startswith("NETCDF:") \
-            or ds.file_path.lower().endswith((".nc", ".nc4"))
+        up = ds.ds_name.upper()
+        # GMT grids share the .nc extension but are flat one-band
+        # rasters — they route through the registry, not the NetCDF
+        # variable model
+        is_nc = not up.startswith("GMT:") and (
+            up.startswith("NETCDF:")
+            or ds.file_path.lower().endswith((".nc", ".nc4")))
         var_name = ""
         if is_nc:
             var_name = ds.ds_name.split(":")[-1].strip('"')

@@ -171,7 +171,7 @@ class SceneCache:
             else:
                 W, H = h.width, h.height
                 ovr = None
-                if level > 1:
+                if level > 1 and getattr(h, "overviews", ()):
                     fx, fy, ovr = h.pick_overview(float(level))
                 if ovr is not None:
                     gt = gt.scaled(fx, fy)

@@ -1,0 +1,182 @@
+"""Format-universality tests (VERDICT r4 #5): the decoder registry, the
+native GMT grid reader, and the adapter tier (PIL JPEG2000 + world
+file) — each crawled and served END TO END through the tile pipeline,
+the way `GDALOpen` driver dispatch serves them in the reference
+(`worker/gdalprocess/warp.go:89-101`)."""
+
+import datetime as dt
+import os
+
+import numpy as np
+import pytest
+
+from gsky_tpu.geo.crs import EPSG3857, EPSG4326
+from gsky_tpu.geo.transform import BBox, GeoTransform, transform_bbox
+from gsky_tpu.index import MASClient, MASStore
+from gsky_tpu.index.crawler import extract
+from gsky_tpu.io.gmt import GMTGrid, is_gmt, write_gmt
+from gsky_tpu.io.registry import formats, open_raster
+from gsky_tpu.pipeline import GeoTileRequest, TilePipeline
+from gsky_tpu.pipeline.executor import WarpExecutor
+
+
+def t(day: int) -> float:
+    return dt.datetime(2020, 1, day, tzinfo=dt.timezone.utc).timestamp()
+
+
+class TestGMT:
+    def _grid(self, tmp_path, node_offset=1):
+        rng = np.random.default_rng(3)
+        H = W = 64
+        data = rng.uniform(0.0, 10.0, (H, W)).astype(np.float32)
+        data[0, 0] = np.nan                      # GMT hole
+        p = str(tmp_path / "relief_20200110.grd")
+        write_gmt(p, data, (148.0, 148.64), (-35.64, -35.0),
+                  node_offset=node_offset)
+        return p, data
+
+    def test_roundtrip_and_sniff(self, tmp_path):
+        p, data = self._grid(tmp_path)
+        assert is_gmt(p)
+        with GMTGrid(p) as g:
+            assert (g.width, g.height) == (64, 64)
+            # pixel registration: origin at x_range[0], y_range[1]
+            assert g.gt.x0 == pytest.approx(148.0)
+            assert g.gt.y0 == pytest.approx(-35.0)
+            assert g.gt.dx == pytest.approx(0.01)
+            assert g.gt.dy == pytest.approx(-0.01)
+            np.testing.assert_allclose(
+                g.read(1, (0, 0, 64, 64)), data, rtol=1e-6)
+            win = g.read(1, (8, 4, 16, 12))
+            np.testing.assert_allclose(win, data[4:16, 8:24], rtol=1e-6)
+
+    def test_gridline_registration(self, tmp_path):
+        p, _ = self._grid(tmp_path, node_offset=0)
+        with GMTGrid(p) as g:
+            # samples ON the range ends: origin shifts half a pixel out
+            dx = 0.64 / 63
+            assert g.gt.dx == pytest.approx(dx)
+            assert g.gt.x0 == pytest.approx(148.0 - dx / 2)
+
+    def test_registry_dispatch(self, tmp_path):
+        p, _ = self._grid(tmp_path)
+        h = open_raster(p)
+        assert isinstance(h, GMTGrid)
+        h.close()
+        assert "gmt" in formats() and "pil-image" in formats()
+
+    def test_served_e2e(self, tmp_path):
+        """crawl -> MAS -> GetMap over the GMT grid."""
+        p, data = self._grid(tmp_path)
+        rec = extract(p)
+        assert not rec.get("error"), rec
+        assert rec["file_type"] == "GMT"
+        store = MASStore()
+        store.ingest(rec)
+        pipe = TilePipeline(MASClient(store), executor=WarpExecutor())
+        merc = transform_bbox(BBox(148.1, -35.5, 148.5, -35.1),
+                              EPSG4326, EPSG3857)
+        req = GeoTileRequest(
+            collection=str(tmp_path), bands=["relief_20200110"],
+            bbox=merc, crs=EPSG3857, width=64, height=64,
+            start_time=t(9), end_time=t(11))
+        res = pipe.process(req)
+        ns = "relief_20200110"
+        assert ns in res.data
+        ok = np.asarray(res.valid[ns])
+        assert ok.mean() > 0.9
+        vals = np.asarray(res.data[ns])[ok]
+        assert 0.0 <= vals.min() and vals.max() <= 10.0
+        # the NaN hole (north-west corner) must be masked, not served
+        nw = transform_bbox(BBox(148.0, -35.02, 148.02, -35.0),
+                            EPSG4326, EPSG3857)
+        req2 = GeoTileRequest(
+            collection=str(tmp_path), bands=[ns], bbox=nw,
+            crs=EPSG3857, width=32, height=32,
+            start_time=t(9), end_time=t(11))
+        res2 = pipe.process(req2)
+        assert not np.asarray(res2.valid[ns]).all()
+
+
+class TestImageAdapter:
+    def _jp2(self, tmp_path):
+        from PIL import Image
+        rng = np.random.default_rng(9)
+        H = W = 64
+        data = rng.integers(0, 255, (H, W), dtype=np.uint8)
+        p = str(tmp_path / "S2_B04_20200110.jp2")
+        Image.fromarray(data, "L").save(p, "JPEG2000", quality_mode="dB",
+                                        quality_layers=[80])
+        # ESRI world file: 0.01-degree pixels anchored at 148/-35
+        with open(str(tmp_path / "S2_B04_20200110.j2w"), "w") as fp:
+            fp.write("0.01\n0.0\n0.0\n-0.01\n148.005\n-35.005\n")
+        return p, data
+
+    def test_open_and_window(self, tmp_path):
+        p, data = self._jp2(tmp_path)
+        h = open_raster(p)
+        assert (h.width, h.height) == (64, 64)
+        assert h.gt.x0 == pytest.approx(148.0)
+        assert h.gt.dy == pytest.approx(-0.01)
+        win = h.read(1, (8, 4, 16, 12))
+        assert win.shape == (12, 16)
+        h.close()
+
+    def test_served_e2e(self, tmp_path):
+        """crawl -> MAS -> GetMap over the Sentinel-2-style JP2."""
+        p, data = self._jp2(tmp_path)
+        rec = extract(p)
+        assert not rec.get("error"), rec
+        store = MASStore()
+        store.ingest(rec)
+        pipe = TilePipeline(MASClient(store), executor=WarpExecutor())
+        merc = transform_bbox(BBox(148.1, -35.5, 148.5, -35.1),
+                              EPSG4326, EPSG3857)
+        ns = "S2_B04_20200110"
+        req = GeoTileRequest(
+            collection=str(tmp_path), bands=[ns], bbox=merc,
+            crs=EPSG3857, width=64, height=64,
+            start_time=t(9), end_time=t(11))
+        res = pipe.process(req)
+        assert ns in res.data
+        ok = np.asarray(res.valid[ns])
+        assert ok.mean() > 0.9
+        # JPEG2000 at this quality is near-lossless; compare loosely
+        vals = np.asarray(res.data[ns])[ok]
+        assert 0 <= vals.min() and vals.max() <= 255
+
+
+class TestRegistryErrors:
+    def test_unknown_magic(self, tmp_path):
+        p = str(tmp_path / "mystery.bin")
+        with open(p, "wb") as fp:
+            fp.write(b"\x00\x01\x02\x03 not a raster")
+        with pytest.raises(ValueError, match="no registered reader"):
+            open_raster(p)
+
+    def test_custom_registration(self, tmp_path):
+        from gsky_tpu.io import registry
+
+        class Fake:
+            width = height = 1
+            nodata = None
+            overviews = ()
+
+            def read(self, band=1, window=None, ifd=None):
+                return np.zeros((1, 1), np.float32)
+
+            def close(self):
+                pass
+
+        registry.register("fake-fmt",
+                          lambda path, magic: magic[:4] == b"FAKE",
+                          lambda path: Fake())
+        try:
+            p = str(tmp_path / "x.fake")
+            with open(p, "wb") as fp:
+                fp.write(b"FAKE....")
+            assert isinstance(open_raster(p), Fake)
+        finally:
+            with registry._lock:
+                registry._formats[:] = [
+                    f for f in registry._formats if f[0] != "fake-fmt"]

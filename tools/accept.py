@@ -192,6 +192,18 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
          _np.arange(_gh, dtype=_np.float64), EPSG4326, nodata=-9999.0)
     store.ingest(_extract(os.path.join(swath_dir, "swath_20200110.nc")))
 
+    # a native GMT grid layer rides along too (the registry's GMT
+    # reader through the full HTTP server — `gmtdataset.cpp` role)
+    from gsky_tpu.io.gmt import write_gmt as _wgmt
+
+    gmt_dir = os.path.join(root, "gmt")
+    os.makedirs(gmt_dir)
+    _rng = _np.random.default_rng(6)
+    _wgmt(os.path.join(gmt_dir, "relief_20200110.grd"),
+          _rng.uniform(0, 100, (96, 96)).astype(_np.float32),
+          (148.0, 148.96), (-35.96, -35.0))
+    store.ingest(_extract(os.path.join(gmt_dir, "relief_20200110.grd")))
+
     conf_dir = os.path.join(root, "conf")
     os.makedirs(conf_dir)
     config = {
@@ -206,6 +218,11 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
             "name": "swath", "title": "curvilinear swath",
             "data_source": swath_dir,
             "rgb_products": ["bt"],
+            "time_generator": "mas",
+        }, {
+            "name": "relief", "title": "GMT grid relief",
+            "data_source": gmt_dir,
+            "rgb_products": ["relief_20200110"],
             "time_generator": "mas",
         }],
         "processes": [{
@@ -278,6 +295,24 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
             f"http://{host}/ows?service=WMS&request=GetMap&version=1.3.0"
             f"&layers=swath&crs=EPSG:4326"
             f"&bbox=-35.28,148.05,-35.17,148.2"
+            f"&width=128&height=128&format=image/png"
+            f"&time=2020-01-10T00:00:00.000Z")
+        ok = status == 200 and body[:8] == b"\x89PNG\r\n\x1a\n" \
+            and len(body) > 500
+    except Exception as e:  # noqa: BLE001
+        ok = False
+        print(f"error: {e} ", end="")
+    print("Passed" if ok else "Failed")
+    if not ok:
+        rc = 1
+
+    # one GMT-grid GetMap (registry-dispatched native GMT reader)
+    print("Testing WMS GetMap (GMT grid): ", end="", flush=True)
+    try:
+        status, body = _get(
+            f"http://{host}/ows?service=WMS&request=GetMap&version=1.3.0"
+            f"&layers=relief&crs=EPSG:4326"
+            f"&bbox=-35.8,148.1,-35.2,148.8"
             f"&width=128&height=128&format=image/png"
             f"&time=2020-01-10T00:00:00.000Z")
         ok = status == 200 and body[:8] == b"\x89PNG\r\n\x1a\n" \
