@@ -54,9 +54,89 @@ class ResponseCache:
                 self._entries.popitem(last=False)
 
 
+class SharedResponseCache:
+    """Cross-process response cache in a sqlite file — the role of the
+    memcached tier every reference masapi consumer shares
+    (`mas/api/api.go:43-52`): OWS-cluster nodes on one host (or a
+    shared filesystem) stop re-running identical index queries.  Sits
+    as an L2 behind the in-process LRU; keys carry the store
+    generation, so ingests invalidate here exactly as they do locally."""
+
+    # trim every Nth put, not every put: the full-table ORDER BY scan
+    # must not sit on every request's write path
+    _TRIM_EVERY = 64
+
+    def __init__(self, path: str, max_entries: int = 8192):
+        self.path = path
+        self.max_entries = max_entries
+        self._local = threading.local()
+        self.hits = 0
+        self.misses = 0
+        self._puts = 0
+        c = self._conn()
+        c.execute("CREATE TABLE IF NOT EXISTS cache("
+                  " k TEXT PRIMARY KEY, body TEXT, ts REAL)")
+        c.execute("CREATE INDEX IF NOT EXISTS idx_cache_ts"
+                  " ON cache(ts)")
+        c.commit()
+
+    def _conn(self):
+        import sqlite3
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = sqlite3.connect(self.path, timeout=5.0)
+            self._local.conn = c
+        return c
+
+    @staticmethod
+    def _k(key: tuple) -> str:
+        import hashlib
+        return hashlib.sha256(repr(key).encode()).hexdigest()
+
+    def get(self, key: tuple) -> Optional[str]:
+        try:
+            row = self._conn().execute(
+                "SELECT body FROM cache WHERE k = ?",
+                (self._k(key),)).fetchone()
+        except Exception:
+            return None         # a broken shared cache degrades silently
+        if row is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return row[0]
+
+    def put(self, key: tuple, body: str):
+        """Insert-time-ordered eviction (FIFO over the insert window,
+        not LRU — gets don't refresh ts, keeping reads write-free),
+        trimmed every `_TRIM_EVERY` puts via the ts index."""
+        import time
+        try:
+            c = self._conn()
+            c.execute("INSERT OR REPLACE INTO cache(k, body, ts)"
+                      " VALUES (?,?,?)", (self._k(key), body, time.time()))
+            self._puts += 1
+            if self._puts % self._TRIM_EVERY == 0:
+                c.execute(
+                    "DELETE FROM cache WHERE k IN ("
+                    " SELECT k FROM cache ORDER BY ts DESC"
+                    " LIMIT -1 OFFSET ?)",
+                    (self.max_entries + self._TRIM_EVERY,))
+            c.commit()
+        except Exception:
+            pass
+
+
 def build_app(store: MASStore,
-              cache: Optional[ResponseCache] = None) -> web.Application:
+              cache: Optional[ResponseCache] = None,
+              shared_cache: Optional[SharedResponseCache] = None
+              ) -> web.Application:
     cache = cache if cache is not None else ResponseCache()
+    if shared_cache is None:
+        import os
+        sp = os.environ.get("GSKY_MAS_SHARED_CACHE", "")
+        if sp:
+            shared_cache = SharedResponseCache(sp)
 
     async def handler(request: web.Request) -> web.Response:
         q = request.query
@@ -72,6 +152,11 @@ def build_app(store: MASStore,
         hit = cache.get(key)
         if hit is not None:
             return web.json_response(text=hit)
+        if shared_cache is not None:
+            hit = shared_cache.get(key)
+            if hit is not None:
+                cache.put(key, hit)     # promote into the local LRU
+                return web.json_response(text=hit)
         try:
             if "intersects" in q:
                 ns = val("namespace")
@@ -108,6 +193,8 @@ def build_app(store: MASStore,
             return web.json_response({"error": str(e)}, status=400)
         body = json.dumps(result)
         cache.put(key, body)
+        if shared_cache is not None:
+            shared_cache.put(key, body)
         return web.json_response(text=body)
 
     app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -130,6 +217,11 @@ def main(argv=None):
                          "shard per top-level directory under this "
                          "root (schema-per-shard analogue, "
                          "mas/MAS_Design.md:11-17)")
+    ap.add_argument("-shared-cache", default="",
+                    help="sqlite file for a CROSS-PROCESS response "
+                         "cache shared by all masapi instances on this "
+                         "host (memcached role, mas/api/api.go:43-52); "
+                         "also via GSKY_MAS_SHARED_CACHE")
     args = ap.parse_args(argv)
 
     if args.shard_root:
@@ -139,7 +231,10 @@ def main(argv=None):
         store = MASStore(args.database)
     for path in args.ingest:
         ingest_file(store, path)
-    web.run_app(build_app(store), host=args.host, port=args.port,
+    shared = SharedResponseCache(args.shared_cache) \
+        if args.shared_cache else None
+    web.run_app(build_app(store, shared_cache=shared),
+                host=args.host, port=args.port,
                 print=lambda *a: print(f"masapi listening on "
                                        f"{args.host}:{args.port}"))
 
@@ -147,11 +242,12 @@ def main(argv=None):
 def ingest_file(store: MASStore, path: str) -> int:
     """Ingest a crawler output file: JSON-lines or TSV
     (`path\\tgdal\\tjson`, the crawl pipeline format)."""
-    n = 0
     opener = open
     if path.endswith(".gz"):
         import gzip
         opener = gzip.open
+    n = 0
+    batch = []
     with opener(path, "rt") as fp:
         for line in fp:
             line = line.strip()
@@ -164,7 +260,14 @@ def ingest_file(store: MASStore, path: str) -> int:
                     rec["filename"] = parts[0]
             else:
                 rec = json.loads(line)
-            n += store.ingest(rec)
+            batch.append(rec)
+            # chunked transactions: the batch win (~50x over per-record
+            # commits) with bounded memory on catalog-scale crawls
+            if len(batch) >= 10_000:
+                n += store.ingest_many(batch)
+                batch = []
+    if batch:
+        n += store.ingest_many(batch)
     return n
 
 

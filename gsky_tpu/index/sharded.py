@@ -107,6 +107,16 @@ class MASShardedStore:
         path = record.get("filename") or record.get("file_path") or ""
         return self._shard(self._shard_key(path)).ingest(record)
 
+    def ingest_many(self, records) -> int:
+        """Batch ingest, one transaction per shard."""
+        from collections import defaultdict
+        by: Dict[str, list] = defaultdict(list)
+        for r in records:
+            path = r.get("filename") or r.get("file_path") or ""
+            by[self._shard_key(path)].append(r)
+        return sum(self._shard(k).ingest_many(rs)
+                   for k, rs in by.items())
+
     @property
     def generation(self) -> int:
         with self._lock:

@@ -59,12 +59,17 @@ class ImageRaster:
     """PIL-decoded raster with world-file georeferencing."""
 
     def __init__(self, path: str):
+        import threading
+
         from PIL import Image
         self.path = path
         img = Image.open(path)
         self.width, self.height = img.size
         self._img = img
         self._arr: Optional[np.ndarray] = None
+        # handles are shared across decode worker threads via the
+        # handle cache; PIL's lazy load() is not thread-safe
+        self._decode_lock = threading.Lock()
         self.bands = len(img.getbands())
         self.nodata: Optional[float] = None
         self.overviews: Tuple = ()
@@ -73,12 +78,13 @@ class ImageRaster:
         self.crs = None        # sidecar .prj / ruleset srs supplies it
 
     def _array(self) -> np.ndarray:
-        if self._arr is None:
-            a = np.asarray(self._img)
-            if a.ndim == 2:
-                a = a[..., None]
-            self._arr = a
-        return self._arr
+        with self._decode_lock:
+            if self._arr is None:
+                a = np.asarray(self._img)
+                if a.ndim == 2:
+                    a = a[..., None]
+                self._arr = a
+            return self._arr
 
     def read(self, band: int = 1,
              window: Optional[Tuple[int, int, int, int]] = None,

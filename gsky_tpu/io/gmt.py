@@ -66,6 +66,9 @@ class GMTGrid:
             raise ValueError(f"GMT z variable too small: {path}")
         self.scale_factor = float(z.attrs.get("scale_factor", 1.0))
         self.add_offset = float(z.attrs.get("add_offset", 0.0))
+        # absent attribute defaults to PIXEL registration, matching the
+        # reference driver (`gmtdataset.cpp:330` inits node_offset = 1
+        # before reading the attr) — parity over GMT's own convention
         node_offset = int(np.asarray(
             z.attrs.get("node_offset", 1)).reshape(-1)[0])
         self.gt = self._geotransform(v, node_offset)
@@ -101,6 +104,12 @@ class GMTGrid:
             window = (0, 0, self.width, self.height)
         c0, r0, w, h = window
         z = self._nc.variables["z"]
+        if c0 == 0 and w == self.width:
+            # full-width read: ONE contiguous slice instead of h
+            # variable round-trips (the scene/drill caches read whole
+            # grids this way)
+            flat = np.asarray(z[r0 * w:(r0 + h) * w])
+            return flat.reshape(h, w)
         rows = []
         # row-contiguous slices out of the flat variable; the NC3/HDF5
         # readers slice without materialising the whole grid

@@ -26,6 +26,9 @@ RESERVED_QUERY_PARAMS = {
     "identitytol", "layer", "layers", "limit", "namespace", "nseg",
     "request", "service", "srs", "styles", "time", "until", "version",
     "width", "wkt",
+    # DAP4 constraint marker: without it every DAP request aggregates
+    # under "?.?" in the /debug summary
+    "dap4.ce",
 }
 
 
@@ -105,12 +108,17 @@ class MetricsCollector:
         self.info["http_status"] = status
         self.info["req_duration"] = int((time.time() - self._t0) * 1e9)
         self.info["cache"] = _cache_stats()
+        self._logger.record_summary(self.info)
         self._logger.write(self.info)
 
 
 class MetricsLogger:
     """stdout or rotated gzip file sink (`metrics/logger.go:35-223`),
     tunables via env GSKY_MAX_LOG_FILE_SIZE / GSKY_MAX_LOG_FILES."""
+
+    # per-verb rolling latency reservoir size (the /debug side-door's
+    # percentile window)
+    _RESERVOIR = 512
 
     def __init__(self, log_dir: str = "", verbose: bool = False):
         self.log_dir = log_dir
@@ -123,9 +131,68 @@ class MetricsLogger:
         self.max_files = int(os.environ.get("GSKY_MAX_LOG_FILES", 10))
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
+        self.started = time.time()
+        # verb -> {count, errors, lat (deque of recent seconds),
+        #          device_ms_sum, rpc_ms_sum}
+        self._summary: Dict[str, Dict] = {}
+        self._summary_lock = threading.Lock()
 
     def collector(self) -> MetricsCollector:
         return MetricsCollector(self)
+
+    def record_summary(self, info: Dict) -> None:
+        """Fold one request into the rolling per-verb aggregates the
+        /debug route serves (`net/http/pprof` role, `ows.go:40`)."""
+        from collections import deque
+        try:
+            q = info.get("url", {}).get("query", {})
+            if "dap4.ce" in q:
+                verb = "DAP4.ce"
+            else:
+                verb = (str(q.get("service", "?")) + "."
+                        + str(q.get("request", "?")))[:48]
+            dur_s = info.get("req_duration", 0) / 1e9
+            status = info.get("http_status", 200)
+            with self._summary_lock:
+                s = self._summary.get(verb)
+                if s is None:
+                    s = self._summary[verb] = {
+                        "count": 0, "errors": 0,
+                        "lat": deque(maxlen=self._RESERVOIR),
+                        "device_ms": 0.0, "rpc_ms": 0.0}
+                s["count"] += 1
+                if status >= 400:
+                    s["errors"] += 1
+                s["lat"].append(dur_s)
+                s["device_ms"] += info.get("device", {}).get(
+                    "duration", 0) / 1e6
+                s["rpc_ms"] += info.get("rpc", {}).get(
+                    "duration", 0) / 1e6
+        except Exception:   # observability must never fail a request
+            pass
+
+    def summary(self) -> Dict:
+        """The /debug document body: uptime, per-verb counts + latency
+        percentiles over the rolling window, cumulative device/pipeline
+        time, cache hit/miss counters."""
+        out: Dict = {"uptime_s": round(time.time() - self.started, 1),
+                     "requests": {}}
+        with self._summary_lock:
+            for verb, s in self._summary.items():
+                lat = sorted(s["lat"])
+
+                def pct(p, lat=lat):
+                    return round(
+                        lat[min(int(len(lat) * p), len(lat) - 1)] * 1e3,
+                        1) if lat else None
+                out["requests"][verb] = {
+                    "count": s["count"], "errors": s["errors"],
+                    "p50_ms": pct(0.5), "p99_ms": pct(0.99),
+                    "window": len(lat),
+                    "device_ms_total": round(s["device_ms"], 1),
+                    "pipeline_ms_total": round(s["rpc_ms"], 1)}
+        out["cache"] = _cache_stats()
+        return out
 
     def write(self, info: Dict):
         if not self.log_dir and not self.verbose:

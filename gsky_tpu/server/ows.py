@@ -112,11 +112,72 @@ class OWSServer:
     def app(self) -> web.Application:
         app = web.Application(client_max_size=64 * 1024 * 1024)
         app.router.add_route("*", "/ows", self.handle)
+        # profiling side-door (`net/http/pprof` on the reference's
+        # servers, `ows.go:40`): rolling stage-timing summaries, cache
+        # and executor state, optional jax-profiler trace capture
+        app.router.add_get("/debug", self._debug)
+        app.router.add_get("/debug/profile", self._debug_profile)
         app.router.add_route("*", "/ows/{namespace:.*}", self.handle)
         if self.static_dir and os.path.isdir(self.static_dir):
             app.router.add_get("/", self._index)
             app.router.add_static("/", self.static_dir, show_index=False)
         return app
+
+    async def _debug(self, request: web.Request) -> web.Response:
+        doc = self.metrics.summary()
+        try:
+            import jax
+            doc["jax"] = {"backend": jax.default_backend(),
+                          "devices": len(jax.devices())}
+        except Exception:
+            pass
+        try:
+            from ..parallel.spmd import spmd_enabled
+            doc["spmd"] = spmd_enabled()
+        except Exception:
+            pass
+        try:
+            from ..pipeline.drill_cache import default_drill_cache as dc
+            from ..pipeline.executor import default_executor as ex
+            from ..pipeline.scene_cache import default_scene_cache as sc
+            doc["executor"] = {
+                "geo_cache": len(ex._geo_cache),
+                "stack_cache": len(ex._stack_cache),
+                "stride_cache": len(ex._stride_cache)}
+            doc["scene_cache_bytes"] = sc._bytes
+            doc["drill_cache_bytes"] = dc._bytes
+        except Exception:
+            pass
+        return web.json_response(doc)
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """Capture a jax profiler trace for ?seconds=N (default 3, max
+        30) into the temp dir and report the path — ad-hoc device-time
+        attribution on a LIVE server, the role of pprof's CPU profile
+        endpoint."""
+        try:
+            seconds = min(max(float(
+                request.query.get("seconds", "3")), 0.1), 30.0)
+        except ValueError:
+            seconds = 3.0
+        out_dir = os.path.join(
+            self.temp_dir,
+            f"gsky_jax_trace_{int(time.time())}")
+        try:
+            import jax
+            jax.profiler.start_trace(out_dir)
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                # client disconnect cancels the handler with a
+                # BaseException; an un-stopped trace would wedge the
+                # profiler for the life of the process
+                jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 - report, don't 500
+            return web.json_response(
+                {"error": f"trace failed: {e}"}, status=503)
+        return web.json_response({"trace_dir": out_dir,
+                                  "seconds": seconds})
 
     async def _index(self, request):
         index = os.path.join(self.static_dir, "index.html")

@@ -692,3 +692,76 @@ class TestShardedStore:
             end_time=t0 + 3 * 86400)
         res = TilePipeline(MASClient(store)).process(req)
         assert res.valid["landsat_20200110"].any()
+
+
+class TestSharedResponseCache:
+    """The cross-process MAS response cache (memcached role,
+    `mas/api/api.go:43-52`): populated by one server process, served
+    from by another."""
+
+    SCRIPT = r'''
+import asyncio, json, sys
+sys.path.insert(0, sys.argv[4])
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+from gsky_tpu.index.api import SharedResponseCache, build_app
+from gsky_tpu.index.store import MASStore
+
+mode, db, shared = sys.argv[1], sys.argv[2], sys.argv[3]
+store = MASStore(db)
+if mode == "ingest":
+    store.ingest({"filename": "/x/a.tif", "file_type": "GeoTIFF",
+                  "geo_metadata": [{
+                      "ds_name": "/x/a.tif", "namespace": "v",
+                      "array_type": "Int16",
+                      "proj4": "+proj=longlat +datum=WGS84 +no_defs",
+                      "geotransform": [148, 0.01, 0, -35, 0, -0.01],
+                      "x_size": 10, "y_size": 10,
+                      "polygon": "POLYGON((148 -35.1,148.1 -35.1,"
+                                 "148.1 -35,148 -35,148 -35.1))",
+                      "timestamps": [], "nodata": None, "band": 1}]})
+elif mode == "reader":
+    # sabotage: this process's store CANNOT answer queries, so a
+    # correct response proves the shared cache served it
+    def boom(*a, **k):
+        raise RuntimeError("store must not be queried")
+    store.intersects = boom
+
+async def go():
+    from aiohttp.test_utils import TestClient, TestServer
+    app = build_app(store, shared_cache=SharedResponseCache(shared))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.get(
+            "/x?intersects&srs=EPSG:4326"
+            "&wkt=POLYGON((148.0 -35.09,148.09 -35.09,148.09 -35.01,"
+            "148.0 -35.01,148.0 -35.09))")
+        print(resp.status, json.dumps(await resp.json()))
+    finally:
+        await client.close()
+
+asyncio.run(go())
+'''
+
+    def test_second_process_served_from_shared_file(self, tmp_path):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        db = str(tmp_path / "mas.db")
+        shared = str(tmp_path / "shared_cache.db")
+
+        def run(mode):
+            r = subprocess.run(
+                [sys.executable, "-c", self.SCRIPT, mode, db, shared,
+                 repo],
+                capture_output=True, text=True, timeout=120)
+            assert r.returncode == 0, r.stderr
+            status, body = r.stdout.strip().split(" ", 1)
+            return int(status), json.loads(body)
+
+        st, body = run("ingest")           # process A: query -> cache
+        assert st == 200 and body["files"] == ["/x/a.tif"]
+        st, body = run("reader")           # process B: store sabotaged
+        assert st == 200 and body["files"] == ["/x/a.tif"]

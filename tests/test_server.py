@@ -600,3 +600,61 @@ class TestCacheMetrics:
         assert "cache" in rec
         assert "scene" in rec["cache"]
         assert {"hits", "misses"} <= set(rec["cache"]["scene"])
+
+
+class TestDebugSideDoor:
+    """The /debug profiling side-door (`ows.go:40` pprof role)."""
+
+    def test_debug_summary_after_requests(self, env):
+        import json as _json
+
+        # drive a couple of real requests so the summary has rows
+        st, ct, _ = _get(env, "/ows?service=WMS&request=GetCapabilities")
+        assert st == 200
+        st, ct, _ = _get(
+            env, "/ows?service=WMS&request=GetMap&version=1.3.0"
+            f"&layers=landsat&crs=EPSG:3857&bbox={BBOX3857}"
+            "&width=64&height=64&format=image/png"
+            f"&time={DATE}")
+        assert st == 200
+
+        st, ct, body = _get(env, "/debug")
+        assert st == 200 and ct == "application/json"
+        doc = _json.loads(body)
+        assert doc["uptime_s"] >= 0
+        reqs = doc["requests"]
+        assert any(k.lower().startswith("wms.getmap") for k in reqs), reqs
+        getmap = next(v for k, v in reqs.items()
+                      if k.lower().startswith("wms.getmap"))
+        assert getmap["count"] >= 1
+        assert getmap["p50_ms"] is not None and getmap["p50_ms"] > 0
+        assert "cache" in doc and "scene" in doc["cache"]
+        assert "executor" in doc
+        assert "jax" in doc and doc["jax"]["backend"] == "cpu"
+
+    def test_debug_errors_counted(self, env):
+        import json as _json
+
+        st, _, _ = _get(env, "/ows?service=WMS&request=GetMap"
+                             "&layers=nolayer")
+        assert st == 400
+        st, _, body = _get(env, "/debug")
+        doc = _json.loads(body)
+        getmap = next(v for k, v in doc["requests"].items()
+                      if k.lower().startswith("wms.getmap"))
+        assert getmap["errors"] >= 1
+
+    def test_debug_profile_capture(self, env, tmp_path):
+        import json as _json
+
+        env["server"].temp_dir = str(tmp_path)
+        st, _, body = _get(env, "/debug/profile?seconds=0.2")
+        doc = _json.loads(body)
+        if st == 503:
+            # profiler unavailable on this backend build: the route
+            # must degrade with an explanation, not a 500
+            assert "error" in doc
+            return
+        assert st == 200
+        import os as _os
+        assert _os.path.isdir(doc["trace_dir"])

@@ -1,0 +1,132 @@
+"""MAS scale benchmark (VERDICT r4 #6): synthetic catalog at
+reference-ish scale, `?intersects` latency percentiles through
+`MASStore` (R*Tree path) and `MASShardedStore`.
+
+    python tools/mas_bench.py [-n 100000] [-q 200] [--shards 8]
+
+Prints one JSON line.  The reference's PostGIS design (partial GIST
+indexes per SRID + materialized polygons, `mas/api/mas.sql:363-547`)
+targets ~1e7 granules on a database server; the sqlite R*Tree holds the
+<50 ms interactive budget at 1e5+ per shard, and the sharded store
+multiplies that by the shard count.
+"""
+
+import argparse
+import datetime as dt
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def synth_records(n: int, root: str, seed: int = 1):
+    """Landsat-ish footprints over Australia, 16 namespaces, one year
+    of acquisitions."""
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        x0 = float(rng.uniform(112, 152))
+        y0 = float(rng.uniform(-42, -12))
+        x1 = x0 + 0.2 + float(rng.uniform(0, 0.2))
+        y1 = y0 + 0.2 + float(rng.uniform(0, 0.2))
+        t = 1.5e9 + float(rng.uniform(0, 3e7))
+        iso = dt.datetime.fromtimestamp(t, dt.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.000Z")
+        recs.append({
+            "filename": f"{root}/scenes/l8_{i:07d}.tif",
+            "file_type": "GeoTIFF",
+            "geo_metadata": [{
+                "ds_name": f"{root}/scenes/l8_{i:07d}.tif",
+                "namespace": f"band{i % 16}",
+                "array_type": "Int16",
+                "proj4": "+proj=longlat +datum=WGS84 +no_defs",
+                "geotransform": [x0, 3e-4, 0.0, y1, 0.0, -3e-4],
+                "x_size": 1000, "y_size": 1000,
+                "polygon": (f"POLYGON(({x0} {y0},{x1} {y0},{x1} {y1},"
+                            f"{x0} {y1},{x0} {y0}))"),
+                "timestamps": [iso], "nodata": -999.0, "band": 1}]})
+    return recs
+
+
+def measure(store, root: str, n_queries: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    lat = []
+    hits = 0
+    for _ in range(n_queries):
+        cx = float(rng.uniform(113, 151))
+        cy = float(rng.uniform(-41, -13))
+        wkt = (f"POLYGON(({cx} {cy},{cx + 0.3} {cy},"
+               f"{cx + 0.3} {cy + 0.3},{cx} {cy + 0.3},{cx} {cy}))")
+        t0 = time.perf_counter()
+        r = store.intersects(root, srs="EPSG:4326", wkt=wkt,
+                             metadata="gdal",
+                             time="2017-08-01T00:00:00.000Z",
+                             until="2018-03-01T00:00:00.000Z")
+        lat.append(time.perf_counter() - t0)
+        hits += len(r["gdal"])
+    lat.sort()
+
+    def pct(p):
+        return round(lat[min(int(len(lat) * p), len(lat) - 1)] * 1e3, 2)
+
+    return {"p50_ms": pct(0.5), "p99_ms": pct(0.99),
+            "max_ms": round(lat[-1] * 1e3, 2),
+            "mean_rows": round(hits / max(n_queries, 1), 1)}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=100_000)
+    ap.add_argument("-q", type=int, default=200)
+    ap.add_argument("--shards", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    from gsky_tpu.index import MASStore
+    from gsky_tpu.index.sharded import MASShardedStore
+
+    root = "/a"
+    recs = synth_records(args.n, root)
+
+    store = MASStore()
+    t0 = time.time()
+    store.ingest_many(recs)
+    single_ingest_s = round(time.time() - t0, 2)
+    single = measure(store, root, args.q)
+
+    tmp = tempfile.mkdtemp(prefix="mas_shards_")
+    sharded = MASShardedStore(tmp)
+    # route by top-level dir: shard key comes from the path prefix
+    by_shard = []
+    per = args.n // args.shards
+    for s in range(args.shards):
+        for r in recs[s * per:(s + 1) * per]:
+            r2 = dict(r)
+            r2["filename"] = r["filename"].replace(
+                "/scenes/", f"/shard{s:02d}/")
+            gm = [dict(r["geo_metadata"][0])]
+            gm[0]["ds_name"] = r2["filename"]
+            r2["geo_metadata"] = gm
+            by_shard.append(r2)
+    t0 = time.time()
+    sharded.ingest_many(by_shard)
+    shard_ingest_s = round(time.time() - t0, 2)
+    shard_all = measure(sharded, root, args.q, seed=8)
+
+    print(json.dumps({
+        "granules": args.n,
+        "single_store": dict(single, ingest_s=single_ingest_s),
+        "sharded_store": dict(shard_all, shards=args.shards,
+                              ingest_s=shard_ingest_s,
+                              note="root-scope query fans out to all "
+                                   "shards"),
+    }))
+
+
+if __name__ == "__main__":
+    main()
