@@ -465,6 +465,25 @@ def bench_kernels():
         "chip_tiles_per_s": round(1e3 / pipe_ms, 1),
         "approx_hbm_gbps": round(traffic / (pipe_ms * 1e-3) / 1e9, 2)}
 
+    # --- batched N-tile render (the RenderBatcher kernel): how much of
+    # the per-tile cost is per-dispatch overhead the batcher amortises
+    from gsky_tpu.ops.warp import render_scenes_ctrl_many
+    NB = 8
+    ctrls = jnp.asarray(np.stack(
+        [np.asarray(ctrl) + k * 7.0 for k in range(NB)]))
+    paramss = jnp.asarray(np.stack([np.asarray(params)] * NB))
+    sps = jnp.zeros((NB, 3), np.float32)
+
+    def render_many():
+        return render_scenes_ctrl_many(stack, ctrls, paramss, sps,
+                                       "near", 1, (h, w), 16, True, 0)
+
+    sync_ms, pipe_ms = timeit(render_many, n=20)
+    out["render_mosaic_256_x8"] = {
+        "sync_ms": sync_ms, "pipelined_ms": pipe_ms,
+        "per_tile_ms": round(pipe_ms / NB, 3),
+        "chip_tiles_per_s": round(NB * 1e3 / pipe_ms, 1)}
+
     # --- channel-packed RGB render at the cfg2 shape (bilinear)
     rgb = jnp.asarray(
         rng.uniform(200, 3000, (S, S, 3)).astype(np.int16))

@@ -350,29 +350,36 @@ def _gather2d_c(src, ri, ci):
     return src.reshape(-1, C)[ri * W + ci]
 
 
-def _resample_c(src, valid, rows, cols, method: str):
-    """Channel-vectorised resample: src/valid (H, W, C), rows/cols
-    (h, w) -> (out (h, w, C), ok (h, w, C)).  The index math (the
-    expensive part of a gather on any backend) runs ONCE for all C
-    channels instead of once per band."""
+def _resample_c(src, nodata, rows, cols, method: str):
+    """Channel-vectorised resample from a NATIVE-dtype channel-last
+    source: src (H, W, C), rows/cols (h, w) -> (out (h, w, C) f32, ok
+    (h, w, C) bool).  The index math runs ONCE for all C channels, and
+    validity derives from each gathered tap's value (see
+    `_resample_native` — no full-scene f32/validity prologue)."""
+    if method not in ("near", "nearest", "bilinear", "cubic"):
+        # the tap table below would silently render an unknown name as
+        # cubic; keep the old _METHODS[method] KeyError contract
+        raise KeyError(f"unknown resample method {method!r}")
     H, W, C = src.shape
+
+    def tap(ri, ci, inb):
+        v = _gather2d_c(src, ri, ci).astype(jnp.float32)
+        ok = inb[..., None] & jnp.isfinite(v) & (v != nodata)
+        return jnp.where(ok, v, 0.0), ok
+
     if method in ("near", "nearest"):
         ri = jnp.floor(rows + (0.5 + 1e-10)).astype(jnp.int32)
         ci = jnp.floor(cols + (0.5 + 1e-10)).astype(jnp.int32)
         inb = (ri >= 0) & (ri < H) & (ci >= 0) & (ci < W) \
             & jnp.isfinite(rows) & jnp.isfinite(cols)
-        ri = jnp.clip(ri, 0, H - 1)
-        ci = jnp.clip(ci, 0, W - 1)
-        out = _gather2d_c(src, ri, ci)
-        ok = inb[..., None] & _gather2d_c(valid, ri, ci)
-        return out, ok
+        return tap(jnp.clip(ri, 0, H - 1), jnp.clip(ci, 0, W - 1), inb)
     finite = jnp.isfinite(rows) & jnp.isfinite(cols)
     rows = jnp.where(finite, rows, -10.0)
     cols = jnp.where(finite, cols, -10.0)
     r0 = jnp.floor(rows)
     c0 = jnp.floor(cols)
-    fr = (rows - r0).astype(src.dtype)
-    fc = (cols - c0).astype(src.dtype)
+    fr = (rows - r0).astype(jnp.float32)
+    fc = (cols - c0).astype(jnp.float32)
     r0 = r0.astype(jnp.int32)
     c0 = c0.astype(jnp.int32)
     if method == "bilinear":
@@ -385,19 +392,17 @@ def _resample_c(src, valid, rows, cols, method: str):
         taps = [(dr - 1, dc - 1, wr[dr] * wc[dc])
                 for dr in range(4) for dc in range(4)]
         thresh = 0.05
-    acc = jnp.zeros(rows.shape + (C,), src.dtype)
-    wacc = jnp.zeros(rows.shape + (C,), src.dtype)
+    acc = jnp.zeros(rows.shape + (C,), jnp.float32)
+    wacc = jnp.zeros(rows.shape + (C,), jnp.float32)
     for dr, dc, w in taps:
         ri = r0 + dr
         ci = c0 + dc
         inb = (ri >= 0) & (ri < H) & (ci >= 0) & (ci < W)
-        ric = jnp.clip(ri, 0, H - 1)
-        cic = jnp.clip(ci, 0, W - 1)
-        v = _gather2d_c(src, ric, cic)
-        ok = (inb[..., None] & _gather2d_c(valid, ric, cic)) \
-            .astype(src.dtype)
-        acc = acc + w[..., None] * ok * v
-        wacc = wacc + w[..., None] * ok
+        v, okt = tap(jnp.clip(ri, 0, H - 1), jnp.clip(ci, 0, W - 1),
+                     inb)
+        okf = okt.astype(jnp.float32)
+        acc = acc + w[..., None] * okf * v
+        wacc = wacc + w[..., None] * okf
     ok = finite[..., None] & (wacc > thresh)
     out = acc / jnp.where(wacc > thresh, wacc, 1.0)
     return out, ok
@@ -428,15 +433,12 @@ def render_rgba_ctrl(scene, ctrl, param, scale_params,
     sx = _bilerp_grid(ctrl[0], h, w, step)
     sy = _bilerp_grid(ctrl[1], h, w, step)
     p = param
-    sf = scene.astype(jnp.float32)
-    valid = jnp.isfinite(sf) & (sf != p[8])
     cols = (p[0] + p[1] * sx + p[2] * sy) - 0.5
     rows = (p[3] + p[4] * sx + p[5] * sy) - 0.5
     oob = (rows < -0.5) | (rows > p[6] - 0.5) \
         | (cols < -0.5) | (cols > p[7] - 0.5)
     rows = jnp.where(oob, jnp.nan, rows)
-    data, ok = _resample_c(jnp.where(valid, sf, 0.0), valid, rows, cols,
-                           method)
+    data, ok = _resample_c(scene, p[8], rows, cols, method)
     if auto:
         if colour_scale == 1:
             logged = jnp.log10(data)
@@ -535,23 +537,34 @@ def warp_scenes_batch(stack, sxy, params, method: str = "near",
     return _warp_scenes_core(stack, sxy[0], sxy[1], params, method, n_ns)
 
 
+def _resample_native(src, nodata, rows, cols, method: str):
+    """Resample directly from a NATIVE-dtype (H, W) source, deriving
+    validity from each gathered tap's VALUE (finite and != nodata)
+    instead of pre-materialising full-scene f32 + validity arrays.  For
+    a 256-px tile over a 2048-px scene stack the old elementwise
+    prologue moved ~80 MB of HBM per dispatch; tap-side validation
+    moves O(taps x tile).  Semantics identical: validity is a pure
+    function of the stored value.  Implemented as the C=1 case of
+    `_resample_c` (XLA folds the size-1 channel axis away), so the tap
+    machinery exists once."""
+    out, ok = _resample_c(src[..., None], nodata, rows, cols, method)
+    return out[..., 0], ok[..., 0]
+
+
 def _warp_scenes_scored(stack, sx, sy, params, method: str, n_ns: int):
     """Core warp + per-namespace mosaic returning (canvases, best) where
     ``best`` is the winning granule's mosaic priority per pixel (-inf
     where no granule contributed) — the carrier that lets partial
     mosaics from several dispatches (e.g. per-source-CRS groups) combine
     with newest-wins semantics preserved."""
-    fn = _METHODS[method]
 
     def per(scene, p):
-        sf = scene.astype(jnp.float32)
-        valid = jnp.isfinite(sf) & (sf != p[8])
         cols = (p[0] + p[1] * sx + p[2] * sy) - 0.5
         rows = (p[3] + p[4] * sx + p[5] * sy) - 0.5
         oob = (rows < -0.5) | (rows > p[6] - 0.5) \
             | (cols < -0.5) | (cols > p[7] - 0.5)
         rows = jnp.where(oob, jnp.nan, rows)
-        return fn(jnp.where(valid, sf, 0.0), valid, rows, cols)
+        return _resample_native(scene, p[8], rows, cols, method)
 
     out, ok = jax.vmap(per)(stack, params)
     prio = params[:, 9]
