@@ -1,0 +1,108 @@
+"""On-chip cfg5 drill timing breakdown (round-5 warm-path outlier).
+
+Mirrors bench.bench_cfg5_drill exactly, then times each stage of the
+warm device path separately:
+
+    python tools/drill_probe.py            # needs the relay up
+
+Run WITHOUT any shell timeout that could SIGKILL the process mid-work
+(DEVICE.md round-5 rule).
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    # resolve the platform BEFORE touching jax: a wedged relay hangs
+    # bare PJRT init uninterruptibly (DEVICE.md)
+    from gsky_tpu.device import ensure_platform
+    plat = ensure_platform(retries=1, timeout_s=60.0)
+    print("platform:", plat, flush=True)
+
+    import jax
+    print("backend:", jax.default_backend(), flush=True)
+
+    import numpy as np
+
+    import bench
+    from gsky_tpu.index import MASClient
+    from gsky_tpu.pipeline.drill import DrillPipeline, _drill_device
+    from gsky_tpu.pipeline.drill_cache import default_drill_cache as DC
+    from gsky_tpu.pipeline.types import GeoDrillRequest
+
+    tmp = tempfile.mkdtemp(prefix="drillprobe_")
+    wkt = ("POLYGON((148.05 -35.45,148.45 -35.45,148.45 -35.05,"
+           "148.05 -35.05,148.05 -35.45))")
+
+    def make(name, seed):
+        store, _, t0 = bench.build_drill_archive(tmp, name, seed)
+        req = GeoDrillRequest(
+            collection=tmp, bands=["veg"], geometry_wkt=wkt,
+            start_time=t0, end_time=t0 + 1000 * 86400.0, approx=False)
+        return DrillPipeline(MASClient(store)), req
+
+    dpw, reqw = make("veg_warmup.nc", 4)
+    t = time.time()
+    dpw.process(reqw)
+    print(f"warmup#1 (cold host): {time.time() - t:.3f}s", flush=True)
+    print("wait_idle:", DC.wait_idle(600),
+          "resident:", len(DC._order),
+          "hit/miss:", DC.hits, DC.misses, flush=True)
+    for i in range(3):
+        t = time.time()
+        dpw.process(reqw)
+        print(f"warmup#{i + 2}: {time.time() - t:.3f}s", flush=True)
+
+    dp, req = make("veg_stack.nc", 3)
+    t = time.time()
+    dp.process(req)
+    print(f"measured cold: {time.time() - t:.3f}s", flush=True)
+    print("wait_idle:", DC.wait_idle(600),
+          "resident:", len(DC._order), flush=True)
+    for i in range(4):
+        t = time.time()
+        dp.process(req)
+        print(f"measured warm#{i}: {time.time() - t:.3f}s", flush=True)
+
+    # stage-level breakdown of one warm device drill
+    import jax.numpy as jnp
+
+    from gsky_tpu.ops import drill as D
+    st = DC.get("%s/veg_stack.nc" % tmp, True, "veg", 1, -9999.0)
+    print("stack resident:", st is not None, flush=True)
+    if st is None:
+        return
+    rng = np.random.default_rng(0)
+    mask = rng.uniform(0, 1, (128, 128)) < 0.6
+    tsel = np.arange(1024, dtype=np.int32) % 1000
+    for i in range(3):
+        t = time.time()
+        dataf, validf = D.window_gather(
+            st.dev, jnp.asarray(tsel), np.int32(0), np.int32(0),
+            jnp.asarray(mask), np.float32(-9999.0), np.bool_(True),
+            (128, 128))
+        jax.block_until_ready(dataf)
+        t1 = time.time()
+        from gsky_tpu.ops.pallas_tpu import (masked_stats_pallas,
+                                             use_pallas)
+        s, c = masked_stats_pallas(dataf, validf, -3.0e38, 3.0e38,
+                                   interpret=not use_pallas())
+        np.asarray(c)
+        t2 = time.time()
+        v, c2 = D.masked_mean(dataf, validf)
+        np.asarray(v)
+        t3 = time.time()
+        print(f"iter{i}: gather {t1 - t:.3f}s  pallas_stats "
+              f"{t2 - t1:.3f}s  xla_stats {t3 - t2:.3f}s", flush=True)
+    from gsky_tpu.ops.pallas_tpu import _FAILED
+    print("pallas blacklist:", _FAILED, flush=True)
+
+
+if __name__ == "__main__":
+    main()
