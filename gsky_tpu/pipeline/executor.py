@@ -89,8 +89,16 @@ class WarpExecutor:
         self._stack_cache: OrderedDict = OrderedDict()
         self._stride_cache: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
+        # dispatch counters by (path, shape bucket) — the /debug
+        # side-door's "where do renders actually go" answer
+        self.bucket_stats: Dict[str, int] = {}
         from .batcher import RenderBatcher
         self._batcher = RenderBatcher()
+
+    def _count(self, path: str, bucket=None) -> None:
+        key = f"{path}:{bucket}" if bucket is not None else path
+        with self._lock:
+            self.bucket_stats[key] = self.bucket_stats.get(key, 0) + 1
 
     def _geo_cache_get(self, key):
         with self._lock:
@@ -253,6 +261,7 @@ class WarpExecutor:
 
         for (bh, bw), batch in buckets.items():
             B = _bucket_pow2(len(batch))  # pow2 pad: bounded jit variants
+            self._count("window_batch", (bh, bw, B))
             src = np.zeros((B, bh, bw), np.float32)
             valid = np.zeros((B, bh, bw), bool)
             rows = np.full((B, height, width), -1e6, np.float32)
@@ -356,10 +365,12 @@ class WarpExecutor:
                 # mesh path (GSKY_SPMD=1): granule axis over `granule`,
                 # width over `x` — the production fused mosaic on
                 # 1..N chips (SURVEY §2.8 P5/P6 on ICI)
+                self._count("scene_mosaic_spmd", stack.shape)
                 canv, best = spmd.mosaic_scored(
                     stack, ctrl_dev, params, method, n_pad,
                     (height, width), step)
                 return canv, best > -jnp.inf
+            self._count("scene_mosaic", stack.shape)
             return warp_scenes_ctrl(stack, ctrl_dev,
                                     jnp.asarray(params), method,
                                     n_pad, (height, width), step)
@@ -367,6 +378,7 @@ class WarpExecutor:
         # scored dispatch per source-CRS group, then a per-pixel
         # priority combine — newest-wins survives the grouping because
         # each partial carries its winners' priorities
+        self._count("scene_mosaic_multicrs", len(groups))
         parts = [warp_scenes_ctrl_scored(
                     stack, ctrl_dev, jnp.asarray(params),
                     method, n_pad, (height, width), step)
@@ -396,8 +408,10 @@ class WarpExecutor:
                    auto, colour_scale)
         spmd = default_spmd()
         if spmd is not None:
+            self._count("render_byte_spmd", stack.shape)
             return _prefetch(spmd.render_composite(
                 stack, ctrl_dev, params, sp, *statics))
+        self._count("render_byte", stack.shape)
         from .batcher import batching_enabled
         if batching_enabled():
             # scene-serial key (not id()): address reuse after eviction
@@ -427,6 +441,7 @@ class WarpExecutor:
         if made is None:
             return None
         stack, _, params, step, _, ctrl_dev = made
+        self._count("render_bands", stack.shape)
         sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
         sel = jnp.asarray(np.asarray(out_sel, np.int32))
         return _prefetch(render_scenes_bands_ctrl(
@@ -511,6 +526,7 @@ class WarpExecutor:
                          + (s0.height, s0.width, s0.nodata, 0.0, 0.0),
                          np.float32)
         from ..ops.warp import render_rgba_ctrl
+        self._count("render_rgba", packed.shape)
         sp = np.array([offset, scale, clip], np.float32)
         return _prefetch(render_rgba_ctrl(
             packed, ctrl_dev, jnp.asarray(param), jnp.asarray(sp),

@@ -143,7 +143,8 @@ class OWSServer:
             doc["executor"] = {
                 "geo_cache": len(ex._geo_cache),
                 "stack_cache": len(ex._stack_cache),
-                "stride_cache": len(ex._stride_cache)}
+                "stride_cache": len(ex._stride_cache),
+                "dispatches": dict(ex.bucket_stats)}
             doc["scene_cache_bytes"] = sc._bytes
             doc["drill_cache_bytes"] = dc._bytes
         except Exception:

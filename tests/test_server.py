@@ -630,6 +630,12 @@ class TestDebugSideDoor:
         assert getmap["p50_ms"] is not None and getmap["p50_ms"] > 0
         assert "cache" in doc and "scene" in doc["cache"]
         assert "executor" in doc
+        # dispatch counters: the GetMap above must have gone through
+        # a fused render path
+        disp = doc["executor"]["dispatches"]
+        assert any(k.startswith(("render_byte", "scene_mosaic",
+                                 "window_batch", "render_rgba"))
+                   for k in disp), disp
         assert "jax" in doc and doc["jax"]["backend"] == "cpu"
 
     def test_debug_errors_counted(self, env):
