@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Round-5 on-chip sequence — run when the relay is back up.  SERIAL, no
+# shell timeouts around jax processes (DEVICE.md round-5 rule: a
+# SIGKILLed jax client wedges the relay).  Each step is a single
+# long-lived process; probe between steps.
+set -uo pipefail
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+
+echo "== probe =="
+python tools/probe_device.py --label round5-onchip-pre || exit 1
+
+echo "== 1. drill probe (cfg5 warm-path explanation) =="
+python tools/drill_probe.py 2>&1 | tail -20
+
+echo "== 2. on-device parity tier =="
+python -m pytest tests_tpu/ -q 2>&1 | tail -5
+
+echo "== 3. full bench (refreshes BENCH_TPU_r05_builder.json) =="
+python bench.py > BENCH_TPU_r05_builder.json 2> bench_tpu.err
+echo "bench rc=$? platform=$(python -c "
+import json; print(json.load(open('BENCH_TPU_r05_builder.json'))['platform'])")"
+
+echo "== probe (post) =="
+python tools/probe_device.py --label round5-onchip-post
+echo "== done: leave the relay IDLE until round end =="
