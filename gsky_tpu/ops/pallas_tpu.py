@@ -49,15 +49,23 @@ _CHUNK = 2048
 _ROWS = 128
 
 
+def tpu_like_backend() -> bool:
+    """True when the default backend is a real TPU (incl. the axon
+    relay plugin) — the ONE place the backend-name tuple lives; kernel
+    form selection (`ops.warp._use_tapside`) and the pallas gate below
+    both key off it."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
 def use_pallas() -> bool:
     """True when the pallas kernels should run for real (TPU backend and
     not disabled via GSKY_PALLAS=0)."""
     if os.environ.get("GSKY_PALLAS", "1") == "0" or not _HAVE_PLTPU:
         return False
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:  # pragma: no cover
-        return False
+    return tpu_like_backend()
 
 
 # kernels that failed to compile/run this process: fall back to XLA and

@@ -350,22 +350,49 @@ def _gather2d_c(src, ri, ci):
     return src.reshape(-1, C)[ri * W + ci]
 
 
+def _use_tapside() -> bool:
+    """Kernel form selector, evaluated at TRACE time (the backend is
+    fixed for the life of the process): tap-side validation avoids the
+    per-dispatch full-scene f32/validity prologue — the right shape for
+    TPU, where the prologue is pure HBM traffic; XLA CPU prefers the
+    mask-gather form (the prologue parallelises across cores while
+    gathers run as serial scalar loops — measured cfg3 145 -> 100
+    tiles/s when the tap-side form runs on CPU)."""
+    from .pallas_tpu import tpu_like_backend
+    return tpu_like_backend()
+
+
 def _resample_c(src, nodata, rows, cols, method: str):
     """Channel-vectorised resample from a NATIVE-dtype channel-last
     source: src (H, W, C), rows/cols (h, w) -> (out (h, w, C) f32, ok
-    (h, w, C) bool).  The index math runs ONCE for all C channels, and
-    validity derives from each gathered tap's value (see
-    `_resample_native` — no full-scene f32/validity prologue)."""
+    (h, w, C) bool).  The index math runs ONCE for all C channels.
+    Validity semantics are identical in both kernel forms (it is a pure
+    function of the stored value); `_use_tapside` picks the form that
+    fits the backend."""
     if method not in ("near", "nearest", "bilinear", "cubic"):
         # the tap table below would silently render an unknown name as
         # cubic; keep the old _METHODS[method] KeyError contract
         raise KeyError(f"unknown resample method {method!r}")
     H, W, C = src.shape
 
-    def tap(ri, ci, inb):
-        v = _gather2d_c(src, ri, ci).astype(jnp.float32)
-        ok = inb[..., None] & jnp.isfinite(v) & (v != nodata)
-        return jnp.where(ok, v, 0.0), ok
+    if _use_tapside():
+        def tap(ri, ci, inb):
+            v = _gather2d_c(src, ri, ci).astype(jnp.float32)
+            ok = inb[..., None] & jnp.isfinite(v) & (v != nodata)
+            return jnp.where(ok, v, 0.0), ok
+    else:
+        # mask-gather form: one parallel full-source prologue, taps
+        # gather the zeroed values + a precomputed validity plane
+        sf = src.astype(jnp.float32)
+        validp = jnp.isfinite(sf) & (sf != nodata)
+        srcz = jnp.where(validp, sf, 0.0)
+
+        def tap(ri, ci, inb):
+            v = _gather2d_c(srcz, ri, ci)
+            ok = inb[..., None] & _gather2d_c(validp, ri, ci)
+            # zero values where ok is False: raw outputs at invalid
+            # pixels stay identical between the two kernel forms
+            return jnp.where(ok, v, 0.0), ok
 
     if method in ("near", "nearest"):
         ri = jnp.floor(rows + (0.5 + 1e-10)).astype(jnp.int32)
