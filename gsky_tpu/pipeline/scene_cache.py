@@ -5,9 +5,11 @@ The reference amortises IO with a per-process GDAL block cache
 decoded scenes in HBM.  Host->device upload is the scarcest resource when
 the accelerator sits behind a network link (measured ~10-40 MB/s with
 ~90 ms/MB serial latency), while HBM is plentiful — so each (path, band)
-source raster is decoded and shipped ONCE in its native dtype, and every
-subsequent tile request warps from the cached device array
-(`ops.warp.warp_scenes_batch`) with only a ~0.5 MB coordinate-grid upload.
+source raster is decoded and shipped ONCE — NaN-encoded f32, invalid
+pixels pre-baked to NaN so per-dispatch validity is one isnan on the
+gathered tap — and every subsequent tile request warps from the cached
+device array (`ops.warp.warp_scenes_batch`) with only a ~2 KB
+control-grid upload.
 
 Eviction is LRU by device bytes.  Scenes above ``max_scene_px`` are not
 cached (a one-off window read is cheaper than shipping the whole raster).
@@ -34,7 +36,7 @@ _scene_serial = itertools.count(1)
 
 @dataclass
 class DeviceScene:
-    dev: jax.Array            # (bh, bw) native dtype, bucket-padded
+    dev: jax.Array            # (bh, bw) f32, invalid=NaN, bucket-padded
     height: int               # true rows
     width: int                # true cols
     nodata: float             # NaN when absent
@@ -187,26 +189,31 @@ class SceneCache:
             return None
         nd = float(nodata) if nodata is not None else float("nan")
         true_h, true_w = data.shape
+        # NaN-encode ONCE at load: invalid pixels (nodata / non-finite)
+        # become NaN in an f32 scene, so every later dispatch's validity
+        # is a single isnan on the gathered tap — no per-dispatch
+        # full-scene dtype cast or nodata compare on any backend.  The
+        # f32 precision equals what the kernels always computed in
+        # (the old path cast per dispatch); memory is 2x an int16 scene,
+        # paid from the same LRU byte budget.
+        from ..ops.raster import nodata_mask
+        if data.dtype != np.float32 or not np.isnan(nd):
+            # (f32 + NaN-nodata sources are already in encoded form —
+            # skip three full-scene host passes on that common case)
+            valid = nodata_mask(data, nd if not np.isnan(nd) else None)
+            data = data.astype(np.float32)
+            # inf (incl. f64 overflowing the f32 cast) is invalid too,
+            # so the documented "validity == ~isnan" invariant holds
+            valid &= np.isfinite(data)
+            data[~valid] = np.nan
         bh, bw = _bucket(true_h), _bucket(true_w)
         if (bh, bw) != data.shape:
-            pad = np.full((bh, bw), _pad_value(data.dtype, nd), data.dtype)
+            pad = np.full((bh, bw), np.nan, np.float32)
             pad[:true_h, :true_w] = data
             data = pad
         dev = jnp.asarray(data)
         return DeviceScene(dev=dev, height=true_h, width=true_w,
-                           nodata=nd, gt=gt, crs=crs)
-
-
-def _pad_value(dtype, nodata: float):
-    """Padding for the bucket margin: nodata when representable, else the
-    dtype min (bounds checks in the kernel reject the margin anyway)."""
-    if np.issubdtype(dtype, np.floating):
-        return np.nan if np.isnan(nodata) else nodata
-    if not np.isnan(nodata):
-        info = np.iinfo(dtype)
-        if info.min <= nodata <= info.max:
-            return int(nodata)
-    return np.iinfo(dtype).min
+                           nodata=float("nan"), gt=gt, crs=crs)
 
 
 # module-level default (shared across pipelines/requests)
