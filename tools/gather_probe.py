@@ -1,0 +1,167 @@
+"""On-chip gather-strategy probe (round-5 kernel wall).
+
+The fused render's pipelined cost is ~12.8 ms/tile at the cfg3 shape —
+an effective gather rate of ~20M taps/s, far off VPU rates.  This probe
+times candidate gather formulations on the real chip so the winner can
+be integrated deliberately:
+
+  a. dispatch floor        (trivial elementwise kernel, same I/O)
+  b. flat 1D gather        (current `_gather2d` form)
+  c. window-sliced gather  (dynamic-slice the tile's src footprint,
+                            then gather from the small window — tests
+                            whether TPU gather cost scales with source
+                            size or index count)
+  d. row-blocked gather    (sort-free two-level: gather 8-row slabs
+                            with take(), then lane-select — tests the
+                            sublane-vs-lane asymmetry)
+  e. one-hot matmul        (MXU: out = sum_a onehot_r[.,a] * src[a, c]
+                            with the column gather folded into a small
+                            window — FLOP-heavy but systolic)
+
+Run on the chip, no shell timeout:  python tools/gather_probe.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    from gsky_tpu.device import ensure_platform
+    plat = ensure_platform(retries=1, timeout_s=60.0)
+    print("platform:", plat, flush=True)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    B, S = 4, 2048
+    h = w = 256
+    stack = jnp.asarray(rng.uniform(200, 3000, (B, S, S))
+                        .astype(np.float32))
+    # plausible near-identity coords with jitter, inside a 300px window
+    base = 700.0
+    rr = (base + np.linspace(0, 280, h)[None, :, None]
+          + rng.uniform(-1, 1, (B, h, w))).astype(np.float32)
+    cc = (base + np.linspace(0, 280, w)[None, None, :]
+          + rng.uniform(-1, 1, (B, h, w))).astype(np.float32)
+    rows = jnp.asarray(rr)
+    cols = jnp.asarray(cc)
+    ri_all = jnp.clip(jnp.floor(rows + 0.5).astype(jnp.int32), 0, S - 1)
+    ci_all = jnp.clip(jnp.floor(cols + 0.5).astype(jnp.int32), 0, S - 1)
+
+    def timeit(fn, *args, n=30):
+        fn(*args).block_until_ready()
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(n):
+            r = fn(*args)
+        r.block_until_ready()
+        return (time.perf_counter() - t0) / n * 1e3
+
+    # a. dispatch floor
+    @jax.jit
+    def floor_k(s, r, c):
+        return s[:, :h, :w] + r + c
+
+    print(f"a. dispatch floor:      {timeit(floor_k, stack, rows, cols):8.3f} ms",
+          flush=True)
+
+    # b. flat gather (current form)
+    @jax.jit
+    def flat_gather(s, ri, ci):
+        def per(sc, r, c):
+            return sc.reshape(-1)[r * S + c]
+        return jax.vmap(per)(s, ri, ci)
+
+    print(f"b. flat 1D gather:      {timeit(flat_gather, stack, ri_all, ci_all):8.3f} ms",
+          flush=True)
+
+    # c. window-sliced gather: host knows the footprint origin (the
+    # ctrl grid gives it); WIN static
+    WIN = 512
+    o = jnp.int32(int(base) - 8)
+
+    @jax.jit
+    def window_gather(s, ri, ci):
+        def per(sc, r, c):
+            winr = jax.lax.dynamic_slice(sc, (o, o), (WIN, WIN))
+            rl = jnp.clip(r - o, 0, WIN - 1)
+            cl = jnp.clip(c - o, 0, WIN - 1)
+            return winr.reshape(-1)[rl * WIN + cl]
+        return jax.vmap(per)(s, ri, ci)
+
+    print(f"c. window gather (512): {timeit(window_gather, stack, ri_all, ci_all):8.3f} ms",
+          flush=True)
+
+    # c2. smaller window
+    WIN2 = 384
+
+    @jax.jit
+    def window_gather2(s, ri, ci):
+        def per(sc, r, c):
+            winr = jax.lax.dynamic_slice(sc, (o, o), (WIN2, WIN2))
+            rl = jnp.clip(r - o, 0, WIN2 - 1)
+            cl = jnp.clip(c - o, 0, WIN2 - 1)
+            return winr.reshape(-1)[rl * WIN2 + cl]
+        return jax.vmap(per)(s, ri, ci)
+
+    print(f"c2. window gather (384):{timeit(window_gather2, stack, ri_all, ci_all):8.3f} ms",
+          flush=True)
+
+    # d. two-level: take rows (axis-0 gather of whole rows), then
+    # take_along_axis on the lane dim within the row window
+    @jax.jit
+    def row_then_lane(s, ri, ci):
+        def per(sc, r, c):
+            win = jax.lax.dynamic_slice(sc, (o, o), (WIN, WIN))
+            rl = jnp.clip(r - o, 0, WIN - 1)
+            cl = jnp.clip(c - o, 0, WIN - 1)
+            rowsv = jnp.take(win, rl.reshape(-1), axis=0)  # (hw, WIN)
+            return jnp.take_along_axis(
+                rowsv, cl.reshape(-1, 1), axis=1).reshape(h, w)
+        return jax.vmap(per)(s, ri, ci)
+
+    print(f"d. rows+lane (512):     {timeit(row_then_lane, stack, ri_all, ci_all):8.3f} ms",
+          flush=True)
+
+    # e. one-hot MXU: window rows onehot-matmul, then lane select via a
+    # second small one-hot (pure MXU, no gather at all)
+    WIN3 = 384
+
+    @jax.jit
+    def onehot_mxu(s, ri, ci):
+        def per(sc, r, c):
+            win = jax.lax.dynamic_slice(sc, (o, o), (WIN3, WIN3))
+            rl = jnp.clip(r - o, 0, WIN3 - 1).reshape(-1)     # (hw,)
+            cl = jnp.clip(c - o, 0, WIN3 - 1).reshape(-1)
+            oh_r = jax.nn.one_hot(rl, WIN3, dtype=jnp.bfloat16)
+            rowsv = jnp.dot(oh_r, win.astype(jnp.bfloat16),
+                            preferred_element_type=jnp.float32)
+            oh_c = jax.nn.one_hot(cl, WIN3, dtype=jnp.float32)
+            return jnp.sum(rowsv * oh_c, axis=-1).reshape(h, w)
+        return jax.vmap(per)(s, ri, ci)
+
+    print(f"e. one-hot MXU (384):   {timeit(onehot_mxu, stack, ri_all, ci_all):8.3f} ms",
+          flush=True)
+
+    # sanity: all variants agree with b (e in bf16 tolerance)
+    rb = np.asarray(flat_gather(stack, ri_all, ci_all))
+    for name, fn, tol in (("c", window_gather, 0),
+                          ("c2", window_gather2, 0),
+                          ("d", row_then_lane, 0),
+                          ("e", onehot_mxu, 16.0)):
+        got = np.asarray(fn(stack, ri_all, ci_all))
+        if tol:
+            ok = np.allclose(got, rb, atol=tol)
+        else:
+            ok = (got == rb).all()
+        print(f"   parity {name}: {ok}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

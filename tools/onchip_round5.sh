@@ -13,10 +13,13 @@ python tools/probe_device.py --label round5-onchip-pre || exit 1
 echo "== 1. drill probe (cfg5 warm-path explanation) =="
 python tools/drill_probe.py 2>&1 | tail -20
 
-echo "== 2. on-device parity tier =="
+echo "== 2. gather-strategy probe (the 12.8 ms/tile question) =="
+python tools/gather_probe.py 2>&1 | tail -12
+
+echo "== 3. on-device parity tier =="
 python -m pytest tests_tpu/ -q 2>&1 | tail -5
 
-echo "== 3. full bench (refreshes BENCH_TPU_r05_builder.json) =="
+echo "== 4. full bench (refreshes BENCH_TPU_r05_builder.json) =="
 python bench.py > BENCH_TPU_r05_builder.json 2> bench_tpu.err
 echo "bench rc=$? platform=$(python -c "
 import json; print(json.load(open('BENCH_TPU_r05_builder.json'))['platform'])")"
