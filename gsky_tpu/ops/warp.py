@@ -100,6 +100,25 @@ def _gather2d(src, ri, ci):
     return src.reshape(-1)[ri * W + ci]
 
 
+def _window_slice(arr, win, win0, axis: int):
+    """Dynamic-slice the two spatial axes (axis, axis+1) of ``arr`` to
+    the static window ``win`` = (WR, WC) at traced (2,) int32 origin
+    ``win0``.  Returns (sliced, r0f, c0f): the f32 origins callers
+    subtract from their coordinate grids — exact, because subtracting
+    an integer ≤ 4096 from an f32 coordinate < 2^12 never rounds, so
+    windowed outputs stay bit-identical to the full-scene kernel."""
+    r0 = win0[0]
+    c0 = win0[1]
+    starts = [jnp.int32(0)] * arr.ndim
+    sizes = list(arr.shape)
+    starts[axis] = r0
+    starts[axis + 1] = c0
+    sizes[axis] = win[0]
+    sizes[axis + 1] = win[1]
+    out = jax.lax.dynamic_slice(arr, tuple(starts), tuple(sizes))
+    return out, r0.astype(jnp.float32), c0.astype(jnp.float32)
+
+
 def _nearest(src, valid, rows, cols):
     H, W = src.shape
     # reference parity: the C kernel truncates (int)(px + 1e-10) in
@@ -241,29 +260,41 @@ def _bilerp_grid(ctrl, h: int, w: int, step: int, x0=0):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("method", "n_ns", "out_hw", "step"))
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "win"))
 def warp_scenes_ctrl(stack, ctrl, params, method: str = "near",
                      n_ns: int = 1, out_hw: Tuple[int, int] = (256, 256),
-                     step: int = 16):
+                     step: int = 16, win: Optional[Tuple[int, int]] = None,
+                     win0=None):
     """`warp_scenes_batch` with the coordinate grid reconstructed ON
     DEVICE from sparse control points: ctrl (2, gh, gw) f32 holds the
     origin-relative src-CRS coords of every ``step``-th dst pixel centre,
     so a 256x256 tile uploads ~2 KB of coordinates instead of 512 KB.
+
+    win/win0: optional gather window — static (WR, WC) + traced (2,)
+    int32 origin.  The executor guarantees the whole tile's gather
+    footprint (+resampling margin) fits the window; the kernel then
+    gathers from a dynamic slice of the stack instead of the full
+    scenes, which cuts the TPU gather cost (it scales with the source
+    extent, not the tap count).  Bit-identical to the unwindowed path.
     """
     h, w = out_hw
     sx = _bilerp_grid(ctrl[0], h, w, step)
     sy = _bilerp_grid(ctrl[1], h, w, step)
-    return _warp_scenes_core(stack, sx, sy, params, method, n_ns)
+    return _warp_scenes_core(stack, sx, sy, params, method, n_ns,
+                             win=win, win0=win0)
 
 
 def _render_scenes_core(stack, ctrl, params, scale_params, method: str,
                         n_ns: int, out_hw: Tuple[int, int], step: int,
-                        auto: bool, colour_scale: int):
+                        auto: bool, colour_scale: int, win=None,
+                        win0=None):
     from .scale import auto_byte_scale, scale_to_byte
     h, w = out_hw
     sx = _bilerp_grid(ctrl[0], h, w, step)
     sy = _bilerp_grid(ctrl[1], h, w, step)
-    canv, vals = _warp_scenes_core(stack, sx, sy, params, method, n_ns)
+    canv, vals = _warp_scenes_core(stack, sx, sy, params, method, n_ns,
+                                   win=win, win0=win0)
     idx = jnp.argmax(vals, axis=0)
     data = jnp.take_along_axis(canv, idx[None], axis=0)[0]
     ok = jnp.any(vals, axis=0)
@@ -284,12 +315,13 @@ def _render_scenes_core(stack, ctrl, params, scale_params, method: str,
 
 @functools.partial(jax.jit,
                    static_argnames=("method", "n_ns", "out_hw", "step",
-                                    "auto", "colour_scale"))
+                                    "auto", "colour_scale", "win"))
 def render_scenes_ctrl(stack, ctrl, params, scale_params,
                        method: str = "near", n_ns: int = 1,
                        out_hw: Tuple[int, int] = (256, 256),
                        step: int = 16, auto: bool = True,
-                       colour_scale: int = 0):
+                       colour_scale: int = 0,
+                       win: Optional[Tuple[int, int]] = None, win0=None):
     """The WHOLE GetMap tile in one dispatch: control-grid coords ->
     warp -> per-namespace newest-wins mosaic -> first-valid composite
     across namespaces -> byte scaling.  Returns the PNG-ready uint8
@@ -300,17 +332,20 @@ def render_scenes_ctrl(stack, ctrl, params, scale_params,
     scale_params: (3,) f32 [offset, scale, clip] (ignored when auto).
     """
     return _render_scenes_core(stack, ctrl, params, scale_params, method,
-                               n_ns, out_hw, step, auto, colour_scale)
+                               n_ns, out_hw, step, auto, colour_scale,
+                               win=win, win0=win0)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("method", "n_ns", "out_hw", "step",
-                                    "auto", "colour_scale"))
+                                    "auto", "colour_scale", "win"))
 def render_scenes_bands_ctrl(stack, ctrl, params, scale_params, out_sel,
                              method: str = "near", n_ns: int = 1,
                              out_hw: Tuple[int, int] = (256, 256),
                              step: int = 16, auto: bool = True,
-                             colour_scale: int = 0):
+                             colour_scale: int = 0,
+                             win: Optional[Tuple[int, int]] = None,
+                             win0=None):
     """Multi-band variant of `render_scenes_ctrl` for RGB(A) styles:
     instead of compositing namespaces it emits one scaled uint8 plane
     per selected namespace — out_sel (n_out,) int32 indexes the mosaic
@@ -321,7 +356,8 @@ def render_scenes_bands_ctrl(stack, ctrl, params, scale_params, out_sel,
     h, w = out_hw
     sx = _bilerp_grid(ctrl[0], h, w, step)
     sy = _bilerp_grid(ctrl[1], h, w, step)
-    canv, vals = _warp_scenes_core(stack, sx, sy, params, method, n_ns)
+    canv, vals = _warp_scenes_core(stack, sx, sy, params, method, n_ns,
+                                   win=win, win0=win0)
     data = canv[out_sel]
     ok = vals[out_sel]
     if auto:
@@ -437,12 +473,13 @@ def _resample_c(src, nodata, rows, cols, method: str):
 
 @functools.partial(jax.jit,
                    static_argnames=("method", "out_hw", "step", "auto",
-                                    "colour_scale"))
+                                    "colour_scale", "win"))
 def render_rgba_ctrl(scene, ctrl, param, scale_params,
                      method: str = "near",
                      out_hw: Tuple[int, int] = (256, 256),
                      step: int = 16, auto: bool = True,
-                     colour_scale: int = 0):
+                     colour_scale: int = 0,
+                     win: Optional[Tuple[int, int]] = None, win0=None):
     """Single-granule RGB fast path: one dispatch from a channel-packed
     scene (sh, sw, 3) to the PNG-ready (h, w, 4) RGBA tile.  Compared
     with `render_scenes_bands_ctrl` this computes warp indices and tap
@@ -465,6 +502,10 @@ def render_rgba_ctrl(scene, ctrl, param, scale_params,
     oob = (rows < -0.5) | (rows > p[6] - 0.5) \
         | (cols < -0.5) | (cols > p[7] - 0.5)
     rows = jnp.where(oob, jnp.nan, rows)
+    if win is not None:
+        scene, r0f, c0f = _window_slice(scene, win, win0, axis=0)
+        rows = rows - r0f
+        cols = cols - c0f
     data, ok = _resample_c(scene, p[8], rows, cols, method)
     if auto:
         if colour_scale == 1:
@@ -509,18 +550,22 @@ def render_scenes_ctrl_many(stack, ctrls, params, scale_params,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("method", "n_ns", "out_hw", "step"))
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "win"))
 def warp_scenes_ctrl_scored(stack, ctrl, params, method: str = "near",
                             n_ns: int = 1,
                             out_hw: Tuple[int, int] = (256, 256),
-                            step: int = 16):
+                            step: int = 16,
+                            win: Optional[Tuple[int, int]] = None,
+                            win0=None):
     """`warp_scenes_ctrl` that also returns the per-pixel winning
     priority — one per-source-CRS group dispatch of a multi-CRS mosaic
     (granule sets spanning UTM zones)."""
     h, w = out_hw
     sx = _bilerp_grid(ctrl[0], h, w, step)
     sy = _bilerp_grid(ctrl[1], h, w, step)
-    return _warp_scenes_scored(stack, sx, sy, params, method, n_ns)
+    return _warp_scenes_scored(stack, sx, sy, params, method, n_ns,
+                               win=win, win0=win0)
 
 
 @jax.jit
@@ -578,12 +623,23 @@ def _resample_native(src, nodata, rows, cols, method: str):
     return out[..., 0], ok[..., 0]
 
 
-def _warp_scenes_scored(stack, sx, sy, params, method: str, n_ns: int):
+def _warp_scenes_scored(stack, sx, sy, params, method: str, n_ns: int,
+                        win=None, win0=None):
     """Core warp + per-namespace mosaic returning (canvases, best) where
     ``best`` is the winning granule's mosaic priority per pixel (-inf
     where no granule contributed) — the carrier that lets partial
     mosaics from several dispatches (e.g. per-source-CRS groups) combine
-    with newest-wins semantics preserved."""
+    with newest-wins semantics preserved.
+
+    win (static (WR, WC)) + win0 (traced (2,) int32): gather from one
+    shared dynamic slice of the stack instead of the full scenes.  The
+    caller guarantees every granule's finite gather footprint (incl.
+    the 2-px cubic tap margin) lies inside the window; the origin
+    subtraction is an exact f32 op (integer ≤ 4096 off a coordinate
+    < 2^12), so outputs are bit-identical to the unwindowed kernel.
+    """
+    if win is not None:
+        stack, r0f, c0f = _window_slice(stack, win, win0, axis=1)
 
     def per(scene, p):
         cols = (p[0] + p[1] * sx + p[2] * sy) - 0.5
@@ -591,6 +647,9 @@ def _warp_scenes_scored(stack, sx, sy, params, method: str, n_ns: int):
         oob = (rows < -0.5) | (rows > p[6] - 0.5) \
             | (cols < -0.5) | (cols > p[7] - 0.5)
         rows = jnp.where(oob, jnp.nan, rows)
+        if win is not None:
+            rows = rows - r0f
+            cols = cols - c0f
         return _resample_native(scene, p[8], rows, cols, method)
 
     out, ok = jax.vmap(per)(stack, params)
@@ -612,8 +671,10 @@ def _warp_scenes_scored(stack, sx, sy, params, method: str, n_ns: int):
     return jnp.stack(canv), jnp.stack(best)
 
 
-def _warp_scenes_core(stack, sx, sy, params, method: str, n_ns: int):
-    canv, best = _warp_scenes_scored(stack, sx, sy, params, method, n_ns)
+def _warp_scenes_core(stack, sx, sy, params, method: str, n_ns: int,
+                      win=None, win0=None):
+    canv, best = _warp_scenes_scored(stack, sx, sy, params, method, n_ns,
+                                     win=win, win0=win0)
     return canv, best > -jnp.inf
 
 

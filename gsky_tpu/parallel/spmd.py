@@ -56,6 +56,15 @@ from ..ops.warp import _bilerp_grid, _warp_scenes_scored
 from .mesh import AXIS_GRANULE, AXIS_X, make_mesh
 
 
+def _win0_arr(win0):
+    """Replicated window-origin operand: the shard_map'd kernels always
+    take it (a (2,) int32; ignored when the build-time ``win`` static is
+    None) so one local() shape serves both modes."""
+    if win0 is None:
+        win0 = np.zeros(2, np.int32)
+    return jnp.asarray(np.asarray(win0, np.int32))
+
+
 def spmd_enabled() -> bool:
     """GSKY_SPMD=1 and more than one device: the pipelines then route
     their fused dispatches through the mesh."""
@@ -107,14 +116,15 @@ class SpmdRenderer:
         return stack, np.asarray(params, np.float32), wp
 
     def _build_mosaic(self, method: str, n_ns: int,
-                      out_hw: Tuple[int, int], step: int, wp: int):
+                      out_hw: Tuple[int, int], step: int, wp: int,
+                      win=None):
         """Sharded `warp_scenes_ctrl_scored`: (canv (n_ns, h, w) f32,
         best (n_ns, h, w) f32) — the WCS / modular-path carrier."""
         h, w_true = out_hw
         wl = wp // self.nx
         mesh = self.mesh
 
-        def local(stack, ctrl, params):
+        def local(stack, ctrl, params, win0):
             x0 = jax.lax.axis_index(AXIS_X) * wl
             sx = _bilerp_grid(ctrl[0], h, wl, step, x0=x0)
             sy = _bilerp_grid(ctrl[1], h, wl, step, x0=x0)
@@ -123,7 +133,8 @@ class SpmdRenderer:
             xg = x0 + jnp.arange(wl)
             sx = jnp.where(xg[None, :] < w_true, sx, jnp.nan)
             canv, best = _warp_scenes_scored(stack, sx, sy, params,
-                                             method, n_ns)
+                                             method, n_ns,
+                                             win=win, win0=win0)
             bests = jax.lax.all_gather(best, AXIS_GRANULE)
             canvs = jax.lax.all_gather(canv, AXIS_GRANULE)
             idx = jnp.argmax(bests, axis=0)
@@ -133,7 +144,8 @@ class SpmdRenderer:
 
         fn = shard_map(
             local, mesh=mesh,
-            in_specs=(P(AXIS_GRANULE, None, None), P(), P(AXIS_GRANULE)),
+            in_specs=(P(AXIS_GRANULE, None, None), P(), P(AXIS_GRANULE),
+                      P()),
             out_specs=(P(None, None, AXIS_X), P(None, None, AXIS_X)),
             check_rep=False)
         return jax.jit(fn)
@@ -141,17 +153,21 @@ class SpmdRenderer:
     # -- production entries ------------------------------------------------
 
     def mosaic_scored(self, stack, ctrl, params, method: str, n_ns: int,
-                      out_hw: Tuple[int, int], step: int):
+                      out_hw: Tuple[int, int], step: int,
+                      win=None, win0=None):
         """Sharded equivalent of `ops.warp.warp_scenes_ctrl_scored`:
-        returns (canvases (n_ns, h, w) f32, best (n_ns, h, w) f32)."""
+        returns (canvases (n_ns, h, w) f32, best (n_ns, h, w) f32).
+        win/win0: the executor's gather window (replicated across the
+        mesh; each shard slices the same window from its granule
+        shard)."""
         h, w = out_hw
         stack, params, wp = self._pad_inputs(stack, params, w)
         key = ("mosaic", method, n_ns, out_hw, step, wp,
-               stack.shape[0])
+               stack.shape[0], win)
         fn = self._get(key, lambda: self._build_mosaic(
-            method, n_ns, out_hw, step, wp))
+            method, n_ns, out_hw, step, wp, win))
         canv, best = fn(jnp.asarray(stack), jnp.asarray(ctrl),
-                        jnp.asarray(params))
+                        jnp.asarray(params), _win0_arr(win0))
         if wp != w:
             canv = canv[..., :w]
             best = best[..., :w]
@@ -159,21 +175,22 @@ class SpmdRenderer:
 
     def _build_composite(self, method: str, n_ns: int,
                          out_hw: Tuple[int, int], step: int, wp: int,
-                         auto: bool, colour_scale: int):
+                         auto: bool, colour_scale: int, win=None):
         """Sharded `render_scenes_ctrl`: the whole GetMap tile —
         warp -> mosaic -> composite -> byte scale — across the mesh."""
         h, w_true = out_hw
         wl = wp // self.nx
         mesh = self.mesh
 
-        def local(stack, ctrl, params, sp):
+        def local(stack, ctrl, params, sp, win0):
             x0 = jax.lax.axis_index(AXIS_X) * wl
             sx = _bilerp_grid(ctrl[0], h, wl, step, x0=x0)
             sy = _bilerp_grid(ctrl[1], h, wl, step, x0=x0)
             xg = x0 + jnp.arange(wl)
             sx = jnp.where(xg[None, :] < w_true, sx, jnp.nan)
             canv, best = _warp_scenes_scored(stack, sx, sy, params,
-                                             method, n_ns)
+                                             method, n_ns,
+                                             win=win, win0=win0)
             bests = jax.lax.all_gather(best, AXIS_GRANULE)
             canvs = jax.lax.all_gather(canv, AXIS_GRANULE)
             idx = jnp.argmax(bests, axis=0)
@@ -204,7 +221,7 @@ class SpmdRenderer:
         fn = shard_map(
             local, mesh=mesh,
             in_specs=(P(AXIS_GRANULE, None, None), P(), P(AXIS_GRANULE),
-                      P()),
+                      P(), P()),
             out_specs=P(None, AXIS_X),
             check_rep=False)
         return jax.jit(fn)
@@ -212,18 +229,19 @@ class SpmdRenderer:
     def render_composite(self, stack, ctrl, params, scale_params,
                          method: str, n_ns: int,
                          out_hw: Tuple[int, int], step: int, auto: bool,
-                         colour_scale: int):
+                         colour_scale: int, win=None, win0=None):
         """Sharded equivalent of `ops.warp.render_scenes_ctrl`: the
         PNG-ready uint8 (h, w) tile (exact winners, exact extrema; see
         the module determinism note)."""
         h, w = out_hw
         stack, params, wp = self._pad_inputs(stack, params, w)
         key = ("composite", method, n_ns, out_hw, step, wp,
-               stack.shape[0], auto, colour_scale)
+               stack.shape[0], auto, colour_scale, win)
         fn = self._get(key, lambda: self._build_composite(
-            method, n_ns, out_hw, step, wp, auto, colour_scale))
+            method, n_ns, out_hw, step, wp, auto, colour_scale, win))
         out = fn(jnp.asarray(stack), jnp.asarray(ctrl),
-                 jnp.asarray(params), jnp.asarray(scale_params))
+                 jnp.asarray(params), jnp.asarray(scale_params),
+                 _win0_arr(win0))
         return out[:, :w] if wp != w else out
 
     def _build_stats(self, pixel_count: bool):

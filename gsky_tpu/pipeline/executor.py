@@ -11,6 +11,7 @@ host and only the cheap affine part is per-granule.
 from __future__ import annotations
 
 import math
+import os
 import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -60,6 +61,76 @@ def _bucket_pow2(n: int, lo: int = 1) -> int:
     return b
 
 
+def _window_mode() -> bool:
+    """Gather-window gate (GSKY_WARP_WINDOW): '1' on, '0' off, default
+    'auto' = on for TPU-like backends only.  XLA's TPU gather lowering
+    costs proportional to the SOURCE extent, so slicing the tile's
+    footprint window out of the scene stack before the gather is the
+    difference between ~13 ms and ~1 ms per 256-px tile over 2048-px
+    scenes; on CPU the gather is a per-tap scalar loop and the slice is
+    pure overhead."""
+    v = os.environ.get("GSKY_WARP_WINDOW", "auto")
+    if v == "0":
+        return False
+    if v == "1":
+        return True
+    from ..ops.pallas_tpu import tpu_like_backend
+    return tpu_like_backend()
+
+
+_WIN_MARGIN = 2  # covers cubic's +2 tap and f32-vs-f64 coord rounding
+
+
+def _gather_window(params64: np.ndarray, cx: np.ndarray, cy: np.ndarray,
+                   bucket_h: int, bucket_w: int):
+    """(win, win0) covering every granule's finite gather footprint, or
+    None when windowing can't help (footprint ~ scene, or no finite
+    coords).  Exactness: the dense device coords are the bilinear
+    interpolation of the ctrl-point coords with the per-granule affine
+    applied — affine commutes with interpolation, so the dense extremes
+    are bounded by the affine evaluated at the ctrl points, computed
+    here in f64.
+
+    params64: (B, 11) f64 granule params (ns_id < 0 rows are padding);
+    cx/cy: host ctrl coords (gh, gw), possibly NaN."""
+    rmin = cmin = np.inf
+    rmax = cmax = -np.inf
+    for p in params64:
+        if p[10] < 0:
+            continue
+        # clamp to the kernel's oob thresholds (coords past the true
+        # extent are NaN-poisoned on device and never gathered): a tile
+        # straddling a scene edge must not inflate the footprint to its
+        # off-scene extent and lose the window
+        cols = np.clip(p[0] + p[1] * cx + p[2] * cy - 0.5, -1.0, p[7])
+        rows = np.clip(p[3] + p[4] * cx + p[5] * cy - 0.5, -1.0, p[6])
+        ok = np.isfinite(rows) & np.isfinite(cols)
+        if not ok.any():
+            continue
+        rmin = min(rmin, float(rows[ok].min()))
+        rmax = max(rmax, float(rows[ok].max()))
+        cmin = min(cmin, float(cols[ok].min()))
+        cmax = max(cmax, float(cols[ok].max()))
+    if not np.isfinite(rmin) or not np.isfinite(cmin):
+        return None
+    r_lo = math.floor(rmin) - _WIN_MARGIN
+    c_lo = math.floor(cmin) - _WIN_MARGIN
+    # high edge gets one extra pixel: the device recomputes coords in
+    # f32, which can land just past the f64 bound and bump floor() by
+    # one, pushing cubic's +2 tap one past _WIN_MARGIN
+    wr = min(_bucket(math.floor(rmax) + _WIN_MARGIN + 2 - r_lo), bucket_h)
+    wc = min(_bucket(math.floor(cmax) + _WIN_MARGIN + 2 - c_lo), bucket_w)
+    if wr >= bucket_h and wc >= bucket_w:
+        return None
+    r0 = min(max(r_lo, 0), bucket_h - wr)
+    c0 = min(max(c_lo, 0), bucket_w - wc)
+    return (wr, wc), np.array([r0, c0], np.int32)
+
+
+def _dev_win0(win0):
+    return None if win0 is None else jnp.asarray(win0)
+
+
 def _inv_gt_params(gt: GeoTransform, ox: float, oy: float):
     """Origin-folded inverse geotransform (src-CRS coords relative to
     (ox, oy) -> granule pixel): the 6-tuple every scene kernel takes in
@@ -92,8 +163,24 @@ class WarpExecutor:
         # dispatch counters by (path, shape bucket) — the /debug
         # side-door's "where do renders actually go" answer
         self.bucket_stats: Dict[str, int] = {}
+        # gather-window engagement (window mode on): groups that got a
+        # window vs groups that declined (footprint ~ scene / no coords)
+        self.win_engaged = 0
+        self.win_declined = 0
         from .batcher import RenderBatcher
         self._batcher = RenderBatcher()
+
+    def _note_win(self, win) -> None:
+        """Engagement telemetry, recorded at the dispatches that
+        actually pass ``win`` to a kernel (the batcher branch drops the
+        window and must not count as engaged)."""
+        if not _window_mode():
+            return
+        with self._lock:
+            if win is not None:
+                self.win_engaged += 1
+            else:
+                self.win_declined += 1
 
     def _count(self, path: str, bucket=None) -> None:
         key = f"{path}:{bucket}" if bucket is not None else path
@@ -359,30 +446,37 @@ class WarpExecutor:
             return None
         n_pad = _bucket_pow2(n_ns)
         if len(groups) == 1:
-            stack, _, params, step, _, ctrl_dev = groups[0]
+            stack, _, params, step, _, ctrl_dev, win, win0 = groups[0]
             spmd = default_spmd()
             if spmd is not None:
                 # mesh path (GSKY_SPMD=1): granule axis over `granule`,
                 # width over `x` — the production fused mosaic on
                 # 1..N chips (SURVEY §2.8 P5/P6 on ICI)
-                self._count("scene_mosaic_spmd", stack.shape)
+                self._count("scene_mosaic_spmd", (stack.shape, win))
+                self._note_win(win)
                 canv, best = spmd.mosaic_scored(
                     stack, ctrl_dev, params, method, n_pad,
-                    (height, width), step)
+                    (height, width), step, win=win, win0=win0)
                 return canv, best > -jnp.inf
-            self._count("scene_mosaic", stack.shape)
+            self._count("scene_mosaic", (stack.shape, win))
+            self._note_win(win)
             return warp_scenes_ctrl(stack, ctrl_dev,
                                     jnp.asarray(params), method,
-                                    n_pad, (height, width), step)
+                                    n_pad, (height, width), step,
+                                    win=win, win0=_dev_win0(win0))
         # multi-CRS granule set (e.g. scenes across UTM zones): one
         # scored dispatch per source-CRS group, then a per-pixel
         # priority combine — newest-wins survives the grouping because
         # each partial carries its winners' priorities
         self._count("scene_mosaic_multicrs", len(groups))
+        for g in groups:
+            self._note_win(g[6])
         parts = [warp_scenes_ctrl_scored(
                     stack, ctrl_dev, jnp.asarray(params),
-                    method, n_pad, (height, width), step)
-                 for stack, _, params, step, _, ctrl_dev in groups]
+                    method, n_pad, (height, width), step,
+                    win=win, win0=_dev_win0(win0))
+                 for stack, _, params, step, _, ctrl_dev, win, win0
+                 in groups]
         canvs = jnp.stack([p[0] for p in parts])
         bests = jnp.stack([p[1] for p in parts])
         return combine_scored(canvs, bests)
@@ -402,26 +496,33 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, ctrl, params, step, skey, ctrl_dev = made
+        stack, ctrl, params, step, skey, ctrl_dev, win, win0 = made
         sp = np.array([offset, scale, clip], np.float32)
         statics = (method, _bucket_pow2(n_ns), (height, width), step,
                    auto, colour_scale)
         spmd = default_spmd()
         if spmd is not None:
-            self._count("render_byte_spmd", stack.shape)
+            self._count("render_byte_spmd", (stack.shape, win))
+            self._note_win(win)
             return _prefetch(spmd.render_composite(
-                stack, ctrl_dev, params, sp, *statics))
-        self._count("render_byte", stack.shape)
+                stack, ctrl_dev, params, sp, *statics,
+                win=win, win0=win0))
         from .batcher import batching_enabled
         if batching_enabled():
+            # batched tiles share one dispatch: no per-tile window, and
+            # the counter must say so (win would misreport engagement)
+            self._count("render_byte", (stack.shape, None))
             # scene-serial key (not id()): address reuse after eviction
             # must never coalesce a request into another stack's batch
+            # (batched tiles share one dispatch, so no per-tile window)
             key = skey + statics
             return self._batcher.render(key, stack, ctrl, params, sp,
                                         statics)
+        self._count("render_byte", (stack.shape, win))
+        self._note_win(win)
         out = render_scenes_ctrl(stack, ctrl_dev,
                                  jnp.asarray(params), jnp.asarray(sp),
-                                 *statics)
+                                 *statics, win=win, win0=_dev_win0(win0))
         return _prefetch(out)
 
     def render_bands_byte(self, granules, ns_ids: Sequence[int],
@@ -440,14 +541,15 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, _, params, step, _, ctrl_dev = made
-        self._count("render_bands", stack.shape)
+        stack, _, params, step, _, ctrl_dev, win, win0 = made
+        self._count("render_bands", (stack.shape, win))
+        self._note_win(win)
         sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
         sel = jnp.asarray(np.asarray(out_sel, np.int32))
         return _prefetch(render_scenes_bands_ctrl(
             stack, ctrl_dev, jnp.asarray(params), sp, sel,
             method, _bucket_pow2(n_ns), (height, width), step, auto,
-            colour_scale))
+            colour_scale, win=win, win0=_dev_win0(win0)))
 
     def render_rgba_byte(self, granules, out_sel: Sequence[int],
                          dst_gt: GeoTransform, dst_crs: CRS,
@@ -522,15 +624,28 @@ class WarpExecutor:
                 self._stack_cache.move_to_end(skey)
                 while len(self._stack_cache) > self._STACK_CACHE_MAX:
                     self._stack_cache.popitem(last=False)
-        param = np.array(_inv_gt_params(s0.gt, ox, oy)
-                         + (s0.height, s0.width, s0.nodata, 0.0, 0.0),
+        inv = _inv_gt_params(s0.gt, ox, oy)
+        param = np.array(inv + (s0.height, s0.width, s0.nodata, 0.0, 0.0),
                          np.float32)
+        win = win0 = None
+        if _window_mode():
+            # window bound from the SAME param row the kernel consumes
+            # (prio/ns slots are 0, so _gather_window reads it as one
+            # non-padding granule)
+            made_w = _gather_window(param.astype(np.float64)[None, :],
+                                    sx - ox, sy - oy,
+                                    int(packed.shape[0]),
+                                    int(packed.shape[1]))
+            if made_w is not None:
+                win, win0 = made_w
         from ..ops.warp import render_rgba_ctrl
-        self._count("render_rgba", packed.shape)
+        self._count("render_rgba", (packed.shape, win))
+        self._note_win(win)
         sp = np.array([offset, scale, clip], np.float32)
         return _prefetch(render_rgba_ctrl(
             packed, ctrl_dev, jnp.asarray(param), jnp.asarray(sp),
-            method, (height, width), step, auto, colour_scale))
+            method, (height, width), step, auto, colour_scale,
+            win=win, win0=_dev_win0(win0)))
 
     def _scene_inputs(self, granules, ns_ids, prios, dst_gt, dst_crs,
                       height, width, cache=None):
@@ -689,8 +804,16 @@ class WarpExecutor:
                     self._stack_cache.move_to_end(skey)
                     while len(self._stack_cache) > self._STACK_CACHE_MAX:
                         self._stack_cache.popitem(last=False)
+            win = win0 = None
+            if _window_mode():
+                made_w = _gather_window(
+                    params, np.asarray(ctrl[0], np.float64),
+                    np.asarray(ctrl[1], np.float64),
+                    int(stack.shape[1]), int(stack.shape[2]))
+                if made_w is not None:
+                    win, win0 = made_w
             groups.append((stack, ctrl, params.astype(np.float32), step,
-                           skey, ctrl_dev))
+                           skey, ctrl_dev, win, win0))
         return groups
 
 

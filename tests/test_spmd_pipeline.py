@@ -84,6 +84,28 @@ class TestSpmdRender:
         mism = np.mean(out_s != out_1)
         assert mism <= 0.001, f"{mism:.3%} bytes differ"
 
+    def test_composite_with_gather_window(self, archive, spmd_on,
+                                          monkeypatch):
+        """SPMD + gather window (GSKY_WARP_WINDOW=1): the replicated
+        window origin must slice identically on every shard — mesh
+        result == unwindowed single-device result."""
+        monkeypatch.setenv("GSKY_WARP_WINDOW", "1")
+        mas = MASClient(archive["store"])
+        ex = WarpExecutor()
+        out_s = TilePipeline(mas, executor=ex) \
+            .render_composite_byte(_tile_req(archive), auto=True)
+        assert out_s is not None
+        out_s = np.asarray(out_s)
+        # the parity must not pass vacuously: a window really engaged
+        assert ex.win_engaged > 0 and ex.win_declined == 0, \
+            (ex.win_engaged, ex.win_declined)
+        monkeypatch.setenv("GSKY_SPMD", "0")
+        monkeypatch.setenv("GSKY_WARP_WINDOW", "0")
+        out_1 = TilePipeline(mas, executor=WarpExecutor()) \
+            .render_composite_byte(_tile_req(archive), auto=True)
+        mism = np.mean(out_s != np.asarray(out_1))
+        assert mism <= 0.001, f"{mism:.3%} bytes differ"
+
     def test_process_path_mosaic(self, archive, spmd_on, monkeypatch):
         """The modular/WCS path (process() -> TileResult) through the
         sharded scored mosaic == single-device canvases."""

@@ -149,6 +149,51 @@ def main():
     print(f"e. one-hot MXU (384):   {timeit(onehot_mxu, stack, ri_all, ci_all):8.3f} ms",
           flush=True)
 
+    # f. PRODUCTION kernel, full vs gather-window (the round-5
+    # GSKY_WARP_WINDOW path): the number that decides the default
+    from gsky_tpu.pipeline.executor import _gather_window
+    from gsky_tpu.ops.warp import render_scenes_ctrl
+
+    step = 16
+    gh = gw = (256 - 1 + step - 1) // step + 1
+    cc2, rr2 = np.meshgrid(np.arange(gw, dtype=np.float64) * step,
+                           np.arange(gh, dtype=np.float64) * step)
+    sxc = 10.0 + 1.1 * cc2 + 3.0 * np.sin(rr2 / 97.0)
+    syc = 20.0 + 1.07 * rr2 + 2.0 * np.cos(cc2 / 53.0)
+    ctrl = jnp.asarray(np.stack([sxc, syc]).astype(np.float32))
+    params = np.zeros((B, 11), np.float64)
+    for k in range(B):
+        params[k, :6] = (560.0 + 7.0 * k, 1.0, 0.015, 590.0, 0.01, 1.02)
+        params[k, 6] = S - 80
+        params[k, 7] = S - 60
+        params[k, 8] = -999.0
+        params[k, 9] = 10.0 + k
+        params[k, 10] = k % 2
+    made = _gather_window(params, sxc, syc, S, S)
+    p32 = jnp.asarray(params.astype(np.float32))
+    sp = jnp.asarray(np.zeros(3, np.float32))
+
+    def prod_full():
+        return render_scenes_ctrl(stack, ctrl, p32, sp, "near", 2,
+                                  (h, w), step, True, 0)
+
+    print(f"f1. production full:    {timeit(prod_full):8.3f} ms",
+          flush=True)
+    if made is not None:
+        winf, win0f = made
+        w0d = jnp.asarray(win0f)
+
+        def prod_win():
+            return render_scenes_ctrl(stack, ctrl, p32, sp, "near", 2,
+                                      (h, w), step, True, 0,
+                                      win=winf, win0=w0d)
+
+        print(f"f2. production window{winf}: {timeit(prod_win):8.3f} ms",
+              flush=True)
+        pf = np.asarray(prod_full())
+        pw = np.asarray(prod_win())
+        print(f"   parity f: {(pf == pw).all()}", flush=True)
+
     # sanity: all variants agree with b (e in bf16 tolerance)
     rb = np.asarray(flat_gather(stack, ri_all, ci_all))
     for name, fn, tol in (("c", window_gather, 0),

@@ -63,12 +63,25 @@ def scan_ports():
     return open_ports
 
 
-def probe(timeout=90.0, label=""):
+def probe(timeout=90.0, label="", tcp_only=False):
     rec = {
         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "label": label,
         "open_relay_ports": scan_ports(),
     }
+    if not rec["open_relay_ports"]:
+        # Zero relay ports answering: the PJRT probe would only hang for
+        # `timeout` seconds and then SIGKILL a jax client — the DEVICE.md
+        # round-5 wedge trigger.  Record the port evidence and stop.
+        rec["status"] = "down-ports"
+        with open(LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return rec
+    if tcp_only:
+        rec["status"] = "ports-open"
+        with open(LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return rec
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # let sitecustomize pick axon
     env["JAX_PLATFORMS"] = "axon"
@@ -96,7 +109,9 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--timeout", type=float, default=90.0)
     ap.add_argument("--label", default="")
+    ap.add_argument("--tcp-only", action="store_true",
+                    help="port scan only; never start a jax subprocess")
     args = ap.parse_args()
-    rec = probe(args.timeout, args.label)
+    rec = probe(args.timeout, args.label, tcp_only=args.tcp_only)
     print(json.dumps(rec, indent=2))
-    sys.exit(0 if rec["status"] == "up" else 1)
+    sys.exit(0 if rec["status"] in ("up", "ports-open") else 1)
