@@ -471,6 +471,28 @@ def bench_kernels():
         "chip_tiles_per_s": round(1e3 / pipe_ms, 1),
         "approx_hbm_gbps": round(traffic / (pipe_ms * 1e-3) / 1e9, 2)}
 
+    # --- same render through the gather window (GSKY_WARP_WINDOW
+    # path): the full-vs-window split is the direct measure of how much
+    # of the kernel wall is gather-source extent
+    from gsky_tpu.pipeline.executor import _gather_window
+    ctrl_np = np.asarray(ctrl, np.float64)
+    made_w = _gather_window(np.asarray(params, np.float64),
+                            ctrl_np[0], ctrl_np[1], S, S)
+    if made_w is not None:
+        winb, win0b = made_w
+        win0_dev = jnp.asarray(win0b)
+
+        def render_win():
+            return render_scenes_ctrl(stack, ctrl, params, sp, "near",
+                                      1, (h, w), 16, True, 0,
+                                      win=winb, win0=win0_dev)
+
+        sync_ms, pipe_ms = timeit(render_win)
+        out["render_mosaic_256_win"] = {
+            "window": list(winb),
+            "sync_ms": sync_ms, "pipelined_ms": pipe_ms,
+            "chip_tiles_per_s": round(1e3 / pipe_ms, 1)}
+
     # --- batched N-tile render (the RenderBatcher kernel): how much of
     # the per-tile cost is per-dispatch overhead the batcher amortises
     from gsky_tpu.ops.warp import render_scenes_ctrl_many
@@ -506,6 +528,23 @@ def bench_kernels():
         "sync_ms": sync_ms, "pipelined_ms": pipe_ms,
         "chip_tiles_per_s": round(1e3 / pipe_ms, 1),
         "approx_hbm_gbps": round(traffic / (pipe_ms * 1e-3) / 1e9, 2)}
+
+    made_w = _gather_window(np.asarray(param1, np.float64)[None, :],
+                            ctrl_np[0], ctrl_np[1], S, S)
+    if made_w is not None:
+        winr, win0r = made_w
+        win0r_dev = jnp.asarray(win0r)
+
+        def render_rgb_win():
+            return render_rgba_ctrl(rgb, ctrl, param1, sp, "bilinear",
+                                    (h, w), 16, True, 0,
+                                    win=winr, win0=win0r_dev)
+
+        sync_ms, pipe_ms = timeit(render_rgb_win)
+        out["render_rgba_256_win"] = {
+            "window": list(winr),
+            "sync_ms": sync_ms, "pipelined_ms": pipe_ms,
+            "chip_tiles_per_s": round(1e3 / pipe_ms, 1)}
 
     # --- drill reductions from a resident (1000, 128, 128) f32 stack
     T, H, W = DRILL_STEPS, 128, 128

@@ -186,6 +186,59 @@ def _():
         assert mism < 0.001, f"band {i}: {mism:.2%}"
 
 
+@check("window_render_bit_parity")
+def _():
+    """Gather-window path vs full-scene path ON THE CHIP: the window is
+    a pure re-indexing, so the byte tiles must be IDENTICAL under the
+    real TPU lowering (the production default enables it there)."""
+    from gsky_tpu.ops.warp import render_scenes_ctrl
+    from gsky_tpu.pipeline.executor import _gather_window
+    # 1024-px scenes: the ~350-px footprint buckets to 512 < scene, so
+    # the window engages (at 512 it would bucket to the whole scene)
+    stack, ctrl, params = _render_inputs(S=1024)
+    sp = np.zeros(3, np.float32)
+    made = _gather_window(params.astype(np.float64),
+                          ctrl[0].astype(np.float64),
+                          ctrl[1].astype(np.float64),
+                          stack.shape[1], stack.shape[2])
+    assert made is not None, "window must engage at this shape"
+    win, win0 = made
+    kw = dict(method="cubic", n_ns=2, out_hw=(256, 256), step=16,
+              auto=True, colour_scale=0)
+    full = np.asarray(render_scenes_ctrl(
+        jnp.asarray(stack), jnp.asarray(ctrl), jnp.asarray(params),
+        jnp.asarray(sp), **kw))
+    wind = np.asarray(render_scenes_ctrl(
+        jnp.asarray(stack), jnp.asarray(ctrl), jnp.asarray(params),
+        jnp.asarray(sp), **kw, win=win, win0=jnp.asarray(win0)))
+    np.testing.assert_array_equal(full, wind)
+
+
+@check("window_rgba_bit_parity")
+def _():
+    from gsky_tpu.ops.warp import render_rgba_ctrl
+    from gsky_tpu.pipeline.executor import _gather_window
+    S = 1024
+    scene = rng.uniform(200, 3000, (S, S, 3)).astype(np.int16)
+    _, ctrl, _ = _render_inputs()
+    param = np.array([0, 1, 0, 0, 0, 1, S, S, 230.0, 0, 0], np.float32)
+    sp = np.zeros(3, np.float32)
+    made = _gather_window(param.astype(np.float64)[None, :],
+                          ctrl[0].astype(np.float64),
+                          ctrl[1].astype(np.float64), S, S)
+    assert made is not None, "window must engage at this shape"
+    win, win0 = made
+    kw = dict(method="bilinear", out_hw=(256, 256), step=16, auto=True,
+              colour_scale=0)
+    full = np.asarray(render_rgba_ctrl(
+        jnp.asarray(scene), jnp.asarray(ctrl), jnp.asarray(param),
+        jnp.asarray(sp), **kw))
+    wind = np.asarray(render_rgba_ctrl(
+        jnp.asarray(scene), jnp.asarray(ctrl), jnp.asarray(param),
+        jnp.asarray(sp), **kw, win=win, win0=jnp.asarray(win0)))
+    np.testing.assert_array_equal(full, wind)
+
+
 # --- mosaic semantics -----------------------------------------------------
 
 @check("mosaic_newest_wins")
@@ -319,8 +372,8 @@ def _():
 
 @check("band_expr_ndvi")
 def _():
-    from gsky_tpu.ops.expr import BandExpressions
-    be = BandExpressions(["ndvi = (nir - red) / (nir + red)"])
+    from gsky_tpu.ops.expr import parse_band_expressions
+    be = parse_band_expressions(["ndvi = (nir - red) / (nir + red)"])
     nir = rng.uniform(0, 1, (128, 128)).astype(np.float32)
     red = rng.uniform(0, 1, (128, 128)).astype(np.float32)
     v = rng.uniform(0, 1, (128, 128)) > 0.2

@@ -20,6 +20,7 @@ CHECK_NAMES = [
     "warp_nearest", "warp_bilinear", "warp_cubic",
     "fused_mosaic_render", "fused_rgba_render",
     "rgba_matches_planes_on_chip",
+    "window_render_bit_parity", "window_rgba_bit_parity",
     "mosaic_newest_wins", "mosaic_weighted_fusion",
     "pallas_masked_stats_vs_xla", "pallas_mosaic_vs_xla",
     "drill_window_gather_stats", "deciles_device_vs_host",
