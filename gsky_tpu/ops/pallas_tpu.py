@@ -82,6 +82,38 @@ _PROVEN: dict = {}
 # the faulting kernel — this bounds that failure window to < _RESYNC
 # requests before the kernel falls back to XLA for good
 _RESYNC = 64
+# (name, token) pairs whose pallas kernel MEASURED slower than the XLA
+# fallback in the first-call race: a Pallas kernel that compiles and
+# answers correctly can still lose to XLA's lowering at a given shape
+# (grid/tiling mismatch), and "works" must not beat "faster"
+_SLOW: set = set()
+# demote only on a clear loss: both race legs carry the same dispatch
+# round-trip overhead (tens of ms on a tunneled device), so small
+# kernel-time differences disappear into it and the default stays pallas
+_RACE_MARGIN = 1.3
+
+
+def _proven_put(name, token, cnt):
+    """Bounded insert: WMS/WCS request sizes are arbitrary, so a
+    long-lived server would otherwise grow the map forever."""
+    while len(_PROVEN) >= 4096:
+        _PROVEN.pop(next(iter(_PROVEN)))
+    _PROVEN[(name, token)] = cnt
+
+
+def _timed_best(thunk, n=2):
+    """(result, best seconds over ``n`` timed runs after one warm-up
+    run) — the warm-up pays jit compilation, and min-of-n keeps a
+    one-off stall (relay hiccup, host scheduling) from mis-deciding the
+    race with a false demotion."""
+    import time as _time
+    r = jax.block_until_ready(thunk())
+    best = float("inf")
+    for _ in range(n):
+        t0 = _time.perf_counter()
+        r = jax.block_until_ready(thunk())
+        best = min(best, _time.perf_counter() - t0)
+    return r, best
 
 
 def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
@@ -98,20 +130,54 @@ def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
     every ``_RESYNC``-th call thereafter, so a kernel that starts
     faulting under load still reaches the blacklist; in between,
     dispatches stay async so the pipeline doesn't serialise on a host
-    sync per call."""
+    sync per call.  The first call also RACES the two implementations
+    (second-invocation timings, so compilation doesn't bias it) and
+    demotes the pallas kernel at that (name, token) when it loses by
+    more than ``_RACE_MARGIN`` — correctness-equivalent paths should
+    compete on speed, not default on provenance."""
     if name in _FAILED or not use_pallas():
         return xla_thunk()
+    if sync_token is not None and (name, sync_token) in _SLOW:
+        return xla_thunk()
     try:
+        if sync_token is not None \
+                and (name, sync_token) not in _PROVEN:
+            # first call per (kernel, shape): materialising correctness
+            # sync AND a speed race against the XLA fallback — a pallas
+            # kernel that measures clearly slower (tiling mismatch at
+            # this shape) is demoted for the process, because the
+            # fallback exists to give callers the best correct answer,
+            # not to prefer pallas unconditionally.  Callers pass
+            # BUCKETED shapes as tokens (padded pow2 batch x shape
+            # buckets), so the race runs a bounded number of times, not
+            # per request
+            r, tp = _timed_best(pallas_thunk)
+            _proven_put(name, sync_token, 2)
+            try:
+                rx, tx = _timed_best(xla_thunk)
+            except Exception:  # noqa: BLE001 - race leg only
+                return r       # XLA leg failing never demotes pallas
+            if tp > tx * _RACE_MARGIN:
+                # drop the _PROVEN entry: if _SLOW ever evicts this
+                # key, the next call re-races instead of finding a
+                # "proven" entry and dispatching the slow kernel async
+                _PROVEN.pop((name, sync_token), None)
+                while len(_SLOW) >= 4096:
+                    _SLOW.pop()
+                _SLOW.add((name, sync_token))
+                import warnings
+                warnings.warn(
+                    f"pallas kernel {name!r} measured {tp * 1e3:.1f} ms"
+                    f" vs XLA {tx * 1e3:.1f} ms at {sync_token}; using"
+                    " XLA for this shape", stacklevel=2)
+                return rx
+            return r
         r = pallas_thunk()
         if sync_token is not None:
             cnt = _PROVEN.get((name, sync_token), 0)
             if cnt % _RESYNC == 0:
                 r = jax.block_until_ready(r)
-            # bounded: WMS/WCS request sizes are arbitrary, so a
-            # long-lived server would otherwise grow this forever
-            while len(_PROVEN) >= 4096:
-                _PROVEN.pop(next(iter(_PROVEN)))
-            _PROVEN[(name, sync_token)] = cnt + 1
+            _proven_put(name, sync_token, cnt + 1)
         return r
     except Exception as e:  # noqa: BLE001 - any compile/runtime failure
         _FAILED.add(name)

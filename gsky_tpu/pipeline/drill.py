@@ -583,8 +583,16 @@ def _stats_tail(dataf, validf, req: GeoDrillRequest):
         return np.asarray(v), np.asarray(c)
 
     if not req.pixel_count:
+        # sync_token engages the fallback guard's first-call speed race
+        # too: at deep-stack shapes (1000, 16k) the pallas reduction is
+        # the prime suspect for the r5 on-chip warm-drill outlier, and
+        # the race demotes it automatically wherever XLA measures
+        # faster.  The shape is BUCKETED (`_drill_device` pads the band
+        # axis to pow2 and the window to shape buckets), so the token
+        # cardinality — and with it the number of races — is bounded
         vals, counts = run_with_fallback(
-            "masked_stats", _via_pallas, _via_xla)
+            "masked_stats", _via_pallas, _via_xla,
+            sync_token=tuple(dataf.shape))
     else:
         vals, counts = _via_xla()
     if req.deciles:

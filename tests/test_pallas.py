@@ -114,6 +114,75 @@ class TestRunWithFallback:
             pt._FAILED.discard("test_kernel")
         assert calls == {"pallas": 1, "xla": 2}
 
+    def test_speed_race_demotes_slow_pallas(self):
+        """First call per (kernel, shape) races pallas against the XLA
+        fallback; a clear loser is demoted for the process — 'works'
+        must not beat 'faster' (the r5 warm-drill lesson)."""
+        import time as _t
+
+        from gsky_tpu.ops import pallas_tpu as pt
+
+        calls = {"pallas": 0, "xla": 0}
+
+        def slow_pallas():
+            calls["pallas"] += 1
+            _t.sleep(0.05)
+            return np.float32(1.0)
+
+        def fast_xla():
+            calls["xla"] += 1
+            return np.float32(1.0)
+
+        key = ("race_kernel", (8, 8))
+        orig = pt.use_pallas
+        pt.use_pallas = lambda: True
+        try:
+            with pytest.warns(UserWarning, match="race_kernel"):
+                pt.run_with_fallback("race_kernel", slow_pallas,
+                                     fast_xla, sync_token=(8, 8))
+            assert key in pt._SLOW
+            p_before = calls["pallas"]
+            pt.run_with_fallback("race_kernel", slow_pallas, fast_xla,
+                                 sync_token=(8, 8))
+            assert calls["pallas"] == p_before  # demoted: straight XLA
+        finally:
+            pt.use_pallas = orig
+            pt._SLOW.discard(key)
+            pt._PROVEN.pop(key, None)
+
+    def test_speed_race_keeps_fast_pallas(self):
+        import time as _t
+
+        from gsky_tpu.ops import pallas_tpu as pt
+
+        calls = {"pallas": 0, "xla": 0}
+
+        def fast_pallas():
+            calls["pallas"] += 1
+            return np.float32(1.0)
+
+        def slow_xla():
+            calls["xla"] += 1
+            _t.sleep(0.05)
+            return np.float32(2.0)
+
+        key = ("race_kernel2", (4, 4))
+        orig = pt.use_pallas
+        pt.use_pallas = lambda: True
+        try:
+            r = pt.run_with_fallback("race_kernel2", fast_pallas,
+                                     slow_xla, sync_token=(4, 4))
+            assert float(r) == 1.0 and key not in pt._SLOW
+            x_before = calls["xla"]
+            r = pt.run_with_fallback("race_kernel2", fast_pallas,
+                                     slow_xla, sync_token=(4, 4))
+            assert float(r) == 1.0
+            assert calls["xla"] == x_before     # steady state: no XLA
+        finally:
+            pt.use_pallas = orig
+            pt._SLOW.discard(key)
+            pt._PROVEN.pop(key, None)
+
     def test_disabled_goes_straight_to_xla(self):
         from gsky_tpu.ops import pallas_tpu as pt
 

@@ -100,8 +100,9 @@ def main():
         t3 = time.time()
         print(f"iter{i}: gather {t1 - t:.3f}s  pallas_stats "
               f"{t2 - t1:.3f}s  xla_stats {t3 - t2:.3f}s", flush=True)
-    from gsky_tpu.ops.pallas_tpu import _FAILED
+    from gsky_tpu.ops.pallas_tpu import _FAILED, _SLOW
     print("pallas blacklist:", _FAILED, flush=True)
+    print("pallas race demotions:", _SLOW, flush=True)
 
 
 if __name__ == "__main__":
