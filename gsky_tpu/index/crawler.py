@@ -152,6 +152,56 @@ def extract_gmt(path: str, approx_stats: bool = False) -> Dict:
     return {"filename": path, "file_type": "GMT", "geo_metadata": [ds]}
 
 
+def extract_hdf4(path: str, approx_stats: bool = False) -> Dict:
+    """MAS record for an HDF4 / HDF-EOS grid file (the MODIS family the
+    reference serves through GDAL's HDF4 driver): one namespace per
+    scientific data set, georeferenced from StructMetadata.0 when
+    present (sinusoidal or geographic), else pixel space for rulesets
+    to override.  Timestamps come from the filename (the MODIS
+    ``AYYYYDDD`` pattern is in `_TIME_PATTERNS`)."""
+    from ..geo.crs import EPSG4326
+    from ..io.hdf4 import HDF4
+
+    with HDF4(path) as h:
+        stem = sanitize_namespace(
+            os.path.splitext(os.path.basename(path))[0])
+        ts = timestamp_from_filename(path)
+        gt = h.gt or GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, 1.0)
+        crs = h.crs or EPSG4326
+        geo_md = []
+        for b, s in enumerate(h.sds, start=1):
+            if len(s.dims) < 2:
+                continue
+            hh, ww = int(s.dims[-2]), int(s.dims[-1])
+            ns = sanitize_namespace(s.name) or (
+                stem if len(h.sds) == 1 else f"sds_{b}")
+            ds = {
+                # the trailing :band index is what granule expansion
+                # (and the drill indexer) recover the band from — the
+                # store has no band column (`granule.py:60-63`)
+                "ds_name": f'HDF4:"{path}":{s.name}:{b}',
+                "namespace": ns,
+                "array_type": NP_TO_GDAL.get(
+                    np.dtype(s.dtype.newbyteorder("=")), "Float32"),
+                "proj_wkt": crs.to_wkt(),
+                "proj4": crs.to_proj4(),
+                "geotransform": list(gt.to_gdal()),
+                "x_size": ww,
+                "y_size": hh,
+                "polygon": _polygon_wkt(gt, ww, hh),
+                "timestamps": [ts] if ts else [],
+                "timestamps_source": "filename" if ts else "",
+                "nodata": s.fill,
+                "band": b,
+                "overviews": None,
+            }
+            if approx_stats:
+                ds.update(_approx_stats(h.read(b), s.fill))
+            geo_md.append(ds)
+    return {"filename": path, "file_type": "HDF4",
+            "geo_metadata": geo_md}
+
+
 def extract_raster(path: str, approx_stats: bool = False) -> Dict:
     """MAS record via the format registry's adapter tier (JP2, PNG,
     HDF4-via-GDAL, ... — whatever `io.registry` resolves): the
@@ -433,11 +483,13 @@ def extract(path: str, approx_stats: bool = False,
         elif low.endswith((".tif", ".tiff", ".gtiff")):
             rec = extract_geotiff(path, approx_stats=approx_stats)
         else:
-            # sniff
+            # sniff (.hdf may be HDF4 *or* HDF5-based, so magic decides)
             with open(path, "rb") as fp:
                 magic = fp.read(8)
             if magic[:3] == b"CDF" or magic[:8] == b"\x89HDF\r\n\x1a\n":
                 rec = _nc_or_gmt()
+            elif magic[:4] == b"\x0e\x03\x13\x01":
+                rec = extract_hdf4(path, approx_stats=approx_stats)
             elif magic[:4] in (b"II*\0", b"MM\0*", b"II+\0", b"MM\0+"):
                 rec = extract_geotiff(path, approx_stats=approx_stats)
             else:
@@ -507,8 +559,10 @@ def main(argv=None):
             paths += [line.strip() for line in sys.stdin if line.strip()]
         elif os.path.isdir(p):
             exts = [".tif", ".tiff", ".nc", ".nc4",
-                    # registry-served formats: GMT grids + adapter tier
-                    ".grd", ".jp2", ".j2k", ".png", ".jpg", ".jpeg"]
+                    # registry-served formats: GMT grids, HDF4 (MODIS),
+                    # + adapter tier
+                    ".grd", ".hdf", ".jp2", ".j2k", ".png", ".jpg",
+                    ".jpeg"]
             if args.sentinel2_yaml or args.landsat_yaml:
                 exts += [".yaml", ".yml"]
             for root, _, files in os.walk(p):

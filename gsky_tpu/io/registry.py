@@ -93,8 +93,18 @@ def _open_gmt(path: str):
     return GMTGrid(path)
 
 
+def _sniff_hdf4(path: str, magic: bytes) -> bool:
+    return magic[:4] == b"\x0e\x03\x13\x01"
+
+
+def _open_hdf4(path: str):
+    from .hdf4 import HDF4
+    return HDF4(path)
+
+
 register("geotiff", _sniff_tiff, _open_tiff)
 register("gmt", _sniff_gmt, _open_gmt)
+register("hdf4", _sniff_hdf4, _open_hdf4)
 # NetCDF proper stays on the dedicated NetCDF facade (variables +
 # hyperslabs, not a flat band model) — decode/drill route it by
 # granule metadata before consulting the registry.

@@ -222,11 +222,14 @@ class VRTRaster:
                tuple(self.ds.geo_transform))
             return out
         if b.pixel_fn_language == "expression":
-            from ..ops.expr import parse_band_expressions
-            exprs = parse_band_expressions([b.pixel_fn_code.strip()])
+            # a bare expression string, not a bands list: compile it
+            # directly (parse_band_expressions treats single-part
+            # entries as band names, reference '='-split semantics)
+            from ..ops.expr import compile_expr
+            ce = compile_expr(b.pixel_fn_code.strip())
             env = {f"b{i + 1}": np.asarray(a, np.float32)
                    for i, a in enumerate(in_ar)}
-            out[:] = np.asarray(exprs.expressions[0](env, xp=np))
+            out[:] = np.asarray(ce(env, xp=np))
             return out
         raise ValueError(
             f"unsupported PixelFunctionLanguage {b.pixel_fn_language!r}")

@@ -312,12 +312,20 @@ def parse_band_expressions(bands: Sequence[str]) -> BandExpressions:
         if not parts or any(not p for p in parts):
             raise ValueError(f"invalid expression: {b!r}")
         if len(parts) == 1:
+            # a single-part entry is a band NAME, never parsed — the
+            # reference only parses the RHS of '=' entries
+            # (`utils/config.go:1002-1019`) — so names the expression
+            # grammar would reject (digit-leading MODIS SDS namespaces
+            # like "250m_NDVI") stay servable.  Callers with a bare
+            # expression string (VRT pixel functions) use
+            # `compile_expr` directly.
             name = body = parts[0]
+            ce = CompiledExpr(body, [body], ("var", body))
         elif len(parts) == 2:
             name, body = parts[0], parts[1]
+            ce = compile_expr(body)
         else:
             raise ValueError(f"invalid expression: {b!r}")
-        ce = compile_expr(body)
         if ce._ast[0] != "var":
             has_expr = True
         exprs.append(ce)

@@ -98,6 +98,127 @@ class TestGMT:
         assert not np.asarray(res2.valid[ns]).all()
 
 
+class TestHDF4:
+    """Native HDF4 / HDF-EOS reader (the MODIS family the reference
+    serves through GDAL's HDF4 driver — `warp.go:89-101`)."""
+
+    def _modis(self, tmp_path, compress="deflate"):
+        from gsky_tpu.geo.crs import CRS_SINU_MODIS
+        from gsky_tpu.io.hdf4 import write_hdf4
+
+        rng = np.random.default_rng(9)
+        H = W = 96
+        ndvi = rng.uniform(-2000, 10000, (H, W)).astype(np.int16)
+        ndvi[:8, :8] = -3000                      # fill region
+        evi = rng.uniform(0.0, 1.0, (H, W)).astype(np.float32)
+        # a small sinusoidal grid around lon 148, lat -35
+        from gsky_tpu.geo.transform import GeoTransform as GT
+        x0, y0 = CRS_SINU_MODIS.from_lonlat(148.0, -35.0)
+        gt = GeoTransform(x0, 463.3127, 0.0, y0, 0.0, -463.3127)
+        p = str(tmp_path / "MOD13Q1.A2020010.h29v12.hdf")
+        write_hdf4(p, {"250m NDVI": ndvi, "250m EVI": evi}, gt=gt,
+                   crs=CRS_SINU_MODIS, fills={"250m NDVI": -3000.0},
+                   compress=compress)
+        return p, ndvi, evi, gt
+
+    @pytest.mark.parametrize("compress", [None, "deflate"])
+    def test_roundtrip(self, tmp_path, compress):
+        from gsky_tpu.io.hdf4 import HDF4, is_hdf4
+
+        p, ndvi, evi, gt = self._modis(tmp_path, compress)
+        assert is_hdf4(p)
+        with HDF4(p) as h:
+            assert [s.name for s in h.sds] == ["250m NDVI", "250m EVI"]
+            assert (h.height, h.width) == (96, 96)
+            assert h.nodata == -3000.0
+            np.testing.assert_array_equal(h.read(1), ndvi)
+            np.testing.assert_array_equal(
+                h.read(2, (10, 20, 30, 40)), evi[20:60, 10:40])
+            assert h.gt is not None and h.crs is not None
+            assert h.crs.proj == "sinu"
+            assert h.gt.dx == pytest.approx(463.3127, rel=1e-4)
+            assert h.nodata_for(2) is None
+
+    def test_geo_projection_dms(self, tmp_path):
+        """GCTP_GEO metadata packs corners as DMS; the reader must
+        unpack to degrees."""
+        from gsky_tpu.io.hdf4 import HDF4, write_hdf4
+
+        v = np.arange(12, dtype=np.float32).reshape(3, 4)
+        p = str(tmp_path / "geo_20200110.hdf")
+        write_hdf4(p, {"v": v},
+                   gt=GeoTransform(148.0, 0.25, 0, -35.0, 0, -0.5))
+        with HDF4(p) as h:
+            g = h.gt.to_gdal()
+            assert g[0] == pytest.approx(148.0, abs=1e-6)
+            assert g[1] == pytest.approx(0.25, abs=1e-6)
+            assert g[3] == pytest.approx(-35.0, abs=1e-6)
+            assert g[5] == pytest.approx(-0.5, abs=1e-6)
+            assert h.crs.proj == "longlat"
+
+    def test_registry_and_unsupported_special(self, tmp_path):
+        from gsky_tpu.io.hdf4 import HDF4
+
+        p, _, _, _ = self._modis(tmp_path)
+        h = open_raster(p)
+        assert isinstance(h, HDF4)
+        h.close()
+        assert "hdf4" in formats()
+
+    def test_served_e2e(self, tmp_path):
+        """crawl -> MAS -> GetMap over a sinusoidal MODIS-style grid:
+        the sinusoidal->mercator warp and fill masking end to end."""
+        p, ndvi, _, gt = self._modis(tmp_path)
+        rec = extract(p)
+        assert not rec.get("error"), rec
+        assert rec["file_type"] == "HDF4"
+        nss = [d["namespace"] for d in rec["geo_metadata"]]
+        assert nss == ["250m_NDVI", "250m_EVI"]
+        assert rec["geo_metadata"][0]["timestamps"] == \
+            ["2020-01-10T00:00:00.000Z"]
+        assert rec["geo_metadata"][0]["nodata"] == -3000.0
+        store = MASStore()
+        store.ingest(rec)
+        pipe = TilePipeline(MASClient(store), executor=WarpExecutor())
+        # query an inner box of the grid, computed from its own
+        # corners (sinusoidal skew makes a hand-written lon/lat bbox
+        # overshoot)
+        from gsky_tpu.geo.crs import CRS_SINU_MODIS
+        px = np.array([10, 86], float)
+        xs = gt.x0 + px * gt.dx
+        ys = gt.y0 + px * gt.dy
+        lon, lat = CRS_SINU_MODIS.to_lonlat(
+            np.array([xs[0], xs[1], xs[0], xs[1]]),
+            np.array([ys[0], ys[0], ys[1], ys[1]]))
+        merc = transform_bbox(
+            BBox(lon.max() - (lon.max() - lon.min()) * 0.9,
+                 lat.min(), lon.min() + (lon.max() - lon.min()) * 0.9,
+                 lat.max()),
+            EPSG4326, EPSG3857)
+        req = GeoTileRequest(
+            collection=str(tmp_path), bands=["250m_NDVI"],
+            bbox=merc, crs=EPSG3857, width=64, height=64,
+            start_time=t(9), end_time=t(11))
+        res = pipe.process(req)
+        assert "250m_NDVI" in res.data
+        ok = np.asarray(res.valid["250m_NDVI"])
+        assert ok.mean() > 0.5
+        vals = np.asarray(res.data["250m_NDVI"])[ok]
+        assert vals.min() >= -2000 - 1 and vals.max() <= 10000 + 1
+        assert not (vals == -3000).any()          # fill masked
+        # the SECOND SDS must serve ITS values, not band 1's (the band
+        # index rides the ds_name suffix; the store has no band column)
+        req2 = GeoTileRequest(
+            collection=str(tmp_path), bands=["250m_EVI"],
+            bbox=merc, crs=EPSG3857, width=64, height=64,
+            start_time=t(9), end_time=t(11))
+        res2 = pipe.process(req2)
+        ok2 = np.asarray(res2.valid["250m_EVI"])
+        assert ok2.mean() > 0.5
+        evals = np.asarray(res2.data["250m_EVI"])[ok2]
+        assert 0.0 <= evals.min() and evals.max() <= 1.0
+
+
 class TestImageAdapter:
     def _jp2(self, tmp_path):
         from PIL import Image

@@ -327,6 +327,19 @@ class TestExpr:
         assert be.passthrough
         assert be.var_list == ["red", "green", "blue"]
 
+    def test_grammar_hostile_band_names_pass_through(self):
+        """Single-part entries are NAMES, never parsed (the reference
+        only parses the RHS of '=' entries) — digit-leading MODIS SDS
+        namespaces must stay servable."""
+        be = parse_band_expressions(["250m_NDVI", "2020-01"])
+        assert be.passthrough
+        assert be.var_list == ["250m_NDVI", "2020-01"]
+        assert be.expr_names == ["250m_NDVI", "2020-01"]
+        out, ok = be.expressions[0].eval_masked(
+            {"250m_NDVI": jnp.asarray(np.float32(7.0))},
+            {"250m_NDVI": jnp.asarray(True)})
+        assert float(out) == 7.0 and bool(ok)
+
     def test_bracketed_identifier(self):
         ce = compile_expr("[band #1] * 2")
         out = ce({"band #1": jnp.asarray(np.float32(3.0))})

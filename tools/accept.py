@@ -204,6 +204,24 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
           (148.0, 148.96), (-35.96, -35.0))
     store.ingest(_extract(os.path.join(gmt_dir, "relief_20200110.grd")))
 
+    # an HDF4 MODIS-style sinusoidal grid rides along (the native HDF4
+    # reader through the full HTTP server — GDAL-HDF4-driver role)
+    from gsky_tpu.geo.crs import CRS_SINU_MODIS
+    from gsky_tpu.geo.transform import GeoTransform as _GT
+    from gsky_tpu.io.hdf4 import write_hdf4 as _whdf
+
+    hdf_dir = os.path.join(root, "hdf")
+    os.makedirs(hdf_dir)
+    _sx, _sy = CRS_SINU_MODIS.from_lonlat(148.0, -35.0)
+    _whdf(os.path.join(hdf_dir, "MOD13Q1.A2020010.h29v12.hdf"),
+          {"NDVI": _rng.uniform(-2000, 10000, (96, 96))
+           .astype(_np.int16)},
+          gt=_GT(float(_sx), 463.3127, 0.0, float(_sy), 0.0, -463.3127),
+          crs=CRS_SINU_MODIS, fills={"NDVI": -3000.0},
+          compress="deflate")
+    store.ingest(_extract(os.path.join(hdf_dir,
+                                       "MOD13Q1.A2020010.h29v12.hdf")))
+
     conf_dir = os.path.join(root, "conf")
     os.makedirs(conf_dir)
     config = {
@@ -223,6 +241,11 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
             "name": "relief", "title": "GMT grid relief",
             "data_source": gmt_dir,
             "rgb_products": ["relief_20200110"],
+            "time_generator": "mas",
+        }, {
+            "name": "modis", "title": "HDF4 sinusoidal NDVI",
+            "data_source": hdf_dir,
+            "rgb_products": ["NDVI"],
             "time_generator": "mas",
         }],
         "processes": [{
@@ -313,6 +336,23 @@ def suite_selftest(conc: int, n_tiles: int) -> int:
             f"http://{host}/ows?service=WMS&request=GetMap&version=1.3.0"
             f"&layers=relief&crs=EPSG:4326"
             f"&bbox=-35.8,148.1,-35.2,148.8"
+            f"&width=128&height=128&format=image/png"
+            f"&time=2020-01-10T00:00:00.000Z")
+        ok = status == 200 and body[:8] == b"\x89PNG\r\n\x1a\n" \
+            and len(body) > 500
+    except Exception as e:  # noqa: BLE001
+        ok = False
+        print(f"error: {e} ", end="")
+    print("Passed" if ok else "Failed")
+    if not ok:
+        rc = 1
+
+    print("Testing WMS GetMap (HDF4 sinusoidal): ", end="", flush=True)
+    try:
+        status, body = _get(
+            f"http://{host}/ows?service=WMS&request=GetMap&version=1.3.0"
+            f"&layers=modis&crs=EPSG:4326"
+            f"&bbox=-35.35,148.05,-35.05,148.45"
             f"&width=128&height=128&format=image/png"
             f"&time=2020-01-10T00:00:00.000Z")
         ok = status == 200 and body[:8] == b"\x89PNG\r\n\x1a\n" \
