@@ -84,8 +84,15 @@ def _dfnt_dtype(code: int) -> np.dtype:
 class _RawFile:
     """DD-level access: (tag, ref) -> bytes, special elements resolved."""
 
+    # a corrupt DD count/offset/length must never drive allocation —
+    # every size is validated against the stat'd file size, and the DD
+    # list itself is capped (bounds-hardening parity with the GeoTIFF
+    # and NetCDF parsers)
+    _MAX_DDS = 65536
+
     def __init__(self, path: str):
         self.path = path
+        self._size = os.stat(path).st_size
         self._fp = open(path, "rb")
         # the handle cache shares one open handle across the decode
         # thread pool; seek+read must not interleave
@@ -96,7 +103,7 @@ class _RawFile:
         self.dds: List[Tuple[int, int, int, int]] = []  # tag,ref,off,len
         pos = 4
         visited = set()
-        while pos and pos not in visited:
+        while pos and pos not in visited and pos < self._size:
             visited.add(pos)       # corrupt next-pointers must not loop
             self._fp.seek(pos)
             head = self._fp.read(6)
@@ -104,11 +111,15 @@ class _RawFile:
                 break
             ndd, nxt = struct.unpack(">hI", head)
             raw = self._fp.read(12 * max(ndd, 0))
-            for i in range(max(ndd, 0)):
+            for i in range(len(raw) // 12):
                 tag, ref, off, ln = struct.unpack_from(">HHII", raw,
                                                        i * 12)
-                if tag != DFTAG_NULL:
+                if tag != DFTAG_NULL and off + ln <= self._size:
                     self.dds.append((tag, ref, off, ln))
+            if len(self.dds) > self._MAX_DDS:
+                self._fp.close()
+                raise ValueError(
+                    f"{path}: DD list exceeds {self._MAX_DDS} entries")
             pos = nxt
         self._by_id: Dict[Tuple[int, int], Tuple[int, int]] = {
             (t, r): (o, ln) for t, r, o, ln in self.dds}
@@ -141,10 +152,16 @@ class _RawFile:
         with self._lock:
             self._fp.seek(off)
             head = self._fp.read(ln if ln < 64 else 64)
+        if len(head) < 2:
+            raise ValueError(
+                f"{self.path}: truncated special-element header")
         (code,) = struct.unpack_from(">H", head, 0)
         if code == SPECIAL_COMP:
             # version u16, uncompressed length u32, comp_ref u16,
             # model u16, comp_type u16 (hcomp.c header)
+            if len(head) < 14:
+                raise ValueError(
+                    f"{self.path}: truncated SPECIAL_COMP header")
             _ver, total, comp_ref, _model, ctype = \
                 struct.unpack_from(">HIHHH", head, 2)
             payload = self.raw(DFTAG_COMPRESSED, comp_ref)
@@ -152,8 +169,37 @@ class _RawFile:
                 raise ValueError(
                     f"{self.path}: missing compressed element "
                     f"{comp_ref}")
+            if total > (1 << 30):
+                raise ValueError(
+                    f"{self.path}: compressed element claims "
+                    f"{total} bytes")
             if ctype == COMP_DEFLATE:
-                out = zlib.decompress(payload)
+                # bounded inflate (a crafted header must not drive the
+                # allocation past its own declared, capped size) that
+                # still validates completeness: max_length=0 would mean
+                # UNLIMITED, and a short/overlong stream must raise,
+                # not decode into silent garbage
+                if total == 0:
+                    return b""
+                d = zlib.decompressobj()
+                try:
+                    out = d.decompress(payload, total)
+                except zlib.error as e:
+                    raise ValueError(
+                        f"{self.path}: corrupt deflate stream: {e}"
+                    ) from e
+                if len(out) == total and not d.eof:
+                    # the output cap can stop right before the stream
+                    # terminator on a well-formed stream; one more
+                    # bounded pull must yield nothing and hit EOF
+                    if d.decompress(d.unconsumed_tail, 1) or not d.eof:
+                        raise ValueError(
+                            f"{self.path}: compressed element longer "
+                            f"than its declared {total} bytes")
+                if len(out) != total:
+                    raise ValueError(
+                        f"{self.path}: compressed element decodes to "
+                        f"{len(out)} of {total} declared bytes")
             elif ctype == COMP_NONE:
                 out = payload
             else:
@@ -220,13 +266,19 @@ def _attr_value(rawfile: _RawFile, ref: int):
     vh = rawfile.raw(DFTAG_VH, ref)
     if vh is None:
         return None
-    name, vclass, nvert, ivsize, types, _ = _parse_vh(vh)
+    try:
+        name, vclass, nvert, ivsize, types, _ = _parse_vh(vh)
+    except struct.error:
+        return None                # truncated Vdata description
     if vclass != "Attr0.0" or not types:
         return None
     vs = rawfile.element(DFTAG_VS, ref)
     if vs is None:
         return None
-    dt = _dfnt_dtype(types[0])
+    try:
+        dt = _dfnt_dtype(types[0])
+    except ValueError:
+        return None
     if dt.kind == "S":
         return name, vs.rstrip(b"\x00").decode("latin-1",
                                                errors="replace")
@@ -332,15 +384,22 @@ class HDF4:
         if sdd_ref is None or sd_ref is None:
             return None
         sdd = self._raw.raw(DFTAG_SDD, sdd_ref)
-        if sdd is None:
+        if sdd is None or len(sdd) < 2:
             return None
         (rank,) = struct.unpack_from(">H", sdd, 0)
+        if rank > 16 or len(sdd) < 2 + 4 * rank + 4:
+            return None            # corrupt dimension record
         dims = struct.unpack_from(f">{rank}i", sdd, 2)
+        if any(d <= 0 for d in dims):
+            return None
         nt_tag, nt_ref = struct.unpack_from(">HH", sdd, 2 + 4 * rank)
         nt = self._raw.raw(nt_tag, nt_ref)
         if nt is None or len(nt) < 4:
             return None
-        dtype = _dfnt_dtype(nt[1])
+        try:
+            dtype = _dfnt_dtype(nt[1])
+        except ValueError:
+            return None            # exotic number type: skip the SDS
         return list(dims), dtype, sd_ref
 
     def _load_structure(self) -> None:
@@ -352,7 +411,10 @@ class HDF4:
             vg = raw.raw(DFTAG_VG, ref)
             if vg is None:
                 continue
-            members, name, vclass = _parse_vgroup(vg)
+            try:
+                members, name, vclass = _parse_vgroup(vg)
+            except struct.error:
+                continue           # truncated group record
             if not vclass.startswith("Var"):
                 continue
             attrs = {}
@@ -406,6 +468,10 @@ class HDF4:
             raise ValueError(f"{self.path}: SDS {info.name!r} has no "
                              f"data element")
         n = int(np.prod(info.dims))
+        if n * info.dtype.itemsize > len(buf):
+            raise ValueError(
+                f"{self.path}: SDS {info.name!r} dims {info.dims} "
+                f"exceed its {len(buf)}-byte data element")
         arr = np.frombuffer(buf[:n * info.dtype.itemsize],
                             info.dtype).reshape(info.dims)
         while arr.ndim > 2:

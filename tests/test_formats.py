@@ -219,6 +219,147 @@ class TestHDF4:
         assert 0.0 <= evals.min() and evals.max() <= 1.0
 
 
+class TestHDF4Corrupt:
+    """A corrupt header must fail fast (or degrade), never hang or
+    drive allocation — bounds-hardening parity with the TIFF/NetCDF
+    parsers."""
+
+    def _base(self, tmp_path):
+        from gsky_tpu.io.hdf4 import write_hdf4
+
+        p = str(tmp_path / "x.hdf")
+        write_hdf4(p, {"v": np.ones((8, 8), np.float32)})
+        return p
+
+    def test_dd_chain_cycle_terminates(self, tmp_path):
+        import struct
+
+        from gsky_tpu.io.hdf4 import HDF4
+
+        p = self._base(tmp_path)
+        with open(p, "r+b") as fp:
+            fp.seek(4 + 2)
+            fp.write(struct.pack(">I", 4))   # next-block -> itself
+        with HDF4(p) as h:                   # terminates, no hang
+            assert h.bands >= 0
+
+    def test_truncated_file(self, tmp_path):
+        from gsky_tpu.io.hdf4 import HDF4
+
+        p = self._base(tmp_path)
+        raw = open(p, "rb").read()
+        with open(p, "wb") as fp:
+            fp.write(raw[:40])
+        h = HDF4(p)                          # opens; elements bounded
+        assert all(o + ln <= 40 for _, _, o, ln in h._raw.dds)
+        h.close()
+
+    def test_oversize_dims_rejected(self, tmp_path):
+        import struct
+
+        from gsky_tpu.io.hdf4 import DFTAG_SDD, HDF4
+
+        p = self._base(tmp_path)
+        h = HDF4(p)
+        # rewrite the SDD's first dim to a huge value
+        tag_off = next((o for t, r, o, ln in h._raw.dds
+                        if t == DFTAG_SDD), None)
+        h.close()
+        assert tag_off is not None
+        with open(p, "r+b") as fp:
+            fp.seek(tag_off + 2)
+            fp.write(struct.pack(">i", 1 << 30))
+        with HDF4(p) as h2:
+            if h2.bands:                     # dims claim > element size
+                with pytest.raises(ValueError):
+                    h2.read(1)
+
+    def test_zero_declared_length_never_unbounded(self, tmp_path):
+        """total=0 must not disable the inflate cap (zlib max_length=0
+        means UNLIMITED) — it returns empty, bomb payload untouched."""
+        import struct
+
+        from gsky_tpu.io.hdf4 import SPECIAL_COMP, HDF4, write_hdf4
+
+        p = str(tmp_path / "z.hdf")
+        write_hdf4(p, {"v": np.ones((64, 64), np.float32)},
+                   compress="deflate")
+        h = HDF4(p)
+        # rewrite the SPECIAL_COMP header's declared length to 0
+        sd_off = next(o for t, r, o, ln in h._raw.dds
+                      if t & 0x4000 and ln >= 14)
+        h.close()
+        with open(p, "r+b") as fp:
+            fp.seek(sd_off)
+            (code,) = struct.unpack(">H", fp.read(2))
+            assert code == SPECIAL_COMP
+            fp.seek(sd_off + 4)
+            fp.write(struct.pack(">I", 0))
+        with HDF4(p) as h2:
+            with pytest.raises(ValueError):
+                h2.read(1)        # 0 bytes can't fill 64x64
+
+    def test_truncated_deflate_raises(self, tmp_path):
+        from gsky_tpu.io.hdf4 import DFTAG_COMPRESSED, HDF4, write_hdf4
+
+        p = str(tmp_path / "tr.hdf")
+        write_hdf4(p, {"v": np.arange(4096, dtype=np.float32)
+                       .reshape(64, 64)}, compress="deflate")
+        h = HDF4(p)
+        off, ln = next((o, ln) for t, r, o, ln in h._raw.dds
+                       if t == DFTAG_COMPRESSED)
+        h.close()
+        with open(p, "r+b") as fp:       # zero out the payload's tail
+            fp.seek(off + ln // 2)
+            fp.write(b"\x00" * (ln - ln // 2))
+        with HDF4(p) as h2:
+            with pytest.raises(ValueError):
+                h2.read(1)
+
+    def test_not_hdf4(self, tmp_path):
+        from gsky_tpu.io.hdf4 import HDF4, is_hdf4
+
+        p = str(tmp_path / "no.hdf")
+        with open(p, "wb") as fp:
+            fp.write(b"not an hdf file at all")
+        assert not is_hdf4(p)
+        with pytest.raises(ValueError):
+            HDF4(p)
+
+
+class TestHDF4Drill:
+    def test_drill_over_hdf4(self, tmp_path):
+        """WPS drill through the registry HDF4 handle (host reads +
+        the drill-stack device path share the flat-band interface)."""
+        from gsky_tpu.geo.crs import CRS_SINU_MODIS
+        from gsky_tpu.io.hdf4 import write_hdf4
+        from gsky_tpu.pipeline import DrillPipeline, GeoDrillRequest
+
+        rng = np.random.default_rng(21)
+        ndvi = rng.uniform(1000.0, 2000.0, (96, 96)).astype(np.float32)
+        x0, y0 = CRS_SINU_MODIS.from_lonlat(148.0, -35.0)
+        gt = GeoTransform(float(x0), 463.3127, 0.0, float(y0), 0.0,
+                          -463.3127)
+        p = str(tmp_path / "MOD13Q1.A2020010.h29v12.hdf")
+        write_hdf4(p, {"NDVI": ndvi}, gt=gt, crs=CRS_SINU_MODIS,
+                   fills={"NDVI": -3000.0}, compress="deflate")
+        rec = extract(p)
+        assert not rec.get("error"), rec
+        store = MASStore()
+        store.ingest(rec)
+        wkt = ("POLYGON((148.05 -35.25,148.25 -35.25,148.25 -35.05,"
+               "148.05 -35.05,148.05 -35.25))")
+        req = GeoDrillRequest(
+            collection=str(tmp_path), bands=["NDVI"],
+            geometry_wkt=wkt, start_time=t(9), end_time=t(11),
+            approx=False)
+        res = DrillPipeline(MASClient(store)).process(req)
+        assert res.dates and "NDVI" in res.values
+        v = res.values["NDVI"][0]
+        assert 1000.0 <= v <= 2000.0
+        assert res.counts["NDVI"][0] > 0
+
+
 class TestImageAdapter:
     def _jp2(self, tmp_path):
         from PIL import Image
