@@ -479,7 +479,7 @@ def bench_kernels():
     made_w = _gather_window(np.asarray(params, np.float64),
                             ctrl_np[0], ctrl_np[1], S, S)
     if made_w is not None:
-        winb, win0b = made_w
+        winb, win0b, _ = made_w
         win0_dev = jnp.asarray(win0b)
 
         def render_win():
@@ -532,7 +532,7 @@ def bench_kernels():
     made_w = _gather_window(np.asarray(param1, np.float64)[None, :],
                             ctrl_np[0], ctrl_np[1], S, S)
     if made_w is not None:
-        winr, win0r = made_w
+        winr, win0r, _ = made_w
         win0r_dev = jnp.asarray(win0r)
 
         def render_rgb_win():

@@ -48,32 +48,57 @@ class RenderBatcher:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self._lock = threading.Lock()
-        # key -> (stack, [(ctrl, params, sp, Future), ...])
+        # key -> (stack, [(ctrl, params, sp, win_raw, Future), ...])
         self._groups: Dict[tuple, Tuple[object, List]] = {}
+        # batches dispatched with / without a union gather window
+        # (engagement telemetry, mirroring WarpExecutor.win_engaged)
+        self.win_batches = 0
+        self.full_batches = 0
 
     def render(self, key: tuple, stack, ctrl, params, sp,
-               statics: tuple) -> np.ndarray:
+               statics: tuple, win_raw=None) -> np.ndarray:
         """Submit one tile; blocks until its batch executes.  ``key``
         must capture everything that makes tiles batchable together:
         the scene-stack identity plus all static kernel parameters.
-        Returns the uint8 (H, W) tile as host numpy."""
+        win_raw: this tile's RAW footprint bounds (r_lo, r_hi, c_lo,
+        c_hi) from `executor._gather_window` (or None); the flush
+        unions them into one batch-wide bucketed window when every tile
+        has bounds.  Returns the uint8 (H, W) tile as host numpy."""
         fut: Future = Future()
         flush_now = None
         with self._lock:
             entry = self._groups.get(key)
             if entry is None:
-                self._groups[key] = (stack, [(ctrl, params, sp, fut)])
+                self._groups[key] = (stack,
+                                     [(ctrl, params, sp, win_raw, fut)])
                 timer = threading.Timer(self.max_wait_s,
                                         self._flush_key, (key, statics))
                 timer.daemon = True
                 timer.start()
             else:
-                entry[1].append((ctrl, params, sp, fut))
+                entry[1].append((ctrl, params, sp, win_raw, fut))
                 if len(entry[1]) >= self.max_batch:
                     flush_now = self._groups.pop(key)
         if flush_now is not None:
             self._execute(flush_now, statics)
         return fut.result()
+
+    def _union_window(self, items, stack):
+        """One (win, win0) covering every tile's RAW footprint bounds,
+        bucketed once — or (None, None) when any tile has no bounds or
+        the union grows to the whole stack.  Coalesced tiles come from
+        one map view, so the union is normally barely larger than a
+        single tile's footprint."""
+        if any(it[3] is None for it in items):
+            return None, None
+        from .executor import finish_window   # lazy: avoids cycle
+        made = finish_window(
+            min(it[3][0] for it in items),
+            max(it[3][1] for it in items),
+            min(it[3][2] for it in items),
+            max(it[3][3] for it in items),
+            int(stack.shape[1]), int(stack.shape[2]))
+        return (None, None) if made is None else made
 
     def _flush_key(self, key: tuple, statics: tuple):
         with self._lock:
@@ -101,13 +126,20 @@ class RenderBatcher:
                               + [items[0][1]] * (Np - N))
             sps = np.stack([it[2] for it in items]
                            + [items[0][2]] * (Np - N))
+            win, win0 = self._union_window(items, stack)
+            with self._lock:
+                if win is not None:
+                    self.win_batches += 1
+                else:
+                    self.full_batches += 1
             out = np.asarray(render_scenes_ctrl_many(
                 stack, jnp.asarray(ctrls), jnp.asarray(params),
                 jnp.asarray(sps), method, n_ns, out_hw, step, auto,
-                colour_scale))
-            for i, (_, _, _, fut) in enumerate(items):
-                fut.set_result(out[i])
+                colour_scale, win=win,
+                win0=None if win is None else jnp.asarray(win0)))
+            for i, it in enumerate(items):
+                it[4].set_result(out[i])
         except Exception as e:  # pragma: no cover - propagate to callers
-            for _, _, _, fut in items:
-                if not fut.done():
-                    fut.set_exception(e)
+            for it in items:
+                if not it[4].done():
+                    it[4].set_exception(e)

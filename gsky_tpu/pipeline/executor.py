@@ -118,8 +118,26 @@ def _gather_window(params64: np.ndarray, cx: np.ndarray, cy: np.ndarray,
     # high edge gets one extra pixel: the device recomputes coords in
     # f32, which can land just past the f64 bound and bump floor() by
     # one, pushing cubic's +2 tap one past _WIN_MARGIN
-    wr = min(_bucket(math.floor(rmax) + _WIN_MARGIN + 2 - r_lo), bucket_h)
-    wc = min(_bucket(math.floor(cmax) + _WIN_MARGIN + 2 - c_lo), bucket_w)
+    r_hi = math.floor(rmax) + _WIN_MARGIN + 2
+    c_hi = math.floor(cmax) + _WIN_MARGIN + 2
+    made = finish_window(r_lo, r_hi, c_lo, c_hi, bucket_h, bucket_w)
+    if made is None:
+        return None
+    win, win0 = made
+    # raw (unpadded, unclamped) bounds ride along so batch flushes can
+    # union footprints BEFORE bucketing (unioning padded windows would
+    # overshoot a bucket and decline needlessly)
+    return win, win0, (r_lo, r_hi, c_lo, c_hi)
+
+
+def finish_window(r_lo: int, r_hi: int, c_lo: int, c_hi: int,
+                  bucket_h: int, bucket_w: int):
+    """Bucket raw footprint bounds into (win, win0), or None when the
+    window would be the whole stack — the ONE place the bucket /
+    decline / origin-clamp rules live (`_gather_window` and the
+    batcher's union flush both finish through here)."""
+    wr = min(_bucket(r_hi - r_lo), bucket_h)
+    wc = min(_bucket(c_hi - c_lo), bucket_w)
     if wr >= bucket_h and wc >= bucket_w:
         return None
     r0 = min(max(r_lo, 0), bucket_h - wr)
@@ -446,7 +464,7 @@ class WarpExecutor:
             return None
         n_pad = _bucket_pow2(n_ns)
         if len(groups) == 1:
-            stack, _, params, step, _, ctrl_dev, win, win0 = groups[0]
+            stack, _, params, step, _, ctrl_dev, win, win0, _ = groups[0]
             spmd = default_spmd()
             if spmd is not None:
                 # mesh path (GSKY_SPMD=1): granule axis over `granule`,
@@ -475,8 +493,8 @@ class WarpExecutor:
                     stack, ctrl_dev, jnp.asarray(params),
                     method, n_pad, (height, width), step,
                     win=win, win0=_dev_win0(win0))
-                 for stack, _, params, step, _, ctrl_dev, win, win0
-                 in groups]
+                 for stack, _, params, step, _, ctrl_dev, win,
+                 win0, _ in groups]
         canvs = jnp.stack([p[0] for p in parts])
         bests = jnp.stack([p[1] for p in parts])
         return combine_scored(canvs, bests)
@@ -496,7 +514,7 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, ctrl, params, step, skey, ctrl_dev, win, win0 = made
+        stack, ctrl, params, step, skey, ctrl_dev, win, win0, win_raw = made
         sp = np.array([offset, scale, clip], np.float32)
         statics = (method, _bucket_pow2(n_ns), (height, width), step,
                    auto, colour_scale)
@@ -509,15 +527,15 @@ class WarpExecutor:
                 win=win, win0=win0))
         from .batcher import batching_enabled
         if batching_enabled():
-            # batched tiles share one dispatch: no per-tile window, and
-            # the counter must say so (win would misreport engagement)
-            self._count("render_byte", (stack.shape, None))
+            # batched tiles share one dispatch; the batcher unions the
+            # per-tile windows at flush (its win_batches/full_batches
+            # counters carry the engagement telemetry for this path)
+            self._count("render_byte_batched", stack.shape)
             # scene-serial key (not id()): address reuse after eviction
             # must never coalesce a request into another stack's batch
-            # (batched tiles share one dispatch, so no per-tile window)
             key = skey + statics
             return self._batcher.render(key, stack, ctrl, params, sp,
-                                        statics)
+                                        statics, win_raw=win_raw)
         self._count("render_byte", (stack.shape, win))
         self._note_win(win)
         out = render_scenes_ctrl(stack, ctrl_dev,
@@ -541,7 +559,7 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, _, params, step, _, ctrl_dev, win, win0 = made
+        stack, _, params, step, _, ctrl_dev, win, win0, _ = made
         self._count("render_bands", (stack.shape, win))
         self._note_win(win)
         sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
@@ -637,7 +655,7 @@ class WarpExecutor:
                                     int(packed.shape[0]),
                                     int(packed.shape[1]))
             if made_w is not None:
-                win, win0 = made_w
+                win, win0, _ = made_w
         from ..ops.warp import render_rgba_ctrl
         self._count("render_rgba", (packed.shape, win))
         self._note_win(win)
@@ -804,16 +822,16 @@ class WarpExecutor:
                     self._stack_cache.move_to_end(skey)
                     while len(self._stack_cache) > self._STACK_CACHE_MAX:
                         self._stack_cache.popitem(last=False)
-            win = win0 = None
+            win = win0 = win_raw = None
             if _window_mode():
                 made_w = _gather_window(
                     params, np.asarray(ctrl[0], np.float64),
                     np.asarray(ctrl[1], np.float64),
                     int(stack.shape[1]), int(stack.shape[2]))
                 if made_w is not None:
-                    win, win0 = made_w
+                    win, win0, win_raw = made_w
             groups.append((stack, ctrl, params.astype(np.float32), step,
-                           skey, ctrl_dev, win, win0))
+                           skey, ctrl_dev, win, win0, win_raw))
         return groups
 
 

@@ -202,7 +202,7 @@ def _():
                           ctrl[1].astype(np.float64),
                           stack.shape[1], stack.shape[2])
     assert made is not None, "window must engage at this shape"
-    win, win0 = made
+    win, win0, _ = made
     kw = dict(method="cubic", n_ns=2, out_hw=(256, 256), step=16,
               auto=True, colour_scale=0)
     full = np.asarray(render_scenes_ctrl(
@@ -227,7 +227,7 @@ def _():
                           ctrl[0].astype(np.float64),
                           ctrl[1].astype(np.float64), S, S)
     assert made is not None, "window must engage at this shape"
-    win, win0 = made
+    win, win0, _ = made
     kw = dict(method="bilinear", out_hw=(256, 256), step=16, auto=True,
               colour_scale=0)
     full = np.asarray(render_rgba_ctrl(

@@ -180,7 +180,7 @@ def main():
     print(f"f1. production full:    {timeit(prod_full):8.3f} ms",
           flush=True)
     if made is not None:
-        winf, win0f = made
+        winf, win0f, _ = made
         w0d = jnp.asarray(win0f)
 
         def prod_win():
