@@ -144,7 +144,15 @@ class OWSServer:
                 "geo_cache": len(ex._geo_cache),
                 "stack_cache": len(ex._stack_cache),
                 "stride_cache": len(ex._stride_cache),
-                "dispatches": dict(ex.bucket_stats)}
+                "dispatches": dict(ex.bucket_stats),
+                # gather-window engagement (GSKY_WARP_WINDOW): groups
+                # that got a footprint window vs declined, + batched
+                # flushes with/without a union window
+                "gather_window": {
+                    "engaged": ex.win_engaged,
+                    "declined": ex.win_declined,
+                    "batches_windowed": ex._batcher.win_batches,
+                    "batches_full": ex._batcher.full_batches}}
             doc["scene_cache_bytes"] = sc._bytes
             doc["drill_cache_bytes"] = dc._bytes
         except Exception:

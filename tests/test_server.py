@@ -636,6 +636,9 @@ class TestDebugSideDoor:
         assert any(k.startswith(("render_byte", "scene_mosaic",
                                  "window_batch", "render_rgba"))
                    for k in disp), disp
+        gw = doc["executor"]["gather_window"]
+        assert set(gw) == {"engaged", "declined", "batches_windowed",
+                           "batches_full"}
         assert "jax" in doc and doc["jax"]["backend"] == "cpu"
 
     def test_debug_errors_counted(self, env):
