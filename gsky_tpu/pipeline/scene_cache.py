@@ -180,9 +180,24 @@ class SceneCache:
                     W, H = ovr.width, ovr.height
                 if H * W > self._max_scene_px:
                     return None
-                data = h.read(g.band, (0, 0, W, H), ifd=ovr)
+                if ovr is not None:
+                    data = h.read(g.band, (0, 0, W, H), ifd=ovr)
+                else:
+                    # no ifd kwarg here: the registry read contract is
+                    # plain read(band, window) — handles that don't
+                    # declare an ifd kwarg (HDF4) raised TypeError into
+                    # the except below and were silently uncacheable,
+                    # falling back to the window path every render
+                    data = h.read(g.band, (0, 0, W, H))
                 nodata = g.nodata if g.nodata is not None else h.nodata
-        except Exception:
+        except Exception as e:
+            # "uncacheable" must stay a degradation, never a crash — but
+            # it must also be VISIBLE: a signature drift in a handle's
+            # read() once hid here as a silent slow path for the format
+            import logging
+            logging.getLogger("gsky.scene_cache").warning(
+                "scene uncacheable, window-path fallback: %s (%s: %s)",
+                g.path, type(e).__name__, e)
             return None
         crs = parse_crs(g.srs) if g.srs else None
         if crs is None:

@@ -408,6 +408,40 @@ class TestImageAdapter:
         assert 0 <= vals.min() and vals.max() <= 255
 
 
+class TestSceneCacheRegistryFormats:
+    def test_registry_handles_are_scene_cacheable(self, tmp_path):
+        """GMT and HDF4 granules must reach the device-resident scene
+        fast path (a GeoTIFF-only ifd kwarg once made handles without
+        that kwarg — HDF4 — silently uncacheable: each render then
+        re-decoded and re-uploaded its window)."""
+        from gsky_tpu.geo.crs import CRS_SINU_MODIS
+        from gsky_tpu.io.gmt import write_gmt
+        from gsky_tpu.io.hdf4 import write_hdf4
+        from gsky_tpu.pipeline.granule import expand_granules
+        from gsky_tpu.pipeline.scene_cache import SceneCache
+
+        rng = np.random.default_rng(31)
+        x0, y0 = CRS_SINU_MODIS.from_lonlat(148.0, -35.0)
+        write_hdf4(str(tmp_path / "MOD13Q1.A2020010.h29v12.hdf"),
+                   {"NDVI": rng.uniform(0, 1, (96, 96))
+                    .astype(np.float32)},
+                   gt=GeoTransform(float(x0), 463.3127, 0.0, float(y0),
+                                   0.0, -463.3127),
+                   crs=CRS_SINU_MODIS, compress="deflate")
+        write_gmt(str(tmp_path / "relief_20200110.grd"),
+                  rng.uniform(0, 100, (64, 64)).astype(np.float32),
+                  (148.0, 148.64), (-35.64, -35.0))
+        store = MASStore()
+        for f in os.listdir(str(tmp_path)):
+            store.ingest(extract(str(tmp_path / f)))
+        gs = expand_granules(MASClient(store).intersects(str(tmp_path)),
+                             None, None)
+        assert len(gs) == 2
+        cache = SceneCache()
+        for g in gs:
+            assert cache.get(g, 1.0) is not None, g.namespace
+
+
 class TestRegistryErrors:
     def test_unknown_magic(self, tmp_path):
         p = str(tmp_path / "mystery.bin")
