@@ -254,6 +254,58 @@ def test_grpc_remote_pipeline_matches_local(grpc_worker, archive):
         assert frac < 0.02, f"{ns}: {frac:.1%} pixels differ"
 
 
+def test_grpc_remote_hdf4_matches_local(grpc_worker, tmp_path_factory):
+    """Registry-format granules (native HDF4, sinusoidal) through the
+    remote worker fan-out: ds_name band routing and the registry decode
+    must behave identically in the worker subprocess."""
+    from gsky_tpu.geo.crs import CRS_SINU_MODIS
+    from gsky_tpu.index import MASStore
+    from gsky_tpu.index.crawler import extract as _extract
+    from gsky_tpu.io.hdf4 import write_hdf4
+    from gsky_tpu.worker import WorkerClient
+
+    root = str(tmp_path_factory.mktemp("hdfrpc"))
+    rng = np.random.default_rng(23)
+    x0, y0 = CRS_SINU_MODIS.from_lonlat(148.0, -35.0)
+    gt = GeoTransform(float(x0), 463.3127, 0.0, float(y0), 0.0,
+                      -463.3127)
+    p = root + "/MOD13Q1.A2020010.h29v12.hdf"
+    write_hdf4(p, {"NDVI": rng.uniform(0, 1, (96, 96))
+                   .astype(np.float32),
+                   "EVI": rng.uniform(2, 3, (96, 96))
+                   .astype(np.float32)},
+               gt=gt, crs=CRS_SINU_MODIS, compress="deflate")
+    store = MASStore()
+    store.ingest(_extract(p))
+    mas = MASClient(store)
+    # inner box of the sinusoidal grid, from its own corners
+    px = np.array([10, 86], float)
+    lon, lat = CRS_SINU_MODIS.to_lonlat(
+        np.repeat(gt.x0 + px * gt.dx, 2),
+        np.tile(gt.y0 + px * gt.dy, 2))
+    bb = transform_bbox(
+        BBox(lon.max() - (lon.max() - lon.min()) * 0.9, lat.min(),
+             lon.min() + (lon.max() - lon.min()) * 0.9, lat.max()),
+        EPSG4326, EPSG3857)
+    t0 = 1578614400.0                          # 2020-01-10 UTC
+    req = GeoTileRequest(
+        collection=root, bands=["EVI"],        # band 2: routing check
+        bbox=bb, crs=EPSG3857, width=64, height=64,
+        start_time=t0 - 86400, end_time=t0 + 86400)
+    local = TilePipeline(mas).process(req)
+    remote = TilePipeline(mas,
+                          remote=WorkerClient([grpc_worker])).process(req)
+    assert local.namespaces == remote.namespaces == ["EVI"]
+    lv = np.asarray(local.valid["EVI"])
+    assert lv.mean() > 0.5
+    np.testing.assert_array_equal(lv, np.asarray(remote.valid["EVI"]))
+    ld = np.asarray(local.data["EVI"])
+    rd = np.asarray(remote.data["EVI"])
+    frac = np.mean(~np.isclose(ld[lv], rd[lv], rtol=1e-6))
+    assert frac < 0.02, f"{frac:.1%} pixels differ"
+    assert 2.0 <= ld[lv].min() and ld[lv].max() <= 3.0   # EVI, not NDVI
+
+
 def test_grpc_info_op(grpc_worker, archive):
     from gsky_tpu.worker import WorkerClient
     c = WorkerClient([grpc_worker])
