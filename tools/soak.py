@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Soak the in-process OWS server: sustained GetMap load across a
+DISTINCT-tile sweep (cache churn, not cache hits) while sampling the
+process RSS and the /debug cache sizes — the leak/bounds check a
+long-lived tile server needs and the acceptance suite's fixed grid
+can't give.
+
+    JAX_PLATFORMS=cpu python tools/soak.py [--seconds 120] [--conc 8]
+
+Exit 0 when (a) every request succeeded, (b) RSS growth over the
+steady-state phase (after the first quarter, which pays compiles +
+cache fills) is under --max-rss-growth-mb, and (c) the /debug cache
+sizes stay at or below their configured LRU bounds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures as cf
+import itertools
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status") as fp:
+        for line in fp:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=120.0)
+    ap.add_argument("--conc", type=int, default=8)
+    ap.add_argument("--max-rss-growth-mb", type=float, default=256.0)
+    args = ap.parse_args(argv)
+
+    from gsky_tpu.device import ensure_platform
+    ensure_platform(retries=1, timeout_s=45.0)
+
+    import asyncio
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import bench as B
+    from gsky_tpu.geo.crs import EPSG4326, EPSG3857
+    from gsky_tpu.geo.transform import BBox, transform_bbox
+    from gsky_tpu.index import MASClient
+    from gsky_tpu.server.config import ConfigWatcher
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    root = tempfile.mkdtemp(prefix="gsky_soak_")
+    store, utm, paths = B.build_archive(root)
+    mas_client = MASClient(store)
+    conf_dir = os.path.join(root, "conf")
+    os.makedirs(conf_dir)
+    with open(os.path.join(conf_dir, "config.json"), "w") as fp:
+        json.dump({
+            "service_config": {"ows_hostname": "", "mas_address": ""},
+            "layers": [{
+                "name": "landsat", "title": "soak",
+                "data_source": root,
+                "rgb_products": [f"LC08_20200{110 + k}_T1"
+                                 for k in range(B.N_SCENES)],
+                "time_generator": "mas"}],
+        }, fp)
+    watcher = ConfigWatcher(conf_dir, mas_factory=lambda a: mas_client,
+                            install_signal=False)
+    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                      metrics=MetricsLogger())
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    host_holder = {}
+
+    def run_server():
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        async def boot():
+            runner = web.AppRunner(server.app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            host_holder["host"] = "127.0.0.1:%d" % \
+                site._server.sockets[0].getsockname()[1]
+            started.set()
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    threading.Thread(target=run_server, daemon=True).start()
+    started.wait(30)
+    host = host_holder["host"]
+
+    span = B.SCENE_SIZE * 30.0
+    core = BBox(590000.0, 6105000.0 - span * 1.3,
+                590000.0 + span * 1.3, 6105000.0)
+    merc = transform_bbox(transform_bbox(core, utm, EPSG4326),
+                          EPSG4326, EPSG3857)
+
+    rng = np.random.default_rng(1)
+    counter = itertools.count()
+
+    def one(_):
+        # distinct bbox nearly every request: exercises eviction, the
+        # ctrl/stride caches and the window machinery, not the LRU hit
+        # path
+        i = next(counter)
+        fx = float(rng.uniform(0.0, 0.75))
+        fy = float(rng.uniform(0.0, 0.75))
+        w = merc.width * 0.25
+        bb = (f"{merc.xmin + fx * merc.width},"
+              f"{merc.ymin + fy * merc.height},"
+              f"{merc.xmin + fx * merc.width + w},"
+              f"{merc.ymin + fy * merc.height + w}")
+        url = (f"http://{host}/ows?service=WMS&request=GetMap"
+               f"&version=1.3.0&layers=landsat&crs=EPSG:3857&bbox={bb}"
+               f"&width=256&height=256&format=image/png"
+               f"&time=2020-01-{10 + i % B.N_SCENES:02d}T00:00:00.000Z")
+        with urllib.request.urlopen(url, timeout=120) as r:
+            body = r.read()
+            return r.status == 200 and body[:8] == b"\x89PNG\r\n\x1a\n"
+
+    t_end = time.time() + args.seconds
+    n_ok = n_bad = 0
+    samples = []
+    phase_rss = None
+    with cf.ThreadPoolExecutor(args.conc) as ex:
+        while time.time() < t_end:
+            results = list(ex.map(one, range(args.conc * 4)))
+            n_ok += sum(results)
+            n_bad += len(results) - sum(results)
+            now = time.time()
+            samples.append((round(args.seconds - (t_end - now), 1),
+                            round(rss_mb(), 1)))
+            if phase_rss is None and \
+                    now > t_end - args.seconds * 0.75:
+                phase_rss = rss_mb()   # steady-state baseline
+
+    with urllib.request.urlopen(f"http://{host}/debug",
+                                timeout=30) as r:
+        dbg = json.loads(r.read())
+    exec_caches = dbg.get("executor", {})
+    growth = rss_mb() - (phase_rss or rss_mb())
+    out = {
+        "requests_ok": n_ok, "requests_failed": n_bad,
+        "rss_samples_mb": samples[:3] + samples[-3:],
+        "steady_state_rss_growth_mb": round(growth, 1),
+        "caches": {k: exec_caches.get(k) for k in
+                   ("geo_cache", "stack_cache", "stride_cache")},
+        "scene_cache_bytes": dbg.get("scene_cache_bytes"),
+    }
+    print(json.dumps(out))
+    ok = (n_bad == 0 and growth <= args.max_rss_growth_mb
+          and exec_caches.get("geo_cache", 0) <= 256
+          and exec_caches.get("stack_cache", 0) <= 32)
+    print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
