@@ -105,8 +105,10 @@ def _window_slice(arr, win, win0, axis: int):
     the static window ``win`` = (WR, WC) at traced (2,) int32 origin
     ``win0``.  Returns (sliced, r0f, c0f): the f32 origins callers
     subtract from their coordinate grids — exact, because subtracting
-    an integer ≤ 4096 from an f32 coordinate < 2^12 never rounds, so
-    windowed outputs stay bit-identical to the full-scene kernel."""
+    an integer ≤ 4096 from an f32 coordinate < 2^12 never rounds.
+    Nearest results are bit-identical to the full-scene kernel;
+    interpolated methods can differ by 1 ulp where XLA contracts the
+    tap-weight arithmetic differently between the two programs."""
     r0 = win0[0]
     c0 = win0[1]
     starts = [jnp.int32(0)] * arr.ndim
@@ -276,7 +278,9 @@ def warp_scenes_ctrl(stack, ctrl, params, method: str = "near",
     footprint (+resampling margin) fits the window; the kernel then
     gathers from a dynamic slice of the stack instead of the full
     scenes, which cuts the TPU gather cost (it scales with the source
-    extent, not the tap count).  Bit-identical to the unwindowed path.
+    extent, not the tap count).  Exact re-indexing: nearest is
+    bit-identical to the unwindowed path; interpolated methods agree
+    to 1 ulp (XLA weight-arithmetic contraction between programs).
     """
     h, w = out_hw
     sx = _bilerp_grid(ctrl[0], h, w, step)
@@ -644,7 +648,9 @@ def _warp_scenes_scored(stack, sx, sy, params, method: str, n_ns: int,
     caller guarantees every granule's finite gather footprint (incl.
     the 2-px cubic tap margin) lies inside the window; the origin
     subtraction is an exact f32 op (integer ≤ 4096 off a coordinate
-    < 2^12), so outputs are bit-identical to the unwindowed kernel.
+    < 2^12), so the windowed kernel reads exactly the taps the
+    unwindowed one does (nearest: bit-identical; interpolated: 1-ulp
+    XLA-contraction differences between the two programs).
     """
     if win is not None:
         stack, r0f, c0f = _window_slice(stack, win, win0, axis=1)

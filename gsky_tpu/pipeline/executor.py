@@ -45,11 +45,15 @@ def _prefetch(x):
     return x
 
 
-def _bucket(n: int) -> int:
-    for b in _BUCKETS:
+def _bucket_in(n: int, buckets) -> int:
+    for b in buckets:
         if n <= b:
             return b
     return int(math.ceil(n / 4096) * 4096)
+
+
+def _bucket(n: int) -> int:
+    return _bucket_in(n, _BUCKETS)
 
 
 def _bucket_pow2(n: int, lo: int = 1) -> int:
@@ -79,6 +83,17 @@ def _window_mode() -> bool:
 
 
 _WIN_MARGIN = 2  # covers cubic's +2 tap and f32-vs-f64 coord rounding
+
+# gather-window sizes get a DENSER bucket list than the decode-path
+# shape buckets: a 300-px footprint over a 512-px scene must land in a
+# 384 window, not bucket up to the whole scene and decline.  Still a
+# bounded set (jit variants per (win_h, win_w) pair), just finer.
+_WIN_BUCKETS = (64, 96, 128, 192, 256, 384, 512, 768, 1024, 1536,
+                2048, 3072, 4096)
+
+
+def _win_bucket(n: int) -> int:
+    return _bucket_in(n, _WIN_BUCKETS)
 
 
 def _gather_window(params64: np.ndarray, cx: np.ndarray, cy: np.ndarray,
@@ -136,8 +151,8 @@ def finish_window(r_lo: int, r_hi: int, c_lo: int, c_hi: int,
     window would be the whole stack — the ONE place the bucket /
     decline / origin-clamp rules live (`_gather_window` and the
     batcher's union flush both finish through here)."""
-    wr = min(_bucket(r_hi - r_lo), bucket_h)
-    wc = min(_bucket(c_hi - c_lo), bucket_w)
+    wr = min(_win_bucket(r_hi - r_lo), bucket_h)
+    wc = min(_win_bucket(c_hi - c_lo), bucket_w)
     if wr >= bucket_h and wc >= bucket_w:
         return None
     r0 = min(max(r_lo, 0), bucket_h - wr)
