@@ -675,6 +675,13 @@ def main(argv=None):
             else None),
         "vs_ref_anecdote": round(head["value"] * REF_TILE_SECONDS, 2),
     }
+    if plat["platform"] == "cpu" and plat.get("fallback"):
+        # the CPU-fallback record must point at the measured-on-chip
+        # evidence so the two artifacts read as one story
+        result["tpu_builder_record"] = (
+            "accelerator unreachable (relay wedge, DEVICE.md); the "
+            "measured-on-TPU record from this round is "
+            "BENCH_TPU_r05_builder.json")
     print(json.dumps(result))
 
 
