@@ -51,6 +51,18 @@ DEFAULT_WCS_MAX_TILE_WIDTH = 1024
 DEFAULT_WCS_MAX_TILE_HEIGHT = 1024
 DEFAULT_LEGEND_WIDTH = 160
 DEFAULT_LEGEND_HEIGHT = 320
+# rendered-response cache TTL + Cache-Control max-age (serving gateway,
+# `gsky_tpu/serving/`); 0 disables output caching for the layer
+DEFAULT_CACHE_MAX_AGE = 300
+
+
+def _int_or(v, default: int) -> int:
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return default
 
 
 @dataclass
@@ -124,6 +136,7 @@ class Layer:
     resample: str = "near"
     wms_timeout: int = DEFAULT_WMS_TIMEOUT
     wcs_timeout: int = DEFAULT_WCS_TIMEOUT
+    cache_max_age: int = DEFAULT_CACHE_MAX_AGE
     wms_max_width: int = DEFAULT_WMS_MAX_WIDTH
     wms_max_height: int = DEFAULT_WMS_MAX_HEIGHT
     wcs_max_width: int = DEFAULT_WCS_MAX_WIDTH
@@ -231,6 +244,10 @@ class Layer:
             resample=j.get("resample", "near") or "near",
             wms_timeout=i("wms_timeout", DEFAULT_WMS_TIMEOUT),
             wcs_timeout=i("wcs_timeout", DEFAULT_WCS_TIMEOUT),
+            # not the `i` helper: an explicit 0 (disable caching) must
+            # survive, and `0 or default` would swallow it
+            cache_max_age=_int_or(j.get("cache_max_age"),
+                                  DEFAULT_CACHE_MAX_AGE),
             wms_max_width=i("wms_max_width", DEFAULT_WMS_MAX_WIDTH),
             wms_max_height=i("wms_max_height", DEFAULT_WMS_MAX_HEIGHT),
             wcs_max_width=i("wcs_max_width", DEFAULT_WCS_MAX_WIDTH),
@@ -567,11 +584,17 @@ class ConfigWatcher:
         self.mas_factory = mas_factory
         self._lock = threading.Lock()
         self._configs = load_config_tree(root, mas_factory)
+        # reload subscribers (serving-gateway cache invalidation, ...):
+        # called with the fresh namespace->Config map after each swap
+        self._listeners: List = []
         if install_signal:
             try:
                 signal.signal(signal.SIGHUP, self._on_hup)
             except ValueError:
                 pass  # not the main thread
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
 
     def _on_hup(self, *_):
         # a failed reload (malformed / mid-write config.json) must keep
@@ -586,6 +609,12 @@ class ConfigWatcher:
         configs = load_config_tree(self.root, self.mas_factory)
         with self._lock:
             self._configs = configs
+        for fn in list(self._listeners):
+            try:
+                fn(configs)
+            except Exception:
+                logging.getLogger("gsky.config").exception(
+                    "config reload listener failed")
 
     @property
     def configs(self) -> Dict[str, Config]:

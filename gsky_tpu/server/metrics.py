@@ -56,6 +56,13 @@ def _cache_stats() -> Dict:
                             "misses": MASStore.total_query_misses}
     except Exception:
         pass
+    try:
+        # the serving gateway in front of the pipelines: rendered-
+        # response LRU hits, singleflight joins, admission sheds
+        from ..serving import default_gateway
+        out["response"] = default_gateway.cache_counters()
+    except Exception:
+        pass
     return out
 
 
@@ -201,6 +208,10 @@ class MetricsLogger:
         with self._lock:
             if not self.log_dir:
                 sys.stdout.write(line + "\n")
+                # stdout is block-buffered when piped (containers,
+                # collectors): without a flush records sit in the
+                # buffer indefinitely on an idle server
+                sys.stdout.flush()
                 return
             if self._fp is None or self._size > self.max_size:
                 self._rotate()

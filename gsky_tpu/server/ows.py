@@ -15,6 +15,7 @@ serveWPS.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import datetime as dt
 import functools
@@ -24,6 +25,7 @@ import logging
 import math
 import os
 import tempfile
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -50,6 +52,9 @@ from ..pipeline import (DrillPipeline, GeoDrillRequest, GeoTileRequest,
 from ..pipeline.extent import compute_reprojection_extent
 from ..pipeline.feature_info import get_feature_info
 from ..pipeline.types import AxisSelector, MaskSpec
+from ..serving import (AdmissionShed, ServingGateway, canonical_key,
+                       default_gateway, layer_fingerprint, make_entry,
+                       quantise_bbox)
 from . import dap4
 from . import templates as T
 
@@ -70,16 +75,39 @@ from .params import (OWSError, infer_service, normalise_query, parse_wcs,
                      parse_wms, parse_wps)
 
 
+_GATEWAY_DEFAULT = object()     # sentinel: None means "no gateway"
+
+
 class OWSServer:
     def __init__(self, watcher: ConfigWatcher, mas_factory=None,
                  metrics: Optional[MetricsLogger] = None,
-                 static_dir: str = "", temp_dir: str = ""):
+                 static_dir: str = "", temp_dir: str = "",
+                 gateway=_GATEWAY_DEFAULT):
         self.watcher = watcher
         self.mas_factory = mas_factory or (lambda addr: MASClient(addr))
         self.metrics = metrics or MetricsLogger()
         self.static_dir = static_dir
         self.temp_dir = temp_dir or tempfile.gettempdir()
         self._pipelines: Dict[str, Tuple[tuple, TilePipeline]] = {}
+        # serving gateway: response cache + singleflight + admission in
+        # front of the pipelines; pass gateway=None for the raw server
+        self.gateway: Optional[ServingGateway] = \
+            default_gateway if gateway is _GATEWAY_DEFAULT else gateway
+        # serialize jax profiler captures: two concurrent start_trace
+        # calls collide and wedge the profiler (threading.Lock, not
+        # asyncio.Lock — handlers may run on different event loops)
+        self._profile_mutex = threading.Lock()
+        if self.gateway is not None and \
+                hasattr(watcher, "add_listener"):
+            watcher.add_listener(self._on_config_reload)
+
+    def _on_config_reload(self, configs: Dict[str, Config]) -> None:
+        """SIGHUP reload hook: eagerly drop cached responses whose layer
+        config changed or vanished (the fingerprint folded into every
+        cache key already orphans them; this returns the bytes now)."""
+        fps = {ns: {layer_fingerprint(l) for l in cfg.layers}
+               for ns, cfg in configs.items()}
+        self.gateway.cache.invalidate(fps)
 
     # -- plumbing -----------------------------------------------------------
 
@@ -108,6 +136,91 @@ class OWSServer:
         pipe = TilePipeline(self._mas(cfg), remote=remote)
         self._pipelines[nskey] = (settings, pipe)
         return pipe
+
+    # -- serving gateway (cache / singleflight / admission) -----------------
+
+    def _admit(self, service_class: str):
+        if self.gateway is None:
+            return contextlib.nullcontext()
+        return self.gateway.admission.admit(service_class)
+
+    def _response_key(self, cfg: Config, op: str, lay: Layer,
+                      style: Layer, p, q: Dict[str, str],
+                      width: int, height: int) -> Tuple[str, str]:
+        """Canonical cache/flight key for a render request: built from
+        the PARSED request, so equivalent KVP spellings (axis order,
+        case, float formatting, parameter order) collide."""
+        fp = layer_fingerprint(lay)
+        extras = tuple(sorted(
+            (k, v) for k, v in q.items()
+            if k not in _KEY_CONSUMED and not k.startswith("dim_")))
+        key = canonical_key(
+            ns=cfg.service_config.namespace, op=op, layer=lay.name,
+            style=style.name, crs=repr(p.crs),
+            bbox=quantise_bbox(p.bbox.xmin, p.bbox.ymin, p.bbox.xmax,
+                               p.bbox.ymax, width, height),
+            size=(width, height), fmt=p.format.lower(),
+            times=tuple(p.times),
+            axes=tuple(sorted(getattr(p, "axes", {}).items())),
+            extras=extras, layer_fp=fp)
+        return key, fp
+
+    def _replay(self, request: web.Request, ent,
+                cache_status: str) -> web.Response:
+        """Build a per-request response from cached bytes with the HTTP
+        cache contract: strong ETag, If-None-Match -> 304, per-layer
+        Cache-Control."""
+        headers = {"ETag": ent.etag,
+                   "Cache-Control": f"max-age={ent.max_age}",
+                   "X-Gsky-Cache": cache_status}
+        inm = request.headers.get("If-None-Match", "")
+        if inm and _etag_match(inm, ent.etag):
+            return web.Response(status=304, headers=headers)
+        for k, v in ent.headers:
+            headers[k] = v
+        return web.Response(body=ent.body, status=ent.status,
+                            content_type=ent.content_type,
+                            headers=headers)
+
+    async def _serve_gated(self, request: web.Request, svc: str,
+                           key: Optional[str], meta, collector,
+                           render_inner) -> web.Response:
+        """Response cache -> singleflight -> admission -> render.
+
+        ``render_inner()`` must return a fresh coroutine per call.  A
+        cache hit costs no admission slot; on a miss exactly one caller
+        per key renders (under the service class's admission semaphore)
+        and everyone shares the bytes — or the error.  Unshareable
+        results (streaming FileResponse) pass through for the leader;
+        joiners fall back to their own render."""
+        gw = self.gateway
+        if gw is None or key is None:
+            async with self._admit(svc):
+                return await render_inner()
+        ent = gw.cache.get(key)
+        if ent is not None:
+            collector.info["response_cache"] = "hit"
+            return self._replay(request, ent, "hit")
+
+        async def flight_fn():
+            async with gw.admission.admit(svc):
+                return _freeze_response(await render_inner())
+
+        frozen, joined = await gw.flight.do(key, flight_fn)
+        if not isinstance(frozen, tuple):     # passthrough response
+            if joined:
+                async with self._admit(svc):
+                    return await render_inner()
+            return frozen
+        status, ctype, body, keep = frozen
+        ns, layer_name, fp, max_age = meta
+        ent = make_entry(body, ctype, status, ns, layer_name, fp,
+                         max_age, keep)
+        if status == 200 and not joined:
+            gw.cache.put(key, ent)
+        tag = "join" if joined else "miss"
+        collector.info["response_cache"] = tag
+        return self._replay(request, ent, tag)
 
     def app(self) -> web.Application:
         app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -157,6 +270,8 @@ class OWSServer:
             doc["drill_cache_bytes"] = dc._bytes
         except Exception:
             pass
+        if self.gateway is not None:
+            doc["serving"] = self.gateway.stats()
         return web.json_response(doc)
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
@@ -169,22 +284,31 @@ class OWSServer:
                 request.query.get("seconds", "3")), 0.1), 30.0)
         except ValueError:
             seconds = 3.0
-        out_dir = os.path.join(
-            self.temp_dir,
-            f"gsky_jax_trace_{int(time.time())}")
-        try:
-            import jax
-            jax.profiler.start_trace(out_dir)
-            try:
-                await asyncio.sleep(seconds)
-            finally:
-                # client disconnect cancels the handler with a
-                # BaseException; an un-stopped trace would wedge the
-                # profiler for the life of the process
-                jax.profiler.stop_trace()
-        except Exception as e:  # noqa: BLE001 - report, don't 500
+        # one capture at a time: overlapping start_trace calls collide
+        # and wedge the profiler for the life of the process
+        if not self._profile_mutex.acquire(blocking=False):
             return web.json_response(
-                {"error": f"trace failed: {e}"}, status=503)
+                {"error": "a profile capture is already in progress"},
+                status=409)
+        try:
+            out_dir = os.path.join(
+                self.temp_dir,
+                f"gsky_jax_trace_{int(time.time())}")
+            try:
+                import jax
+                jax.profiler.start_trace(out_dir)
+                try:
+                    await asyncio.sleep(seconds)
+                finally:
+                    # client disconnect cancels the handler with a
+                    # BaseException; an un-stopped trace would wedge
+                    # the profiler for the life of the process
+                    jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001 - report, don't 500
+                return web.json_response(
+                    {"error": f"trace failed: {e}"}, status=503)
+        finally:
+            self._profile_mutex.release()
         return web.json_response({"trace_dir": out_dir,
                                   "seconds": seconds})
 
@@ -210,7 +334,9 @@ class OWSServer:
                 raise OWSError(f"no configuration for namespace {ns!r}",
                                status=404)
             if "dap4.ce" in q:
-                resp = await self.serve_dap(request, cfg, q, collector)
+                async with self._admit("DAP4"):
+                    resp = await self.serve_dap(request, cfg, q,
+                                                collector)
             else:
                 svc = infer_service(q)
                 if svc == "WMS":
@@ -221,6 +347,13 @@ class OWSServer:
                     resp = await self.serve_wps(request, cfg, q, collector)
             collector.log(resp.status)
             return resp
+        except AdmissionShed as e:
+            # shed, don't queue into latency collapse: fast OGC 503 +
+            # Retry-After so well-behaved clients back off
+            collector.log(503)
+            return _exception_response(
+                OWSError(str(e), "ServerBusy", status=503),
+                headers={"Retry-After": str(e.retry_after)})
         except OWSError as e:
             collector.log(e.status)
             return _exception_response(e)
@@ -250,9 +383,10 @@ class OWSServer:
         if req_name == "getlegendgraphic":
             return self._legend(cfg, q)
         if req_name == "getmap":
-            return await self._getmap(cfg, p, collector)
+            return await self._getmap_gated(request, cfg, p, q, collector)
         if req_name == "getfeatureinfo":
-            return await self._feature_info(cfg, p)
+            async with self._admit("WMS"):
+                return await self._feature_info(cfg, p)
         raise OWSError(f"WMS request {p.request!r} not supported",
                        "OperationNotSupported")
 
@@ -339,6 +473,25 @@ class OWSServer:
             index_res_limit=lay.index_res_limit,
             grpc_tile_x_size=lay.grpc_tile_x_size,
             grpc_tile_y_size=lay.grpc_tile_y_size)
+
+    async def _getmap_gated(self, request, cfg: Config, p, q, collector):
+        """GetMap through the serving gateway.  The cache key is only
+        built once the request is complete enough to resolve (layer,
+        bbox, crs, size); incomplete requests fall through to _getmap
+        for its usual validation errors."""
+        key = meta = None
+        if self.gateway is not None and p.layers and p.bbox is not None \
+                and p.crs is not None and p.width > 0 and p.height > 0:
+            lay, style = self._resolve_layer(cfg, p.layers[0], p.styles,
+                                             "wms")
+            if lay.cache_max_age > 0:
+                key, fp = self._response_key(cfg, "map", lay, style, p,
+                                             q, p.width, p.height)
+                meta = (cfg.service_config.namespace, lay.name, fp,
+                        lay.cache_max_age)
+        return await self._serve_gated(
+            request, "WMS", key, meta, collector,
+            lambda: self._getmap(cfg, p, collector))
 
     async def _getmap(self, cfg: Config, p, collector):
         if not p.layers:
@@ -557,11 +710,35 @@ class OWSServer:
                 raise OWSError("coverage not found", "CoverageNotDefined")
             return _xml(T.wcs_describe_coverage(layers, host))
         if req_name == "getcoverage":
-            return await self._getcoverage(
-                cfg, p, collector, q=q, path=request.path,
+            return await self._getcoverage_gated(
+                request, cfg, p, q, collector,
                 is_shard=bool(q.get("wshard")))
         raise OWSError(f"WCS request {p.request!r} not supported",
                        "OperationNotSupported")
+
+    async def _getcoverage_gated(self, request, cfg: Config, p, q,
+                                 collector, is_shard: bool):
+        """GetCoverage through the serving gateway.  Shard re-entries
+        (wshard=1 from a peer OWS) and auto-sized requests (width or
+        height 0, resolved against the live index) bypass the cache;
+        huge exports exceed the per-entry byte cap at put() and simply
+        aren't retained."""
+        key = meta = None
+        if self.gateway is not None and not is_shard and p.coverages \
+                and p.bbox is not None and p.crs is not None \
+                and p.width > 0 and p.height > 0:
+            lay, style = self._resolve_layer(cfg, p.coverages[0],
+                                             p.styles, "wcs")
+            if lay.cache_max_age > 0:
+                key, fp = self._response_key(cfg, "cov", lay, style, p,
+                                             q, p.width, p.height)
+                meta = (cfg.service_config.namespace, lay.name, fp,
+                        lay.cache_max_age)
+        return await self._serve_gated(
+            request, "WCS", key, meta, collector,
+            lambda: self._getcoverage(cfg, p, collector, q=q,
+                                      path=request.path,
+                                      is_shard=is_shard))
 
     async def _getcoverage(self, cfg: Config, p, collector, q=None,
                            path: str = "/ows", is_shard: bool = False):
@@ -800,7 +977,10 @@ class OWSServer:
         if req_name != "execute":
             raise OWSError(f"WPS request {p.request!r} not supported",
                            "OperationNotSupported")
+        async with self._admit("WPS"):
+            return await self._wps_execute(cfg, p)
 
+    async def _wps_execute(self, cfg: Config, p) -> web.Response:
         proc = cfg.process(p.identifier)
         if proc is None:
             raise OWSError(f"process {p.identifier!r} not found",
@@ -860,6 +1040,39 @@ class OWSServer:
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+# query params represented canonically (parsed/normalised) inside the
+# cache key; everything else is folded in verbatim as `extras`
+_KEY_CONSUMED = frozenset({
+    "service", "request", "version", "layers", "layer", "styles",
+    "style", "crs", "srs", "bbox", "width", "height", "format", "time",
+    "coverage", "coverageid", "identifier", "subset", "exceptions",
+})
+
+
+def _freeze_response(resp: web.StreamResponse):
+    """(status, content_type, body, kept_headers) for responses whose
+    body is in RAM; streaming responses (FileResponse) pass through
+    unfrozen — they can be returned once, by the flight leader."""
+    body = getattr(resp, "body", None)
+    if not isinstance(body, (bytes, bytearray)):
+        return resp
+    keep = tuple((k, resp.headers[k]) for k in ("Content-Disposition",)
+                 if k in resp.headers)
+    return (resp.status, resp.content_type, bytes(body), keep)
+
+
+def _etag_match(header: str, etag: str) -> bool:
+    if header.strip() == "*":
+        return True
+    for tok in header.split(","):
+        tok = tok.strip()
+        if tok.startswith("W/"):
+            tok = tok[2:]
+        if tok == etag:
+            return True
+    return False
+
 
 def _render_with_fusion(pipe: TilePipeline, req: GeoTileRequest, lay: Layer,
                         cfg: Config, server: OWSServer):
@@ -935,7 +1148,9 @@ def _png(data: bytes) -> web.Response:
     return web.Response(body=data, content_type="image/png")
 
 
-def _exception_response(e: OWSError) -> web.Response:
+def _exception_response(e: OWSError,
+                        headers: Optional[Dict[str, str]] = None
+                        ) -> web.Response:
     return web.Response(text=T.service_exception(str(e), e.code),
                         content_type="application/vnd.ogc.se_xml",
-                        status=e.status)
+                        status=e.status, headers=headers)

@@ -1,0 +1,63 @@
+"""Serving gateway: the tier between `server/ows.py` and the TPU
+pipelines.
+
+Three cooperating pieces (plus the HTTP cache semantics the OWS layer
+adds on top):
+
+- :mod:`.response_cache` — byte-budgeted LRU of fully-encoded
+  responses, canonical keying, per-layer TTLs, reload invalidation
+- :mod:`.singleflight` — in-flight dedup: N concurrent identical
+  requests trigger exactly one pipeline render
+- :mod:`.admission` — per-service-class bounded concurrency with a
+  queue-wait deadline that sheds overload as 503 + Retry-After
+
+`default_gateway` is the process-wide instance (the same module-level
+singleton pattern as `pipeline.scene_cache.default_scene_cache`);
+servers can be handed a private gateway for isolation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .admission import (AdmissionController, AdmissionShed,
+                        DEFAULT_QUEUE_DEADLINE_S)
+from .response_cache import (CachedResponse, ResponseCache, canonical_key,
+                             layer_fingerprint, make_entry, quantise_bbox)
+from .singleflight import SingleFlight
+
+__all__ = [
+    "AdmissionController", "AdmissionShed", "CachedResponse",
+    "ResponseCache", "ServingGateway", "SingleFlight", "canonical_key",
+    "default_gateway", "layer_fingerprint", "make_entry",
+    "quantise_bbox",
+]
+
+
+class ServingGateway:
+    """Response cache + singleflight + admission, composed."""
+
+    def __init__(self, cache: Optional[ResponseCache] = None,
+                 flight: Optional[SingleFlight] = None,
+                 admission: Optional[AdmissionController] = None):
+        self.cache = cache or ResponseCache()
+        self.flight = flight or SingleFlight()
+        self.admission = admission or AdmissionController()
+
+    def cache_counters(self) -> Dict:
+        """The compact counter block `server/metrics.py::_cache_stats`
+        folds into every metrics record."""
+        return {"hits": self.cache.hits, "misses": self.cache.misses,
+                "inflight_joined": self.flight.joined,
+                "shed": self.admission.total_shed}
+
+    def stats(self) -> Dict:
+        """The full /debug document block."""
+        return {"response_cache": self.cache.stats(),
+                "singleflight": {"leaders": self.flight.leaders,
+                                 "joined": self.flight.joined,
+                                 "inflight": self.flight.inflight},
+                "admission": self.admission.stats()}
+
+
+default_gateway = ServingGateway()

@@ -1,0 +1,138 @@
+"""Per-service-class admission control with load shedding.
+
+The pipelines behind the gateway have finite concurrency (device HBM,
+decode threads, worker pool slots); past that point extra in-flight
+requests only grow queueing delay until every request times out at
+once — the classic latency collapse.  Admission control bounds the
+in-flight renders per service class (WMS tiles are cheap and plentiful,
+WCS exports are heavy, WPS drills heavier), queues a short overflow,
+and shifts from queueing to *shedding* once a request has waited past
+its deadline: a fast OGC-exception 503 with ``Retry-After`` costs the
+client a retry, not a timeout, and costs the server nothing.
+
+Limits come from ``GSKY_ADMIT_{WMS,WCS,WPS,DAP4}``; the queue-wait
+deadline from ``GSKY_ADMIT_QUEUE_S``.  The primitives are
+``threading``-based (awaited via ``asyncio.to_thread``) so one
+process-wide controller serves any number of event loops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import threading
+from typing import Dict, Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+DEFAULT_LIMITS = {
+    "WMS": _env_int("GSKY_ADMIT_WMS", 32),
+    "WCS": _env_int("GSKY_ADMIT_WCS", 8),
+    "WPS": _env_int("GSKY_ADMIT_WPS", 4),
+    "DAP4": _env_int("GSKY_ADMIT_DAP4", 8),
+}
+DEFAULT_QUEUE_DEADLINE_S = _env_float("GSKY_ADMIT_QUEUE_S", 5.0)
+
+
+class AdmissionShed(Exception):
+    """Raised when a request waited past the queue deadline; maps to
+    HTTP 503 + Retry-After at the OWS layer."""
+
+    def __init__(self, service_class: str, retry_after: int):
+        super().__init__(
+            f"{service_class} service at capacity; retry after "
+            f"{retry_after}s")
+        self.service_class = service_class
+        self.retry_after = retry_after
+
+
+class _ClassState:
+    __slots__ = ("limit", "sem", "in_use", "queued", "shed", "admitted")
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.sem = threading.Semaphore(limit)
+        self.in_use = 0
+        self.queued = 0
+        self.shed = 0
+        self.admitted = 0
+
+
+class AdmissionController:
+    def __init__(self, limits: Optional[Dict[str, int]] = None,
+                 queue_deadline_s: float = DEFAULT_QUEUE_DEADLINE_S):
+        merged = dict(DEFAULT_LIMITS)
+        if limits:
+            merged.update(limits)
+        self._lock = threading.Lock()
+        self._classes = {svc: _ClassState(n) for svc, n in merged.items()}
+        self.queue_deadline_s = queue_deadline_s
+
+    def _state(self, service_class: str) -> _ClassState:
+        st = self._classes.get(service_class)
+        if st is None:      # unknown class: fail open under WMS limits
+            st = self._classes.get("WMS")
+            if st is None:
+                with self._lock:
+                    st = self._classes.setdefault(
+                        service_class, _ClassState(32))
+        return st
+
+    @contextlib.asynccontextmanager
+    async def admit(self, service_class: str):
+        st = self._state(service_class)
+        ok = st.sem.acquire(blocking=False)
+        if not ok:
+            with self._lock:
+                st.queued += 1
+            try:
+                # block in a worker thread, not the event loop
+                ok = await asyncio.to_thread(
+                    st.sem.acquire, True, self.queue_deadline_s)
+            finally:
+                with self._lock:
+                    st.queued -= 1
+        if not ok:
+            with self._lock:
+                st.shed += 1
+            raise AdmissionShed(
+                service_class,
+                retry_after=max(1, int(round(self.queue_deadline_s))))
+        with self._lock:
+            st.in_use += 1
+            st.admitted += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                st.in_use -= 1
+            st.sem.release()
+
+    @property
+    def total_shed(self) -> int:
+        with self._lock:
+            return sum(st.shed for st in self._classes.values())
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "queue_deadline_s": self.queue_deadline_s,
+                "classes": {
+                    svc: {"limit": st.limit, "in_use": st.in_use,
+                          "queued": st.queued, "admitted": st.admitted,
+                          "shed": st.shed}
+                    for svc, st in self._classes.items()}}

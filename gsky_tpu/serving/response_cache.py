@@ -1,0 +1,219 @@
+"""Byte-budgeted LRU cache of fully-encoded OWS responses.
+
+The scene/drill caches (`pipeline/scene_cache.py`, `pipeline/
+drill_cache.py`) amortise *input* and *device* work; this tier sits in
+front of the pipelines entirely and replays the finished bytes
+(PNG/JPEG/GeoTIFF + content type) for byte-identical requests — the
+output-cache role memcached/varnish plays in front of a production tile
+server, and the only tier whose hit costs zero device time.
+
+Keying is canonical, not textual: the key is built from the *parsed*
+request (layer, resolved style, CRS, bbox quantised to the tile grid,
+size, format, times, extra dimensions), so equivalent KVP spellings —
+1.1.1 lon/lat vs 1.3.0 lat/lon bbox order, case differences, parameter
+order — land on the same entry.  A fingerprint of the layer's resolved
+config is folded into every key: a SIGHUP reload that changes a layer
+re-fingerprints it, so stale entries can never hit again even before
+the eager `invalidate()` sweep prunes them.
+
+Entries carry a TTL derived from the layer's ``cache_max_age`` and are
+evicted LRU by body bytes against a process-wide budget
+(``GSKY_RESPONSE_CACHE_BYTES``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set, Tuple
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+DEFAULT_CACHE_BYTES = _env_int("GSKY_RESPONSE_CACHE_BYTES", 256 << 20)
+DEFAULT_MAX_ENTRY_BYTES = _env_int("GSKY_RESPONSE_CACHE_MAX_ENTRY",
+                                   32 << 20)
+
+
+def quantise_bbox(xmin: float, ymin: float, xmax: float, ymax: float,
+                  width: int, height: int) -> Tuple[int, int, int, int]:
+    """Snap bbox coordinates to 1/256th-of-a-pixel of the requested
+    grid.  Clients emit the same tile with differing float formatting
+    (trailing digits, axis-order normalisation residue); quantising to
+    the tile grid makes those spellings collide while keeping genuinely
+    different tiles apart (a 1/256-px shift is far below a resampling
+    kernel's support)."""
+    qx = max((xmax - xmin), 1e-12) / max(width, 1) / 256.0
+    qy = max((ymax - ymin), 1e-12) / max(height, 1) / 256.0
+    return (int(round(xmin / qx)), int(round(ymin / qy)),
+            int(round(xmax / qx)), int(round(ymax / qy)))
+
+
+def _plain(obj):
+    if dataclasses.is_dataclass(obj):
+        return {f.name: _plain(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)
+                if not f.name.startswith("_")
+                and f.name != "timestamp_token"}  # volatile MAS token
+    if isinstance(obj, (list, tuple)):
+        return [_plain(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): _plain(v) for k, v in sorted(obj.items())}
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return repr(obj)
+
+
+def layer_fingerprint(layer) -> str:
+    """Stable digest of a layer's resolved config (styles, palettes,
+    scaling, dates, ... — everything that shapes the rendered bytes).
+    Memoised on the layer object: config reloads build fresh Layer
+    instances, so a changed layer gets a fresh fingerprint and its old
+    cache entries are orphaned."""
+    fp = getattr(layer, "_serving_fp", None)
+    if fp is None:
+        doc = json.dumps(_plain(layer), sort_keys=True,
+                         separators=(",", ":"), default=repr)
+        fp = hashlib.sha1(doc.encode()).hexdigest()[:16]
+        try:
+            object.__setattr__(layer, "_serving_fp", fp)
+        except (AttributeError, TypeError):
+            pass
+    return fp
+
+
+def canonical_key(**parts) -> str:
+    """Digest of the canonical request parts; hashable, fixed-size."""
+    doc = json.dumps({k: _plain(v) for k, v in sorted(parts.items())},
+                     sort_keys=True, separators=(",", ":"), default=repr)
+    return hashlib.sha1(doc.encode()).hexdigest()
+
+
+@dataclass
+class CachedResponse:
+    body: bytes
+    content_type: str
+    status: int
+    etag: str
+    namespace: str
+    layer: str
+    layer_fp: str
+    max_age: int
+    expires: float                        # monotonic deadline
+    headers: Tuple[Tuple[str, str], ...] = ()   # e.g. Content-Disposition
+
+
+def make_entry(body: bytes, content_type: str, status: int,
+               namespace: str, layer: str, layer_fp: str, max_age: int,
+               headers: Tuple[Tuple[str, str], ...] = ()
+               ) -> CachedResponse:
+    etag = '"' + hashlib.sha256(body).hexdigest()[:32] + '"'
+    return CachedResponse(
+        body=body, content_type=content_type, status=status,
+        etag=etag, namespace=namespace, layer=layer, layer_fp=layer_fp,
+        max_age=max_age, expires=time.monotonic() + max_age,
+        headers=headers)
+
+
+class ResponseCache:
+    """Thread-safe LRU of CachedResponse keyed by canonical request
+    digest, bounded by total body bytes."""
+
+    def __init__(self, max_bytes: int = DEFAULT_CACHE_BYTES,
+                 max_entry_bytes: int = DEFAULT_MAX_ENTRY_BYTES):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, CachedResponse]" = OrderedDict()
+        self._bytes = 0
+        self.max_bytes = max_bytes
+        self.max_entry_bytes = max_entry_bytes
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.expirations = 0
+        self.invalidations = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+    def get(self, key: str) -> Optional[CachedResponse]:
+        now = time.monotonic()
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            if now >= ent.expires:
+                self._drop(key)
+                self.expirations += 1
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return ent
+
+    def put(self, key: str, ent: CachedResponse) -> bool:
+        n = len(ent.body)
+        if n > self.max_entry_bytes or n > self.max_bytes \
+                or ent.max_age <= 0:
+            return False
+        with self._lock:
+            if key in self._entries:
+                self._drop(key)
+            self._entries[key] = ent
+            self._bytes += n
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                old, _ = next(iter(self._entries.items()))
+                self._drop(old)
+                self.evictions += 1
+            return True
+
+    def _drop(self, key: str) -> None:
+        ent = self._entries.pop(key, None)
+        if ent is not None:
+            self._bytes -= len(ent.body)
+
+    def invalidate(self, namespace_fps: Dict[str, Set[str]]) -> int:
+        """Eager reload sweep: drop every entry whose namespace is gone
+        or whose layer fingerprint no longer exists in that namespace's
+        freshly-loaded config.  (Correctness doesn't depend on this —
+        fingerprints in the key already orphan stale entries — but the
+        sweep returns the bytes to the budget immediately.)"""
+        dropped = 0
+        with self._lock:
+            for key in list(self._entries):
+                ent = self._entries[key]
+                fps = namespace_fps.get(ent.namespace)
+                if fps is None or ent.layer_fp not in fps:
+                    self._drop(key)
+                    dropped += 1
+            self.invalidations += dropped
+        return dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "max_bytes": self.max_bytes, "hits": self.hits,
+                    "misses": self.misses, "evictions": self.evictions,
+                    "expirations": self.expirations,
+                    "invalidations": self.invalidations}

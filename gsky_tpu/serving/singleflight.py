@@ -1,0 +1,92 @@
+"""Asyncio in-flight request deduplication (singleflight).
+
+When N identical tile requests arrive while the first is still
+rendering, exactly one walks the MAS-index -> decode -> TPU pipeline;
+the other N-1 await the leader's future and share its result bytes —
+or its error: a failing render fails every waiter once instead of
+being retried N times against an already-struggling backend (the
+groupcache/golang.org/x/sync "singleflight" contract).
+
+Flights are keyed on the same canonical digest as the response cache,
+so the dedup window is exactly the cache-miss window.  Completed
+flights are forgotten immediately — reuse across time is the response
+cache's job, not this tier's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Callable, Dict, Tuple
+
+
+class _Call:
+    __slots__ = ("loop", "future", "waiters")
+
+    def __init__(self, loop, future):
+        self.loop = loop
+        self.future = future
+        self.waiters = 0
+
+
+class SingleFlight:
+    """``await flight.do(key, fn)`` -> ``(result, joined)``.
+
+    ``fn`` is an async callable executed by exactly one caller per key
+    at a time; concurrent callers with the same key get the leader's
+    result (``joined=True``).  Futures are loop-bound, so a caller on a
+    *different* event loop (multi-loop test harnesses) safely bypasses
+    dedup and executes ``fn`` itself.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._calls: Dict[str, _Call] = {}
+        self.leaders = 0
+        self.joined = 0
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._calls)
+
+    async def do(self, key: str,
+                 fn: Callable[[], Any]) -> Tuple[Any, bool]:
+        loop = asyncio.get_running_loop()
+        with self._lock:
+            call = self._calls.get(key)
+            if call is None:
+                call = self._calls[key] = _Call(loop, loop.create_future())
+                self.leaders += 1
+                lead = True
+            elif call.loop is loop:
+                call.waiters += 1
+                self.joined += 1
+                lead = False
+            else:
+                call = None     # cross-loop: render independently
+                lead = True
+
+        if call is None:
+            return await fn(), False
+        if not lead:
+            # shield: one waiter's disconnect must not cancel the
+            # shared future out from under the others
+            return await asyncio.shield(call.future), True
+
+        try:
+            result = await fn()
+        except BaseException as e:
+            with self._lock:
+                self._calls.pop(key, None)
+                waiters = call.waiters
+            if waiters > 0:
+                call.future.set_exception(e)
+            else:       # nobody listening: avoid un-retrieved warnings
+                call.future.cancel()
+            raise
+        else:
+            with self._lock:
+                self._calls.pop(key, None)
+            call.future.set_result(result)
+            return result, False

@@ -15,8 +15,19 @@ import os
 # os.environ — tests must be hermetic on CPU regardless of device state
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# 8 virtual CPU devices: newer jax exposes jax_num_cpu_devices; older
+# builds only honour the XLA flag, which must be set before the backend
+# initialises — set both so the suite runs on either
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        (_flags + " --xla_force_host_platform_device_count=8").strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # pre-0.5 jax: the XLA_FLAGS path above did the job

@@ -1,0 +1,356 @@
+"""Serving gateway tests: rendered-response cache, singleflight dedup,
+admission control / load shedding, HTTP cache semantics (ETag/304), and
+config-reload invalidation — over the real OWS server + fixture archive.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from gsky_tpu.index import MASClient
+from gsky_tpu.pipeline.tile import TilePipeline
+from gsky_tpu.server.config import ConfigWatcher
+from gsky_tpu.server.metrics import MetricsLogger
+from gsky_tpu.server.ows import OWSServer
+from gsky_tpu.serving import (AdmissionController, AdmissionShed,
+                              ResponseCache, ServingGateway, SingleFlight,
+                              make_entry, quantise_bbox)
+
+from fixtures import make_archive
+
+DATE = "2020-01-10T00:00:00.000Z"
+BBOX3857 = "16478548,-4211230,16489679,-4198025"
+BBOX3857_B = "16478548,-4211230,16489679,-4198026"   # a different tile
+
+
+@pytest.fixture(scope="module")
+def arch(tmp_path_factory):
+    return make_archive(str(tmp_path_factory.mktemp("serv") / "data"))
+
+
+def make_env(tmp_path, arch, gateway=None, extra_layers=(),
+             layer_extra=None):
+    conf = tmp_path / "conf"
+    conf.mkdir()
+    layer = {"name": "landsat", "title": "L",
+             "data_source": arch["root"],
+             "rgb_products": ["LC08_20200110_T1"], "dates": [DATE]}
+    if layer_extra:
+        layer.update(layer_extra)
+    config = {"service_config": {"ows_hostname": "",
+                                 "mas_address": "inproc"},
+              "layers": [layer] + list(extra_layers)}
+    (conf / "config.json").write_text(json.dumps(config))
+    mas = MASClient(arch["store"])
+    watcher = ConfigWatcher(str(conf), mas_factory=lambda a: mas,
+                            install_signal=False)
+    server = OWSServer(watcher, mas_factory=lambda a: mas,
+                       metrics=MetricsLogger(),
+                       gateway=gateway or ServingGateway())
+    return server, watcher, conf
+
+
+def getmap(layer="landsat", bbox=BBOX3857, size=64, crs="EPSG:3857",
+           version="1.3.0", time_=DATE, extra=""):
+    return (f"/ows?service=WMS&request=GetMap&version={version}"
+            f"&layers={layer}&crs={crs}&bbox={bbox}"
+            f"&width={size}&height={size}&format=image/png"
+            f"&time={time_}{extra}")
+
+
+def fetch(server, paths, headers=None):
+    """Issue all paths CONCURRENTLY on one event loop; returns
+    [(status, content_type, body, headers), ...] in order."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        client = TestClient(TestServer(server.app()))
+        await client.start_server()
+        try:
+            async def one(p):
+                resp = await client.get(p, headers=headers or {})
+                # keep the CIMultiDict: header lookups stay
+                # case-insensitive ("ETag" vs wire-cased "Etag")
+                return (resp.status, resp.content_type,
+                        await resp.read(), resp.headers)
+            return await asyncio.gather(*(one(p) for p in paths))
+        finally:
+            await client.close()
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+@pytest.fixture
+def render_calls(monkeypatch):
+    """Count pipeline renders (the landsat layer takes the fused
+    single-band fast path -> render_composite_byte) and slow each one
+    slightly so concurrent requests genuinely overlap."""
+    calls = {"n": 0}
+    orig = TilePipeline.render_composite_byte
+
+    def counting(self, *a, **k):
+        calls["n"] += 1
+        time.sleep(0.3)
+        return orig(self, *a, **k)
+    monkeypatch.setattr(TilePipeline, "render_composite_byte", counting)
+    return calls
+
+
+class TestSingleflight:
+    def test_concurrent_identical_requests_render_once(
+            self, tmp_path, arch, render_calls):
+        server, _, _ = make_env(tmp_path, arch)
+        results = fetch(server, [getmap()] * 6)
+        assert [r[0] for r in results] == [200] * 6
+        bodies = {r[2] for r in results}
+        assert len(bodies) == 1 and results[0][1] == "image/png"
+        # exactly ONE pipeline render for 6 concurrent identical tiles
+        assert render_calls["n"] == 1
+        assert server.gateway.flight.joined == 5
+        # one leader missed, five joined; none were cache hits
+        tags = {r[3]["X-Gsky-Cache"] for r in results}
+        assert tags == {"miss", "join"}
+
+    def test_error_shared_not_retried(self):
+        sf = SingleFlight()
+        calls = {"n": 0}
+
+        async def go():
+            async def fn():
+                calls["n"] += 1
+                await asyncio.sleep(0.05)
+                raise RuntimeError("boom")
+
+            return await asyncio.gather(
+                *(sf.do("k", fn) for _ in range(4)),
+                return_exceptions=True)
+        res = asyncio.new_event_loop().run_until_complete(go())
+        assert len(res) == 4
+        assert all(isinstance(r, RuntimeError) for r in res)
+        assert calls["n"] == 1      # the failure was not retried N times
+        assert sf.inflight == 0     # flight forgotten after completion
+
+    def test_sequential_calls_are_fresh_flights(self):
+        sf = SingleFlight()
+
+        async def go():
+            async def fn(v):
+                return v
+            a, ja = await sf.do("k", lambda: fn(1))
+            b, jb = await sf.do("k", lambda: fn(2))
+            return a, ja, b, jb
+        a, ja, b, jb = asyncio.new_event_loop().run_until_complete(go())
+        # singleflight dedups only the in-flight window; reuse across
+        # time is the response cache's job
+        assert (a, ja, b, jb) == (1, False, 2, False)
+
+
+class TestResponseCacheHTTP:
+    def test_repeat_served_from_cache(self, tmp_path, arch, render_calls):
+        server, _, _ = make_env(tmp_path, arch)
+        (s1, ct1, b1, h1), = fetch(server, [getmap()])
+        assert s1 == 200 and render_calls["n"] == 1
+        (s2, ct2, b2, h2), = fetch(server, [getmap()])
+        assert s2 == 200
+        assert render_calls["n"] == 1          # pipeline untouched
+        assert h2["X-Gsky-Cache"] == "hit"
+        assert ct2 == "image/png" and b2 == b1  # content-type replayed
+        assert h2["ETag"] == h1["ETag"]
+        assert h2["Cache-Control"] == "max-age=300"
+        assert server.gateway.cache.hits >= 1
+
+    def test_if_none_match_304(self, tmp_path, arch, render_calls):
+        server, _, _ = make_env(tmp_path, arch)
+        (_, _, _, h1), = fetch(server, [getmap()])
+        etag = h1["ETag"]
+        assert etag.startswith('"') and etag.endswith('"')
+        (s2, _, b2, h2), = fetch(server, [getmap()],
+                                 headers={"If-None-Match": etag})
+        assert s2 == 304
+        assert b2 == b""
+        assert h2["ETag"] == etag
+        # stale validator still gets the full body
+        (s3, _, b3, _), = fetch(server, [getmap()],
+                                headers={"If-None-Match": '"nope"'})
+        assert s3 == 200 and len(b3) > 0
+
+    def test_equivalent_kvp_spellings_share_entry(
+            self, tmp_path, arch, render_calls):
+        """1.1.1 lon/lat vs 1.3.0 lat/lon spellings of the same tile
+        must land on one cache entry (canonical, not textual, keying)."""
+        server, _, _ = make_env(tmp_path, arch)
+        u111 = getmap(bbox="148.02,-35.32,148.12,-35.22",
+                      crs="EPSG:4326", version="1.1.1")
+        u130 = getmap(bbox="-35.32,148.02,-35.22,148.12",
+                      crs="EPSG:4326", version="1.3.0")
+        (s1, _, b1, _), = fetch(server, [u111.replace("crs=", "srs=")])
+        (s2, _, b2, h2), = fetch(server, [u130])
+        assert s1 == s2 == 200
+        assert b1 == b2
+        assert h2["X-Gsky-Cache"] == "hit"
+        assert render_calls["n"] == 1
+
+    def test_cache_disabled_layer(self, tmp_path, arch, render_calls):
+        server, _, _ = make_env(tmp_path, arch,
+                                layer_extra={"cache_max_age": 0})
+        fetch(server, [getmap()])
+        fetch(server, [getmap()])
+        assert render_calls["n"] == 2       # every request rendered
+        assert len(server.gateway.cache) == 0
+
+
+class TestAdmission:
+    def test_saturated_class_sheds_503(self, tmp_path, arch,
+                                       render_calls):
+        gw = ServingGateway(admission=AdmissionController(
+            limits={"WMS": 1}, queue_deadline_s=0.05))
+        server, _, _ = make_env(tmp_path, arch, gateway=gw)
+        # two DIFFERENT tiles: no flight join, both need a WMS slot
+        results = fetch(server, [getmap(), getmap(bbox=BBOX3857_B)])
+        statuses = sorted(r[0] for r in results)
+        assert statuses == [200, 503]
+        shed = next(r for r in results if r[0] == 503)
+        assert "Retry-After" in shed[3]
+        assert int(shed[3]["Retry-After"]) >= 1
+        assert b"ServiceException" in shed[2]   # OGC exception body
+        # the shed is observable in /debug
+        (_, _, body, _), = fetch(server, ["/debug"])
+        doc = json.loads(body)
+        adm = doc["serving"]["admission"]["classes"]["WMS"]
+        assert adm["shed"] >= 1 and adm["limit"] == 1
+        assert doc["serving"]["response_cache"]["entries"] >= 1
+
+    def test_admission_unit_shed_and_release(self):
+        ac = AdmissionController(limits={"WMS": 1},
+                                 queue_deadline_s=0.05)
+
+        async def go():
+            async def hold():
+                async with ac.admit("WMS"):
+                    await asyncio.sleep(0.3)
+                    return "ok"
+
+            async def late():
+                await asyncio.sleep(0.05)
+                async with ac.admit("WMS"):
+                    return "late-ok"
+            return await asyncio.gather(hold(), late(),
+                                        return_exceptions=True)
+        r = asyncio.new_event_loop().run_until_complete(go())
+        assert r[0] == "ok"
+        assert isinstance(r[1], AdmissionShed)
+        st = ac.stats()["classes"]["WMS"]
+        assert st["shed"] == 1 and st["in_use"] == 0
+        assert st["admitted"] == 1
+
+        # slot released: a fresh request admits immediately
+        async def again():
+            async with ac.admit("WMS"):
+                return True
+        assert asyncio.new_event_loop().run_until_complete(again())
+
+
+class TestReloadInvalidation:
+    def test_changed_layer_invalidated_unchanged_survives(
+            self, tmp_path, arch, render_calls):
+        second = {"name": "landsat2", "title": "L2",
+                  "data_source": arch["root"],
+                  "rgb_products": ["LC08_20200110_T1"], "dates": [DATE]}
+        server, watcher, conf = make_env(tmp_path, arch,
+                                         extra_layers=[second])
+        fetch(server, [getmap(), getmap(layer="landsat2")])
+        assert render_calls["n"] == 2
+        # both cached now
+        fetch(server, [getmap(), getmap(layer="landsat2")])
+        assert render_calls["n"] == 2
+
+        # change only `landsat` (scaling shift alters rendered bytes)
+        cfg = json.loads((conf / "config.json").read_text())
+        cfg["layers"][0]["offset_value"] = 5.0
+        (conf / "config.json").write_text(json.dumps(cfg))
+        watcher.reload()
+        assert server.gateway.cache.invalidations >= 1
+
+        (sa, _, _, ha), (sb, _, _, hb) = fetch(
+            server, [getmap(), getmap(layer="landsat2")])
+        assert sa == sb == 200
+        assert ha["X-Gsky-Cache"] == "miss"   # changed layer re-rendered
+        assert hb["X-Gsky-Cache"] == "hit"    # unchanged layer survived
+        assert render_calls["n"] == 3
+
+
+class TestResponseCacheUnit:
+    def _ent(self, body=b"x" * 40, max_age=60):
+        return make_entry(body, "image/png", 200, "", "lay", "fp",
+                          max_age)
+
+    def test_lru_byte_budget(self):
+        rc = ResponseCache(max_bytes=100, max_entry_bytes=100)
+        for i in range(3):
+            assert rc.put(f"k{i}", self._ent())
+        assert rc.evictions == 1
+        assert rc.get("k0") is None          # oldest evicted
+        assert rc.get("k1") is not None and rc.get("k2") is not None
+        assert rc.bytes <= 100
+
+    def test_lru_recency(self):
+        rc = ResponseCache(max_bytes=100, max_entry_bytes=100)
+        rc.put("a", self._ent())
+        rc.put("b", self._ent())
+        assert rc.get("a") is not None       # refresh a
+        rc.put("c", self._ent())             # evicts b, not a
+        assert rc.get("b") is None
+        assert rc.get("a") is not None
+
+    def test_ttl_expiry(self):
+        rc = ResponseCache()
+        rc.put("k", self._ent(max_age=1))
+        assert rc.get("k") is not None
+        ent = rc._entries["k"]
+        ent.expires = 0.0                    # force expiry
+        assert rc.get("k") is None
+        assert rc.expirations == 1
+
+    def test_rejects_oversize_and_zero_ttl(self):
+        rc = ResponseCache(max_bytes=1000, max_entry_bytes=10)
+        assert not rc.put("big", self._ent(body=b"y" * 11))
+        assert not rc.put("nottl", self._ent(body=b"y", max_age=0))
+        assert len(rc) == 0
+
+    def test_invalidate_by_fingerprint(self):
+        rc = ResponseCache()
+        rc.put("a", make_entry(b"1", "t", 200, "ns1", "lay", "OLD", 60))
+        rc.put("b", make_entry(b"2", "t", 200, "ns1", "lay2", "KEEP", 60))
+        rc.put("c", make_entry(b"3", "t", 200, "gone", "lay", "X", 60))
+        dropped = rc.invalidate({"ns1": {"KEEP", "NEW"}})
+        assert dropped == 2                  # stale fp + dead namespace
+        assert rc.get("b") is not None
+        assert rc.get("a") is None and rc.get("c") is None
+
+    def test_quantise_bbox_spelling_collision(self):
+        a = quantise_bbox(16478548.0, -4211230.0, 16489679.0,
+                          -4198025.0, 256, 256)
+        b = quantise_bbox(16478548.0000001, -4211229.9999999,
+                          16489679.0000002, -4198025.0000001, 256, 256)
+        assert a == b
+        # a genuinely different tile does not collide
+        c = quantise_bbox(16478548.0, -4211230.0, 16489679.0,
+                          -4198026.0, 256, 256)
+        assert a != c
+
+
+class TestProfileSerialized:
+    def test_overlapping_profile_capture_409(self, tmp_path, arch):
+        server, _, _ = make_env(tmp_path, arch)
+        server.temp_dir = str(tmp_path)
+        (s0, _, _, _), = fetch(server, ["/debug/profile?seconds=0.1"])
+        if s0 != 200:
+            pytest.skip("jax profiler unavailable on this backend")
+        results = fetch(server, ["/debug/profile?seconds=0.5"] * 2)
+        statuses = sorted(r[0] for r in results)
+        # one capture proceeds; the overlapping one is rejected, not
+        # allowed to wedge the profiler
+        assert statuses == [200, 409]
+        busy = next(r for r in results if r[0] == 409)
+        assert b"in progress" in busy[2]

@@ -1,9 +1,14 @@
 #!/usr/bin/env python
-"""Soak the in-process OWS server: sustained GetMap load across a
+"""Soak the in-process OWS server.
+
+Two scenarios:
+
+``--scenario churn`` (default): sustained GetMap load across a
 DISTINCT-tile sweep (cache churn, not cache hits) while sampling the
 process RSS and the /debug cache sizes — the leak/bounds check a
 long-lived tile server needs and the acceptance suite's fixed grid
-can't give.
+can't give.  Runs with the serving gateway disabled so the RSS bound
+measures the pipeline tiers, not the response cache filling.
 
     JAX_PLATFORMS=cpu python tools/soak.py [--seconds 120] [--conc 8]
 
@@ -11,6 +16,14 @@ Exit 0 when (a) every request succeeded, (b) RSS growth over the
 steady-state phase (after the first quarter, which pays compiles +
 cache fills) is under --max-rss-growth-mb, and (c) the /debug cache
 sizes stay at or below their configured LRU bounds.
+
+``--scenario hot``: the public-tile-server access pattern — a FIXED
+tile grid with Zipf-distributed popularity — driven against a baseline
+server (gateway=None) and then a gateway-fronted one, reporting
+client-side p50/p99 per phase plus the gateway's response-cache hit
+rate, singleflight joins and admission sheds from /debug.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario hot --seconds 60
 """
 
 from __future__ import annotations
@@ -41,6 +54,10 @@ def main(argv=None):
     ap.add_argument("--seconds", type=float, default=120.0)
     ap.add_argument("--conc", type=int, default=8)
     ap.add_argument("--max-rss-growth-mb", type=float, default=256.0)
+    ap.add_argument("--scenario", choices=("churn", "hot"),
+                    default="churn")
+    ap.add_argument("--zipf", type=float, default=1.2,
+                    help="hot scenario: Zipf exponent of tile popularity")
     args = ap.parse_args(argv)
 
     from gsky_tpu.device import ensure_platform
@@ -77,36 +94,46 @@ def main(argv=None):
         }, fp)
     watcher = ConfigWatcher(conf_dir, mas_factory=lambda a: mas_client,
                             install_signal=False)
-    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
-                      metrics=MetricsLogger())
-    loop = asyncio.new_event_loop()
-    started = threading.Event()
-    host_holder = {}
 
-    def run_server():
-        asyncio.set_event_loop(loop)
-        from aiohttp import web
+    def boot(server) -> str:
+        """Serve on a private loop/thread; return host:port."""
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+        host_holder = {}
 
-        async def boot():
-            runner = web.AppRunner(server.app())
-            await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", 0)
-            await site.start()
-            host_holder["host"] = "127.0.0.1:%d" % \
-                site._server.sockets[0].getsockname()[1]
-            started.set()
-        loop.run_until_complete(boot())
-        loop.run_forever()
+        def run_server():
+            asyncio.set_event_loop(loop)
+            from aiohttp import web
 
-    threading.Thread(target=run_server, daemon=True).start()
-    started.wait(30)
-    host = host_holder["host"]
+            async def _boot():
+                runner = web.AppRunner(server.app())
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                host_holder["host"] = "127.0.0.1:%d" % \
+                    site._server.sockets[0].getsockname()[1]
+                started.set()
+            loop.run_until_complete(_boot())
+            loop.run_forever()
+
+        threading.Thread(target=run_server, daemon=True).start()
+        started.wait(30)
+        return host_holder["host"]
 
     span = B.SCENE_SIZE * 30.0
     core = BBox(590000.0, 6105000.0 - span * 1.3,
                 590000.0 + span * 1.3, 6105000.0)
     merc = transform_bbox(transform_bbox(core, utm, EPSG4326),
                           EPSG4326, EPSG3857)
+
+    if args.scenario == "hot":
+        return run_hot(args, watcher, mas_client, merc, boot)
+
+    # churn: gateway off — the RSS bound must measure the pipeline
+    # tiers, not the response cache legitimately filling its budget
+    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                       metrics=MetricsLogger(), gateway=None)
+    host = boot(server)
 
     rng = np.random.default_rng(1)
     counter = itertools.count()
@@ -164,6 +191,99 @@ def main(argv=None):
     ok = (n_bad == 0 and growth <= args.max_rss_growth_mb
           and exec_caches.get("geo_cache", 0) <= 256
           and exec_caches.get("stack_cache", 0) <= 32)
+    print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+    return 0 if ok else 1
+
+
+def run_hot(args, watcher, mas_client, merc, boot) -> int:
+    """Zipf-popular fixed tile grid vs baseline and gateway servers."""
+    import threading
+
+    import numpy as np
+
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+    from gsky_tpu.serving import ServingGateway
+
+    grid = 8
+    frac = np.linspace(0.0, 0.75, grid)
+    tiles = [(float(fx), float(fy)) for fx in frac for fy in frac]
+    w = merc.width * 0.25
+    rng = np.random.default_rng(7)
+    # rank -> tile: Zipf mass lands on a fixed handful of hot tiles
+    ranks = (rng.zipf(args.zipf, size=200_000) - 1) % len(tiles)
+
+    def url_for(host: str, k: int) -> str:
+        fx, fy = tiles[k]
+        bb = (f"{merc.xmin + fx * merc.width},"
+              f"{merc.ymin + fy * merc.height},"
+              f"{merc.xmin + fx * merc.width + w},"
+              f"{merc.ymin + fy * merc.height + w}")
+        return (f"http://{host}/ows?service=WMS&request=GetMap"
+                f"&version=1.3.0&layers=landsat&crs=EPSG:3857&bbox={bb}"
+                f"&width=256&height=256&format=image/png"
+                f"&time=2020-01-10T00:00:00.000Z")
+
+    def phase(host: str, seconds: float):
+        counter = itertools.count()
+        lats: list = []
+        bad = [0]
+        lock = threading.Lock()
+
+        def one(_):
+            k = int(ranks[next(counter) % len(ranks)])
+            t0 = time.time()
+            try:
+                with urllib.request.urlopen(url_for(host, k),
+                                            timeout=120) as r:
+                    ok = (r.status == 200
+                          and r.read()[:8] == b"\x89PNG\r\n\x1a\n")
+            except Exception:
+                ok = False
+            d = time.time() - t0
+            with lock:
+                lats.append(d)
+                if not ok:
+                    bad[0] += 1
+
+        t_end = time.time() + seconds
+        with cf.ThreadPoolExecutor(args.conc) as ex:
+            while time.time() < t_end:
+                list(ex.map(one, range(args.conc * 4)))
+        arr = np.array(lats) if lats else np.zeros(1)
+        return {"requests": len(lats), "failed": bad[0],
+                "rps": round(len(lats) / max(seconds, 1e-9), 1),
+                "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 1),
+                "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 1)}
+
+    half = args.seconds / 2.0
+    base_srv = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                         metrics=MetricsLogger(), gateway=None)
+    base = phase(boot(base_srv), half)
+
+    gate_srv = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                         metrics=MetricsLogger(),
+                         gateway=ServingGateway())
+    gate_host = boot(gate_srv)
+    gate = phase(gate_host, half)
+
+    with urllib.request.urlopen(f"http://{gate_host}/debug",
+                                timeout=30) as r:
+        serving = json.loads(r.read()).get("serving", {})
+    rc = serving.get("response_cache", {})
+    hits, misses = rc.get("hits", 0), rc.get("misses", 0)
+    gate["hit_rate"] = round(hits / max(hits + misses, 1), 3)
+    gate["singleflight_joined"] = serving.get(
+        "singleflight", {}).get("joined", 0)
+    gate["shed"] = sum(
+        c.get("shed", 0) for c in
+        serving.get("admission", {}).get("classes", {}).values())
+
+    out = {"scenario": "hot", "tiles": len(tiles),
+           "zipf": args.zipf, "baseline": base, "gateway": gate}
+    print(json.dumps(out))
+    ok = (base["failed"] == 0 and gate["failed"] == 0
+          and gate["hit_rate"] > 0.3)
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
 
