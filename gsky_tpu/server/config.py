@@ -583,6 +583,7 @@ class ConfigWatcher:
         self.root = root
         self.mas_factory = mas_factory
         self._lock = threading.Lock()
+        self._reload_lock = threading.Lock()
         self._configs = load_config_tree(root, mas_factory)
         # reload subscribers (serving-gateway cache invalidation, ...):
         # called with the fresh namespace->Config map after each swap
@@ -596,7 +597,22 @@ class ConfigWatcher:
     def add_listener(self, fn) -> None:
         self._listeners.append(fn)
 
+    def remove_listener(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
     def _on_hup(self, *_):
+        # never reload inline: the signal handler interrupts the main
+        # thread at an arbitrary point — possibly while it holds a lock
+        # a reload listener needs (e.g. the response cache's), which
+        # would self-deadlock the event loop.  A detached thread runs
+        # the reload against uninterrupted state instead.
+        threading.Thread(target=self._reload_logged,
+                         name="gsky-config-reload", daemon=True).start()
+
+    def _reload_logged(self):
         # a failed reload (malformed / mid-write config.json) must keep
         # the previous config live, as the reference's WatchConfig does
         try:
@@ -606,15 +622,16 @@ class ConfigWatcher:
                 "config reload failed, keeping previous config: %s", e)
 
     def reload(self):
-        configs = load_config_tree(self.root, self.mas_factory)
-        with self._lock:
-            self._configs = configs
-        for fn in list(self._listeners):
-            try:
-                fn(configs)
-            except Exception:
-                logging.getLogger("gsky.config").exception(
-                    "config reload listener failed")
+        with self._reload_lock:     # back-to-back SIGHUPs serialize
+            configs = load_config_tree(self.root, self.mas_factory)
+            with self._lock:
+                self._configs = configs
+            for fn in list(self._listeners):
+                try:
+                    fn(configs)
+                except Exception:
+                    logging.getLogger("gsky.config").exception(
+                        "config reload listener failed")
 
     @property
     def configs(self) -> Dict[str, Config]:

@@ -27,6 +27,7 @@ import os
 import tempfile
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -97,17 +98,8 @@ class OWSServer:
         # calls collide and wedge the profiler (threading.Lock, not
         # asyncio.Lock — handlers may run on different event loops)
         self._profile_mutex = threading.Lock()
-        if self.gateway is not None and \
-                hasattr(watcher, "add_listener"):
-            watcher.add_listener(self._on_config_reload)
-
-    def _on_config_reload(self, configs: Dict[str, Config]) -> None:
-        """SIGHUP reload hook: eagerly drop cached responses whose layer
-        config changed or vanished (the fingerprint folded into every
-        cache key already orphans them; this returns the bytes now)."""
-        fps = {ns: {layer_fingerprint(l) for l in cfg.layers}
-               for ns, cfg in configs.items()}
-        self.gateway.cache.invalidate(fps)
+        if self.gateway is not None:
+            _register_gateway_invalidation(watcher, self.gateway)
 
     # -- plumbing -----------------------------------------------------------
 
@@ -170,12 +162,19 @@ class OWSServer:
         """Build a per-request response from cached bytes with the HTTP
         cache contract: strong ETag, If-None-Match -> 304, per-layer
         Cache-Control."""
-        headers = {"ETag": ent.etag,
-                   "Cache-Control": f"max-age={ent.max_age}",
-                   "X-Gsky-Cache": cache_status}
-        inm = request.headers.get("If-None-Match", "")
-        if inm and _etag_match(inm, ent.etag):
-            return web.Response(status=304, headers=headers)
+        headers = {"X-Gsky-Cache": cache_status}
+        if ent.status == 200:
+            # Age = time already spent in our cache, so downstream
+            # caches don't stretch the layer TTL to ~2x (RFC 9111 §5.1)
+            age = int(max(0.0, min(
+                ent.max_age - (ent.expires - time.monotonic()),
+                ent.max_age)))
+            headers["ETag"] = ent.etag
+            headers["Cache-Control"] = f"max-age={ent.max_age}"
+            headers["Age"] = str(age)
+            inm = request.headers.get("If-None-Match", "")
+            if inm and _etag_match(inm, ent.etag):
+                return web.Response(status=304, headers=headers)
         for k, v in ent.headers:
             headers[k] = v
         return web.Response(body=ent.body, status=ent.status,
@@ -1048,6 +1047,38 @@ _KEY_CONSUMED = frozenset({
     "style", "crs", "srs", "bbox", "width", "height", "format", "time",
     "coverage", "coverageid", "identifier", "subset", "exceptions",
 })
+
+
+def _register_gateway_invalidation(watcher, gateway) -> None:
+    """Subscribe ``gateway``'s reload invalidation to ``watcher`` once
+    per (watcher, gateway) pair — constructing many servers against one
+    shared watcher/gateway (tests, embedding) must not accumulate
+    listeners or sweep the cache N times per reload.  The listener
+    holds the gateway weakly and unregisters itself when it dies."""
+    if not hasattr(watcher, "add_listener"):
+        return
+    registered = getattr(watcher, "_serving_gateways", None)
+    if registered is None:
+        registered = weakref.WeakSet()
+        try:
+            watcher._serving_gateways = registered
+        except AttributeError:
+            return
+    if gateway in registered:
+        return
+    registered.add(gateway)
+    gw_ref = weakref.ref(gateway)
+
+    def _listener(configs):
+        gw = gw_ref()
+        if gw is None:
+            remove = getattr(watcher, "remove_listener", None)
+            if remove is not None:
+                remove(_listener)
+            return
+        gw.invalidate_for_configs(configs)
+
+    watcher.add_listener(_listener)
 
 
 def _freeze_response(resp: web.StreamResponse):

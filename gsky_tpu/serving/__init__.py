@@ -44,6 +44,15 @@ class ServingGateway:
         self.flight = flight or SingleFlight()
         self.admission = admission or AdmissionController()
 
+    def invalidate_for_configs(self, configs) -> int:
+        """ConfigWatcher reload hook: eagerly drop cached responses
+        whose layer config changed or vanished (the fingerprint folded
+        into every cache key already orphans them; this returns the
+        bytes now)."""
+        fps = {ns: {layer_fingerprint(l) for l in cfg.layers}
+               for ns, cfg in configs.items()}
+        return self.cache.invalidate(fps)
+
     def cache_counters(self) -> Dict:
         """The compact counter block `server/metrics.py::_cache_stats`
         folds into every metrics record."""

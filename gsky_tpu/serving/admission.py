@@ -72,6 +72,22 @@ class _ClassState:
         self.admitted = 0
 
 
+def _release_orphaned_permit(st: _ClassState):
+    """Done-callback for a queued acquire whose request was cancelled
+    (client disconnect): the worker thread cannot be interrupted and may
+    still win the permit after the request is gone — hand it straight
+    back so the class's capacity is never leaked."""
+    def _cb(task) -> None:
+        try:
+            acquired = (not task.cancelled()
+                        and task.exception() is None and task.result())
+        except BaseException:
+            acquired = False
+        if acquired:
+            st.sem.release()
+    return _cb
+
+
 class AdmissionController:
     def __init__(self, limits: Optional[Dict[str, int]] = None,
                  queue_deadline_s: float = DEFAULT_QUEUE_DEADLINE_S):
@@ -99,10 +115,14 @@ class AdmissionController:
         if not ok:
             with self._lock:
                 st.queued += 1
+            # block in a worker thread, not the event loop
+            waiter = asyncio.ensure_future(asyncio.to_thread(
+                st.sem.acquire, True, self.queue_deadline_s))
             try:
-                # block in a worker thread, not the event loop
-                ok = await asyncio.to_thread(
-                    st.sem.acquire, True, self.queue_deadline_s)
+                ok = await asyncio.shield(waiter)
+            except asyncio.CancelledError:
+                waiter.add_done_callback(_release_orphaned_permit(st))
+                raise
             finally:
                 with self._lock:
                     st.queued -= 1

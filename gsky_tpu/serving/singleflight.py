@@ -74,8 +74,25 @@ class SingleFlight:
             # shared future out from under the others
             return await asyncio.shield(call.future), True
 
+        task = asyncio.ensure_future(fn())
         try:
-            result = await fn()
+            result = await asyncio.shield(task)
+        except asyncio.CancelledError:
+            # the LEADER's client disconnected — the joined waiters'
+            # clients did not.  If anyone joined, let the render finish
+            # in the background and hand them the result; only an
+            # unwatched flight aborts the render.
+            with self._lock:
+                abandoned = call.waiters == 0
+                if abandoned:
+                    self._calls.pop(key, None)
+            if abandoned:
+                task.cancel()
+                call.future.cancel()
+            else:
+                task.add_done_callback(
+                    lambda t: self._finish_orphan(key, call, t))
+            raise
         except BaseException as e:
             with self._lock:
                 self._calls.pop(key, None)
@@ -90,3 +107,18 @@ class SingleFlight:
                 self._calls.pop(key, None)
             call.future.set_result(result)
             return result, False
+
+    def _finish_orphan(self, key: str, call: _Call, task) -> None:
+        """Complete a flight whose leader was cancelled mid-render:
+        relay the finished render (or its error) to the waiters."""
+        with self._lock:
+            self._calls.pop(key, None)
+        fut = call.future
+        if fut.done():
+            return
+        if task.cancelled():
+            fut.cancel()
+        elif task.exception() is not None:
+            fut.set_exception(task.exception())
+        else:
+            fut.set_result(task.result())

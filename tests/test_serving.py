@@ -4,7 +4,9 @@ config-reload invalidation — over the real OWS server + fixture archive.
 """
 
 import asyncio
+import gc
 import json
+import threading
 import time
 
 import pytest
@@ -131,6 +133,62 @@ class TestSingleflight:
         assert calls["n"] == 1      # the failure was not retried N times
         assert sf.inflight == 0     # flight forgotten after completion
 
+    def test_leader_cancel_relays_result_to_waiters(self):
+        """A leader whose client disconnects mid-render must not fail
+        the joined waiters (their clients are still connected): the
+        render finishes in the background and they share the result."""
+        sf = SingleFlight()
+        calls = {"n": 0}
+
+        async def go():
+            started = asyncio.Event()
+            block = asyncio.Event()
+
+            async def fn():
+                calls["n"] += 1
+                started.set()
+                await block.wait()
+                return "tile"
+
+            leader = asyncio.ensure_future(sf.do("k", fn))
+            await started.wait()
+            waiter = asyncio.ensure_future(sf.do("k", fn))
+            await asyncio.sleep(0.01)       # let the waiter join
+            leader.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await leader
+            block.set()
+            return await waiter
+        res, joined = asyncio.new_event_loop().run_until_complete(go())
+        assert (res, joined) == ("tile", True)
+        assert calls["n"] == 1              # the render was NOT re-run
+        assert sf.inflight == 0
+
+    def test_leader_cancel_without_waiters_aborts_render(self):
+        sf = SingleFlight()
+        cancelled = {"render": False}
+
+        async def go():
+            started = asyncio.Event()
+
+            async def fn():
+                started.set()
+                try:
+                    await asyncio.sleep(30)
+                except asyncio.CancelledError:
+                    cancelled["render"] = True
+                    raise
+
+            leader = asyncio.ensure_future(sf.do("k", fn))
+            await started.wait()
+            leader.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await leader
+            await asyncio.sleep(0.01)       # let the abort propagate
+        asyncio.new_event_loop().run_until_complete(go())
+        assert cancelled["render"]          # nobody wanted the result
+        assert sf.inflight == 0
+
     def test_sequential_calls_are_fresh_flights(self):
         sf = SingleFlight()
 
@@ -174,6 +232,37 @@ class TestResponseCacheHTTP:
         (s3, _, b3, _), = fetch(server, [getmap()],
                                 headers={"If-None-Match": '"nope"'})
         assert s3 == 200 and len(b3) > 0
+
+    def test_age_header_reflects_cache_residency(self, tmp_path, arch,
+                                                 render_calls):
+        """Replays advertise how long the bytes have sat in the cache:
+        without Age a client could keep a tile fresh for ~2x the layer
+        TTL (its own max-age window starting after ours ended)."""
+        server, _, _ = make_env(tmp_path, arch)
+        (_, _, _, h0), = fetch(server, [getmap()])
+        assert int(h0["Age"]) == 0              # freshly rendered
+        (ent,) = list(server.gateway.cache._entries.values())
+        ent.expires -= 120                      # age the entry 2 min
+        (_, _, _, h1), = fetch(server, [getmap()])
+        assert h1["X-Gsky-Cache"] == "hit"
+        assert 120 <= int(h1["Age"]) <= ent.max_age
+        assert h1["Cache-Control"] == "max-age=300"
+
+    def test_non_200_replay_has_no_cache_validators(self, tmp_path,
+                                                    arch):
+        """Frozen non-200 responses shared through singleflight must
+        not carry ETag/Cache-Control/Age — they are not cacheable."""
+        server, _, _ = make_env(tmp_path, arch)
+
+        class _Req:
+            headers = {"If-None-Match": "*"}
+        ent = make_entry(b"<err/>", "text/xml", 404, "", "lay", "fp",
+                         300)
+        resp = server._replay(_Req(), ent, "join")
+        assert resp.status == 404               # no 304 for errors
+        for k in ("ETag", "Cache-Control", "Age"):
+            assert k not in resp.headers
+        assert resp.headers["X-Gsky-Cache"] == "join"
 
     def test_equivalent_kvp_spellings_share_entry(
             self, tmp_path, arch, render_calls):
@@ -250,6 +339,43 @@ class TestAdmission:
                 return True
         assert asyncio.new_event_loop().run_until_complete(again())
 
+    def test_cancelled_queue_wait_does_not_leak_slot(self):
+        """Cancelling a QUEUED request (client disconnect) must not
+        leak its eventual permit: the orphaned worker-thread acquire
+        hands it back, so capacity never decays under impatient load."""
+        ac = AdmissionController(limits={"WMS": 1}, queue_deadline_s=2.0)
+
+        async def go():
+            entered = asyncio.Event()
+            release = asyncio.Event()
+
+            async def hold():
+                async with ac.admit("WMS"):
+                    entered.set()
+                    await release.wait()
+
+            holder = asyncio.ensure_future(hold())
+            await entered.wait()
+
+            async def queued():
+                async with ac.admit("WMS"):
+                    pass
+
+            q = asyncio.ensure_future(queued())
+            await asyncio.sleep(0.1)        # park it in the queue
+            q.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await q
+            release.set()
+            await holder
+            # the orphan's permit came back: a fresh request admits
+            # within the queue deadline instead of being shed
+            async with ac.admit("WMS"):
+                return True
+        assert asyncio.new_event_loop().run_until_complete(go())
+        st = ac.stats()["classes"]["WMS"]
+        assert st["in_use"] == 0 and st["queued"] == 0
+
 
 class TestReloadInvalidation:
     def test_changed_layer_invalidated_unchanged_survives(
@@ -278,6 +404,43 @@ class TestReloadInvalidation:
         assert ha["X-Gsky-Cache"] == "miss"   # changed layer re-rendered
         assert hb["X-Gsky-Cache"] == "hit"    # unchanged layer survived
         assert render_calls["n"] == 3
+
+    def test_sighup_runs_listeners_off_the_signal_thread(
+            self, tmp_path, arch):
+        """The SIGHUP handler interrupts the main thread at an
+        arbitrary point — possibly while it holds a lock a listener
+        needs (the response cache's).  Listeners must therefore run on
+        a reload thread, never inline in the handler."""
+        _, watcher, _ = make_env(tmp_path, arch)
+        seen = {}
+        done = threading.Event()
+
+        def listener(configs):
+            seen["thread"] = threading.current_thread()
+            done.set()
+        watcher.add_listener(listener)
+        watcher._on_hup()
+        assert done.wait(10)
+        assert seen["thread"] is not threading.current_thread()
+
+    def test_shared_watcher_does_not_accumulate_listeners(
+            self, tmp_path, arch):
+        server, watcher, _ = make_env(tmp_path, arch)
+        mas = server.mas_factory("")
+        n0 = len(watcher._listeners)
+        # same gateway re-registered: no new listeners
+        for _ in range(5):
+            OWSServer(watcher, mas_factory=lambda a: mas,
+                      metrics=MetricsLogger(), gateway=server.gateway)
+        assert len(watcher._listeners) == n0
+        # private gateways register once each, and a reload prunes the
+        # listeners of gateways that have since been garbage-collected
+        for _ in range(3):
+            OWSServer(watcher, mas_factory=lambda a: mas,
+                      metrics=MetricsLogger(), gateway=ServingGateway())
+        gc.collect()
+        watcher.reload()
+        assert len(watcher._listeners) == n0
 
 
 class TestResponseCacheUnit:
