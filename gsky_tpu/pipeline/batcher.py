@@ -48,8 +48,8 @@ class RenderBatcher:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self._lock = threading.Lock()
-        # key -> (stack, [(ctrl, params, sp, win_raw, Future), ...])
-        self._groups: Dict[tuple, Tuple[object, List]] = {}
+        # key -> (stack, [(ctrl, params, sp, win_raw, Future), ...], Timer)
+        self._groups: Dict[tuple, Tuple[object, List, object]] = {}
         # batches dispatched with / without a union gather window
         # (engagement telemetry, mirroring WarpExecutor.win_engaged)
         self.win_batches = 0
@@ -69,17 +69,21 @@ class RenderBatcher:
         with self._lock:
             entry = self._groups.get(key)
             if entry is None:
-                self._groups[key] = (stack,
-                                     [(ctrl, params, sp, win_raw, fut)])
                 timer = threading.Timer(self.max_wait_s,
                                         self._flush_key, (key, statics))
                 timer.daemon = True
+                self._groups[key] = (stack,
+                                     [(ctrl, params, sp, win_raw, fut)],
+                                     timer)
                 timer.start()
             else:
                 entry[1].append((ctrl, params, sp, win_raw, fut))
                 if len(entry[1]) >= self.max_batch:
                     flush_now = self._groups.pop(key)
         if flush_now is not None:
+            # the pending wait timer would still fire, take the lock and
+            # pop nothing — cancel it with the batch already claimed
+            flush_now[2].cancel()
             self._execute(flush_now, statics)
         return fut.result()
 
@@ -107,7 +111,7 @@ class RenderBatcher:
             self._execute(entry, statics)
 
     def _execute(self, entry, statics: tuple):
-        stack, items = entry
+        stack, items = entry[0], entry[1]
         method, n_ns, out_hw, step, auto, colour_scale = statics
         try:
             N = len(items)

@@ -74,6 +74,18 @@ class _HandleCache:
 _handles = _HandleCache()
 _geoloc_skips = 0
 
+# cumulative windows actually READ (post intersection/geoloc filtering):
+# the export planner's decode-dedup accounting counts these, and the
+# one-decode-per-(path, band, window) acceptance test asserts on them
+_counter_lock = threading.Lock()
+window_reads = 0
+
+
+def _count_read() -> None:
+    global window_reads
+    with _counter_lock:
+        window_reads += 1
+
 
 def margin_for(resample: str) -> int:
     return {"near": 1, "nearest": 1, "bilinear": 2, "cubic": 3}.get(resample, 2)
@@ -185,6 +197,7 @@ def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
         nodata = granule.nodata if granule.nodata is not None else h.nodata
     window_gt = gt.window(win[0], win[1])
     valid = nodata_mask(data, nodata)
+    _count_read()
     return DecodedWindow(granule, data.astype(np.float32), valid,
                          window_gt, src_crs)
 

@@ -356,6 +356,20 @@ class WarpExecutor:
         except Exception:
             return 1.0
 
+    def warm_scene(self, g, dst_gt: GeoTransform, dst_crs: CRS,
+                   height: int, width: int, cache=None):
+        """Decode + upload one granule's scene into the device cache at
+        the overview level this destination grid needs, returning the
+        `DeviceScene` or None (uncacheable).  The export engine's decode
+        stage calls this ahead of the warp stage so `warp_mosaic_scenes`
+        hits a warm cache; the stride logic is exactly `_scene_groups`'
+        so both pick the same cache level."""
+        from .scene_cache import default_scene_cache
+        cache = cache or default_scene_cache
+        stride = 1.0 if g.geo_loc else self._granule_stride(
+            g, dst_gt, dst_crs, height, width)
+        return cache.get(g, stride)
+
     def warp_all(self, windows: Sequence[Optional[DecodedWindow]],
                  dst_gt: GeoTransform, dst_crs: CRS, height: int, width: int,
                  method: str = "near") -> List[Optional[Tuple[np.ndarray, np.ndarray]]]:

@@ -30,6 +30,25 @@ log = logging.getLogger("gsky.tile")
 _index_pool = None   # module-level fan-out pool (see _index_fanout)
 
 
+def ns_prio(gs: Sequence[Granule]):
+    """(ns_names, ns_ids, prio) for a granule set: namespace slots in
+    first-seen order, mosaic priorities newest-first
+    (`ops.mosaic.priority_order`).  Shared by the fused tile path and the
+    export engine so both dispatch identically for the same granules."""
+    ns_names: List[str] = []
+    ns_index: Dict[str, int] = {}
+    for g in gs:
+        if g.namespace not in ns_index:
+            ns_index[g.namespace] = len(ns_names)
+            ns_names.append(g.namespace)
+    ns_ids = [ns_index[g.namespace] for g in gs]
+    order = M.priority_order([g.timestamp for g in gs])
+    prio = [0.0] * len(gs)
+    for rank, i in enumerate(order):
+        prio[i] = float(len(gs) - rank)
+    return ns_names, ns_ids, prio
+
+
 class TilePipeline:
     def __init__(self, mas: MASClient, executor: Optional[WarpExecutor] = None,
                  decode_workers: int = 8, remote=None):
@@ -165,20 +184,6 @@ class TilePipeline:
         execution, results stay on device until encode."""
         exprs = req.band_exprs
         H, W = req.height, req.width
-
-        def ns_prio(gs):
-            ns_names: List[str] = []
-            ns_index: Dict[str, int] = {}
-            for g in gs:
-                if g.namespace not in ns_index:
-                    ns_index[g.namespace] = len(ns_names)
-                    ns_names.append(g.namespace)
-            ns_ids = [ns_index[g.namespace] for g in gs]
-            order = M.priority_order([g.timestamp for g in gs])
-            prio = [0.0] * len(gs)
-            for rank, i in enumerate(order):
-                prio[i] = float(len(gs) - rank)
-            return ns_names, ns_ids, prio
 
         # fastest path: scenes already resident in HBM — zero source upload
         ns_names, ns_ids, prio = ns_prio(granules)

@@ -143,6 +143,8 @@ class MetricsLogger:
         #          device_ms_sum, rpc_ms_sum}
         self._summary: Dict[str, Dict] = {}
         self._summary_lock = threading.Lock()
+        # cumulative staged-export (pipeline/export.py) aggregates
+        self._export: Dict = {}
 
     def collector(self) -> MetricsCollector:
         return MetricsCollector(self)
@@ -178,6 +180,31 @@ class MetricsLogger:
         except Exception:   # observability must never fail a request
             pass
 
+    # sum / max folding for export-stats keys; everything else keeps
+    # the latest value via the "last" snapshot
+    _EXPORT_SUMS = ("tiles", "granules", "index_queries", "scenes_warmed",
+                    "scenes_uncacheable", "windows_decoded",
+                    "granule_tile_refs", "dedup_saved", "decode_s",
+                    "warp_s", "encode_s", "wall_s")
+    _EXPORT_MAXES = ("warp_queue_max", "encode_queue_max")
+
+    def record_export(self, stats: Dict) -> None:
+        """Fold one staged export's stats dict (`ExportPipeline.run`)
+        into the /debug aggregates."""
+        try:
+            with self._summary_lock:
+                e = self._export
+                e["exports"] = e.get("exports", 0) + 1
+                for k in self._EXPORT_SUMS:
+                    if k in stats:
+                        e[k] = round(e.get(k, 0) + stats[k], 6)
+                for k in self._EXPORT_MAXES:
+                    if k in stats:
+                        e[k] = max(e.get(k, 0), stats[k])
+                e["last"] = dict(stats)
+        except Exception:   # observability must never fail a request
+            pass
+
     def summary(self) -> Dict:
         """The /debug document body: uptime, per-verb counts + latency
         percentiles over the rolling window, cumulative device/pipeline
@@ -198,6 +225,8 @@ class MetricsLogger:
                     "window": len(lat),
                     "device_ms_total": round(s["device_ms"], 1),
                     "pipeline_ms_total": round(s["rpc_ms"], 1)}
+            if self._export.get("exports"):
+                out["export_pipeline"] = dict(self._export)
         out["cache"] = _cache_stats()
         return out
 

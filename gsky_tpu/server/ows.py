@@ -50,6 +50,8 @@ from ..ops.raster import DTYPE_NP
 from ..ops.scale import scale_params_auto, scale_to_byte
 from ..pipeline import (DrillPipeline, GeoDrillRequest, GeoTileRequest,
                         TilePipeline)
+from ..pipeline.export import ExportPipeline
+from ..pipeline.export import pipeline_enabled as export_pipeline_enabled
 from ..pipeline.extent import compute_reprojection_extent
 from ..pipeline.feature_info import get_feature_info
 from ..pipeline.types import AxisSelector, MaskSpec
@@ -893,14 +895,42 @@ class OWSServer:
                               node)
                 await asyncio.gather(*(render_tile(*t) for t in tiles_in))
 
+        # multi-tile exports go through the staged export engine: ONE
+        # index query over the full bbox, cross-tile decode dedup, and
+        # decode/warp/encode overlap (docs/EXPORT.md).  Fusion layers
+        # keep the per-tile path (each tile composes its input layers);
+        # GSKY_EXPORT_PIPELINE=0 is the serial escape hatch.
+        engine = None
+        if (len(local_tiles) > 1 and not lay.input_layers
+                and export_pipeline_enabled()):
+            engine = ExportPipeline(
+                pipe,
+                dataclasses.replace(
+                    base_req, polygon_segments=lay.wcs_polygon_segments),
+                local_tiles, ns_names, p.bbox, width, height,
+                nodata=nodata, writer=writer, out=out, valid=valid)
+
+        async def render_local():
+            if engine is None:
+                await asyncio.gather(*(render_tile(*t)
+                                       for t in local_tiles))
+                return
+            stats = await asyncio.to_thread(engine.run)
+            try:
+                self.metrics.record_export(stats)
+            except Exception:
+                pass
+
         try:
             await asyncio.wait_for(
-                asyncio.gather(*(render_tile(*t) for t in local_tiles),
+                asyncio.gather(render_local(),
                                *(fetch_shard(*j) for j in remote_jobs)),
                 timeout=lay.wcs_timeout * max(1, len(tiles)))
         except BaseException:
             # close + unlink the partial stream file on timeout/failure
             # (ADVICE r1: fd and temp-file leak)
+            if engine is not None:
+                engine.cancel()
             if writer is not None:
                 try:
                     await asyncio.to_thread(writer.close)
@@ -920,9 +950,12 @@ class OWSServer:
             return web.FileResponse(writer.path, headers={
                 "Content-Disposition": f'attachment; filename="{fname}"',
                 "Content-Type": "image/geotiff"})
+        # finalise in place: the render is done with out[n], so masking
+        # nodata needs no second full-coverage copy (a 4-band 4K export
+        # peaked at 2x the float32 canvases)
         arrays = {}
         for n in ns_names:
-            a = out[n].copy()
+            a = out[n]
             a[~valid[n]] = nodata
             arrays[n] = a
         if fmt == "dap4":

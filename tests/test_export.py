@@ -1,0 +1,219 @@
+"""Staged WCS export engine tests (`pipeline/export.py`): plan-once
+indexing, cross-tile decode dedup, pipelined-vs-serial output identity,
+cancellation cleanup, and /debug stage observability."""
+
+import asyncio
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gsky_tpu.index import MASClient
+from gsky_tpu.server.config import ConfigWatcher
+from gsky_tpu.server.metrics import MetricsLogger
+from gsky_tpu.server.ows import OWSServer
+
+from fixtures import make_archive
+
+DATE = "2020-01-10T00:00:00.000Z"
+BBOX3857 = "16478548,-4211230,16489679,-4198025"
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("export")
+    arch = make_archive(str(root / "data"))
+    conf_dir = root / "conf"
+    conf_dir.mkdir()
+    config = {
+        "service_config": {"ows_hostname": "", "mas_address": "inproc"},
+        "layers": [
+            {
+                # small tiles: a 256x192 export fans out to 12 tiles,
+                # forcing the multi-tile engine path while staying in-RAM
+                "name": "frac_small", "title": "Fractional cover",
+                "data_source": arch["root"],
+                "rgb_products": ["phot_veg", "bare_soil",
+                                 "total = phot_veg + bare_soil"],
+                "time_generator": "mas",
+                "wcs_max_tile_width": 64, "wcs_max_tile_height": 64,
+            },
+            {
+                # 256-aligned tiles: eligible for streaming GeoTIFF once
+                # WCS_STREAM_PIXELS is monkeypatched down
+                "name": "frac_stream", "title": "Fractional cover",
+                "data_source": arch["root"],
+                "rgb_products": ["phot_veg", "bare_soil"],
+                "time_generator": "mas",
+                "wcs_max_tile_width": 256, "wcs_max_tile_height": 256,
+            },
+            {
+                # 1-second budget: with N tiles the request times out at
+                # N seconds — the cancellation-cleanup fixture
+                "name": "frac_slow", "title": "Fractional cover",
+                "data_source": arch["root"],
+                "rgb_products": ["phot_veg"],
+                "time_generator": "mas",
+                "wcs_max_tile_width": 256, "wcs_max_tile_height": 256,
+                "wcs_timeout": 1,
+            },
+        ],
+    }
+    (conf_dir / "config.json").write_text(json.dumps(config))
+    mas_client = MASClient(arch["store"])
+    watcher = ConfigWatcher(str(conf_dir),
+                            mas_factory=lambda addr: mas_client,
+                            install_signal=False)
+    server = OWSServer(watcher, mas_factory=lambda addr: mas_client,
+                       metrics=MetricsLogger())
+    return {"server": server, "arch": arch, "mas": mas_client}
+
+
+def _get(env, path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        client = TestClient(TestServer(env["server"].app()))
+        await client.start_server()
+        try:
+            resp = await client.get(path)
+            return resp.status, resp.content_type, await resp.read()
+        finally:
+            await client.close()
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+def _wcs_url(layer, width, height, bbox=BBOX3857):
+    return (f"/ows?service=WCS&request=GetCoverage&coverage={layer}"
+            f"&crs=EPSG:3857&bbox={bbox}&width={width}&height={height}"
+            f"&format=GeoTIFF&time={DATE}")
+
+
+class TestPlanOnce:
+    def test_one_index_query_and_decode_dedup(self, env, monkeypatch):
+        """A 12-tile export runs ONE MAS intersects query and decodes
+        each deduplicated source at most once (scene loads ≤ unique
+        scenes, zero window-level re-reads)."""
+        import gsky_tpu.pipeline.decode as decode_mod
+        from gsky_tpu.pipeline.scene_cache import default_scene_cache
+
+        calls = []
+        real = MASClient.intersects
+
+        def counting(self, *a, **kw):
+            calls.append(kw.get("namespaces", ""))
+            return real(self, *a, **kw)
+        monkeypatch.setattr(MASClient, "intersects", counting)
+
+        reads0 = decode_mod.window_reads
+        misses0 = default_scene_cache.misses
+
+        status, _, body = _get(env, _wcs_url("frac_small", 256, 192))
+        assert status == 200, body[:300]
+        assert len(calls) == 1, calls
+
+        # frac_small has two source namespaces; one fixture scene each
+        # -> at most 2 cold scene loads, and never a window re-decode
+        assert default_scene_cache.misses - misses0 <= 2
+        assert decode_mod.window_reads - reads0 == 0
+
+        # same export again: every source is already device-resident
+        misses1 = default_scene_cache.misses
+        status, _, _ = _get(env, _wcs_url("frac_small", 256, 192))
+        assert status == 200
+        assert default_scene_cache.misses == misses1
+
+    def test_debug_reports_stage_timings(self, env):
+        status, _, _ = _get(env, _wcs_url("frac_small", 256, 192))
+        assert status == 200
+        status, ctype, body = _get(env, "/debug")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        ep = doc.get("export_pipeline")
+        assert ep, doc.keys()
+        assert ep["exports"] >= 1
+        assert ep["index_queries"] >= 1
+        assert ep["tiles"] >= 12
+        assert ep["decode_s"] > 0
+        assert ep["warp_s"] > 0
+        assert ep["encode_s"] > 0
+        assert ep["wall_s"] > 0
+        assert ep["warp_queue_max"] >= 1
+        assert ep["encode_queue_max"] >= 1
+        assert "last" in ep and ep["last"]["tiles"] == 12
+
+
+class TestOutputIdentity:
+    def test_in_ram_bytes_match_serial(self, env, monkeypatch):
+        """The pipelined engine and the per-tile serial path produce
+        byte-identical in-RAM GeoTIFF responses."""
+        url = _wcs_url("frac_small", 256, 192)
+        monkeypatch.setenv("GSKY_EXPORT_PIPELINE", "0")
+        status, _, serial = _get(env, url)
+        assert status == 200
+        monkeypatch.setenv("GSKY_EXPORT_PIPELINE", "1")
+        status, _, piped = _get(env, url)
+        assert status == 200
+        assert serial == piped
+
+    def test_streaming_matches_in_ram(self, env, monkeypatch, tmp_path):
+        """Streaming (GeoTIFFWriter) output through the engine decodes
+        to the same pixels as the serial in-RAM ground truth.  (Byte
+        order inside a streamed file tracks tile write order, which is
+        scheduler-dependent on BOTH paths, so identity is asserted on
+        decoded arrays — same contract as TestWCSStreaming.)"""
+        import gsky_tpu.server.ows as ows_mod
+        url = _wcs_url("frac_stream", 512, 512)
+        monkeypatch.setenv("GSKY_EXPORT_PIPELINE", "0")
+        status, _, plain = _get(env, url)
+        assert status == 200
+        monkeypatch.setenv("GSKY_EXPORT_PIPELINE", "1")
+        monkeypatch.setattr(ows_mod, "WCS_STREAM_PIXELS", 1000)
+        status, _, streamed = _get(env, url)
+        assert status == 200
+        pp, ps = tmp_path / "plain.tif", tmp_path / "stream.tif"
+        pp.write_bytes(plain)
+        ps.write_bytes(streamed)
+        from gsky_tpu.io.geotiff import GeoTIFF
+        with GeoTIFF(str(pp)) as a, GeoTIFF(str(ps)) as b:
+            assert (a.width, a.height, a.count) == \
+                (b.width, b.height, b.count)
+            assert b.nodata == -9999.0
+            for bi in range(1, a.count + 1):
+                np.testing.assert_array_equal(a.read(bi), b.read(bi))
+
+
+class TestCancellation:
+    def test_timeout_removes_partial_stream_file(self, env, monkeypatch):
+        """A wcs_timeout hit mid-export cancels the engine and unlinks
+        the partial stream file, exactly like the serial path."""
+        import gsky_tpu.server.ows as ows_mod
+        from gsky_tpu.pipeline.export import ExportPipeline
+
+        def slow_render(self, req, gs):
+            time.sleep(6)
+            raise RuntimeError("should have been cancelled")
+        monkeypatch.setattr(ExportPipeline, "_render_tile", slow_render)
+        monkeypatch.setattr(ows_mod, "WCS_STREAM_PIXELS", 1000)
+
+        temp_dir = env["server"].temp_dir
+        before = set(glob.glob(os.path.join(temp_dir, "wcs_*.tif")))
+        # 512x256 on 256px tiles -> 2 tiles -> timeout = 2 * 1 s
+        status, _, body = _get(env, _wcs_url("frac_slow", 512, 256))
+        assert status >= 400
+        after = set(glob.glob(os.path.join(temp_dir, "wcs_*.tif")))
+        assert after == before
+
+
+class TestEscapeHatch:
+    def test_env_toggle(self, monkeypatch):
+        from gsky_tpu.pipeline.export import pipeline_enabled
+        monkeypatch.delenv("GSKY_EXPORT_PIPELINE", raising=False)
+        assert pipeline_enabled()
+        monkeypatch.setenv("GSKY_EXPORT_PIPELINE", "0")
+        assert not pipeline_enabled()
+        monkeypatch.setenv("GSKY_EXPORT_PIPELINE", "1")
+        assert pipeline_enabled()

@@ -24,6 +24,14 @@ client-side p50/p99 per phase plus the gateway's response-cache hit
 rate, singleflight joins and admission sheds from /debug.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario hot --seconds 60
+
+``--scenario wcs``: repeated large GetCoverage exports against a
+running server — the staged export engine (pipeline/export.py) under
+sustained load.  Asserts every export succeeds, RSS stays bounded, and
+/debug's ``export_pipeline`` block reports the expected export count
+with non-zero per-stage timings.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario wcs --seconds 60
 """
 
 from __future__ import annotations
@@ -54,7 +62,7 @@ def main(argv=None):
     ap.add_argument("--seconds", type=float, default=120.0)
     ap.add_argument("--conc", type=int, default=8)
     ap.add_argument("--max-rss-growth-mb", type=float, default=256.0)
-    ap.add_argument("--scenario", choices=("churn", "hot"),
+    ap.add_argument("--scenario", choices=("churn", "hot", "wcs"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -90,7 +98,10 @@ def main(argv=None):
                 "data_source": root,
                 "rgb_products": [f"LC08_20200{110 + k}_T1"
                                  for k in range(B.N_SCENES)],
-                "time_generator": "mas"}],
+                "time_generator": "mas",
+                "wcs_max_width": 4096, "wcs_max_height": 4096,
+                "wcs_max_tile_width": 256,
+                "wcs_max_tile_height": 256}],
         }, fp)
     watcher = ConfigWatcher(conf_dir, mas_factory=lambda a: mas_client,
                             install_signal=False)
@@ -128,6 +139,8 @@ def main(argv=None):
 
     if args.scenario == "hot":
         return run_hot(args, watcher, mas_client, merc, boot)
+    if args.scenario == "wcs":
+        return run_wcs(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -284,6 +297,83 @@ def run_hot(args, watcher, mas_client, merc, boot) -> int:
     print(json.dumps(out))
     ok = (base["failed"] == 0 and gate["failed"] == 0
           and gate["hit_rate"] > 0.3)
+    print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+    return 0 if ok else 1
+
+
+def run_wcs(args, watcher, mas_client, merc, boot) -> int:
+    """Repeated large GetCoverage exports through the staged engine."""
+    import numpy as np
+
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                       metrics=MetricsLogger(), gateway=None)
+    host = boot(server)
+    rng = np.random.default_rng(3)
+
+    def one(_):
+        # each export covers a random half-extent window: big enough to
+        # fan out to a multi-tile plan (1024px / 256px tiles = 16 tiles)
+        fx = float(rng.uniform(0.0, 0.5))
+        fy = float(rng.uniform(0.0, 0.5))
+        w = merc.width * 0.5
+        bb = (f"{merc.xmin + fx * merc.width},"
+              f"{merc.ymin + fy * merc.height},"
+              f"{merc.xmin + fx * merc.width + w},"
+              f"{merc.ymin + fy * merc.height + w}")
+        url = (f"http://{host}/ows?service=WCS&request=GetCoverage"
+               f"&coverage=landsat&crs=EPSG:3857&bbox={bb}"
+               f"&width=1024&height=1024&format=GeoTIFF"
+               f"&time=2020-01-10T00:00:00.000Z")
+        try:
+            with urllib.request.urlopen(url, timeout=300) as r:
+                body = r.read()
+                # classic (II*\x00) little-endian TIFF magic
+                return (r.status == 200 and len(body) > 8
+                        and body[:4] == b"II*\x00")
+        except Exception:
+            return False
+
+    t_end = time.time() + args.seconds
+    n_ok = n_bad = 0
+    lats = []
+    phase_rss = None
+    with cf.ThreadPoolExecutor(args.conc) as ex:
+        while time.time() < t_end:
+            t0 = time.time()
+            results = list(ex.map(one, range(args.conc)))
+            lats.append((time.time() - t0) / max(len(results), 1))
+            n_ok += sum(results)
+            n_bad += len(results) - sum(results)
+            if phase_rss is None and \
+                    time.time() > t_end - args.seconds * 0.75:
+                phase_rss = rss_mb()
+
+    with urllib.request.urlopen(f"http://{host}/debug",
+                                timeout=30) as r:
+        dbg = json.loads(r.read())
+    ep = dbg.get("export_pipeline", {})
+    growth = rss_mb() - (phase_rss or rss_mb())
+    out = {
+        "scenario": "wcs",
+        "exports_ok": n_ok, "exports_failed": n_bad,
+        "mean_export_s": round(float(sum(lats) / max(len(lats), 1)), 2),
+        "steady_state_rss_growth_mb": round(growth, 1),
+        "export_pipeline": {k: ep.get(k) for k in
+                            ("exports", "tiles", "index_queries",
+                             "scenes_warmed", "dedup_saved", "decode_s",
+                             "warp_s", "encode_s", "wall_s")},
+    }
+    print(json.dumps(out))
+    ok = (n_ok > 0 and n_bad == 0
+          and growth <= args.max_rss_growth_mb
+          and ep.get("exports", 0) >= n_ok
+          and ep.get("index_queries", 0) >= n_ok
+          and ep.get("decode_s", 0) > 0
+          and ep.get("warp_s", 0) > 0
+          and ep.get("encode_s", 0) > 0)
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
 
