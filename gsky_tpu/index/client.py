@@ -15,7 +15,11 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from ..resilience import (RetryPolicy, call_with_retry, clamp_timeout,
+                          faults, get_breaker)
 from .store import MASStore, parse_time
+
+DEFAULT_MAS_TIMEOUT = 60.0
 
 
 @dataclass
@@ -87,7 +91,7 @@ class Dataset:
 class MASClient:
     """address: 'host:port' for HTTP, or a MASStore for in-process."""
 
-    def __init__(self, address):
+    def __init__(self, address, timeout: float = DEFAULT_MAS_TIMEOUT):
         # duck-typed: MASStore or MASShardedStore (anything exposing
         # the intersects/timestamps/extents surface) binds in-process
         if hasattr(address, "intersects"):
@@ -96,10 +100,22 @@ class MASClient:
         else:
             self._store = None
             self.address = address
+        self.timeout = float(timeout or DEFAULT_MAS_TIMEOUT)
+        self._breaker = get_breaker(f"mas:{self.address}")
+        self._retry = RetryPolicy(max_attempts=3, base_delay=0.1,
+                                  max_delay=2.0)
 
     # -- sync API (pipelines run in worker threads) -------------------------
 
     def _get(self, gpath: str, params: Dict[str, str], op: str) -> Dict:
+        return call_with_retry(
+            lambda: self._get_once(gpath, params, op),
+            self._retry, site="mas", breaker=self._breaker)
+
+    def _get_once(self, gpath: str, params: Dict[str, str], op: str) -> Dict:
+        # injection sits in front of BOTH transports, so in-process test
+        # stores exercise the same recovery paths as a remote masapi
+        faults.inject("mas")
         if self._store is not None:
             ns = params.get("namespace", "")
             common = dict(
@@ -124,16 +140,22 @@ class MASClient:
         qs = urllib.parse.urlencode({op: "", **params})
         url = f"http://{self.address}{urllib.parse.quote(gpath)}?{qs}"
         try:
-            with urllib.request.urlopen(url, timeout=60) as resp:
+            with urllib.request.urlopen(
+                    url, timeout=clamp_timeout(self.timeout)) as resp:
                 return json.loads(resp.read())
         except urllib.error.HTTPError as e:
-            # surface masapi's JSON error body instead of a bare 400/500
+            # surface masapi's JSON error body instead of a bare 400/500.
+            # 5xx means the server choked (retryable); 4xx means it
+            # answered and retrying the same request cannot help.
             try:
                 body = json.loads(e.read())
             except Exception:
-                raise RuntimeError(f"MAS HTTP {e.code}") from e
-            raise RuntimeError(
-                f"MAS error: {body.get('error', e.code)}") from e
+                err = RuntimeError(f"MAS HTTP {e.code}")
+            else:
+                err = RuntimeError(
+                    f"MAS error: {body.get('error', e.code)}")
+            err.retryable = e.code >= 500
+            raise err from e
 
     def intersects(self, gpath: str, *, srs: str = "", wkt: str = "",
                    time: str = "", until: str = "", namespaces: str = "",

@@ -135,6 +135,8 @@ def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
                 "path (renders only via the scene path; skip #%d)",
                 granule.path, _geoloc_skips)
         return None
+    from ..resilience import faults
+    faults.inject("decode")
     src_crs = parse_crs(granule.srs) if granule.srs else dst_crs
     gt = GeoTransform.from_gdal(granule.geo_transform)
     try:
@@ -220,21 +222,31 @@ def _pixel_window(gt: GeoTransform, bbox: BBox, W: int, H: int,
 
 def decode_all(granules: List[Granule], dst_bbox: BBox, dst_crs: CRS,
                resample: str = "near", workers: int = 8,
-               dst_hw: Optional[Tuple[int, int]] = None
+               dst_hw: Optional[Tuple[int, int]] = None,
+               errors: Optional[List[Exception]] = None
                ) -> List[Optional[DecodedWindow]]:
-    """Decode all granule windows concurrently, preserving order."""
+    """Decode all granule windows concurrently, preserving order.
+
+    A ``None`` slot means EITHER the granule doesn't intersect the tile
+    (normal) OR its decode raised; pass ``errors`` to collect the raised
+    exceptions so callers can apply the partial-failure policy
+    (``resilience.check_partial``) without conflating the two.
+    """
     if not granules:
         return []
     with cf.ThreadPoolExecutor(min(workers, len(granules))) as ex:
         return list(ex.map(
-            lambda g: _safe_decode(g, dst_bbox, dst_crs, resample, dst_hw),
+            lambda g: _safe_decode(g, dst_bbox, dst_crs, resample, dst_hw,
+                                   errors),
             granules))
 
 
-def _safe_decode(g, dst_bbox, dst_crs, resample, dst_hw=None):
+def _safe_decode(g, dst_bbox, dst_crs, resample, dst_hw=None, errors=None):
     try:
         return decode_window(g, dst_bbox, dst_crs, resample, dst_hw)
-    except Exception:
+    except Exception as e:
         # failures degrade to an empty granule, not a failed request
         # (EmptyTile sentinel behaviour, `tile_indexer.go:106,211,307`)
+        if errors is not None:
+            errors.append(e)
         return None

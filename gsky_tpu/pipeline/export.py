@@ -61,6 +61,7 @@ import numpy as np
 
 from ..geo.crs import parse_crs
 from ..geo.transform import BBox, transform_bbox
+from ..resilience import check_partial
 from .decode import decode_window
 from .executor import _prefetch
 from .tile import _empty_result, evaluate_expressions, ns_prio
@@ -141,6 +142,9 @@ class ExportPipeline:
         # for sources the scene cache can't hold
         self._memo: Dict[tuple, object] = {}
         self._memo_lock = threading.Lock()
+        # scene keys whose memo decode RAISED (vs. merely not
+        # intersecting): feeds the partial-failure degradation policy
+        self._memo_failed: set = set()
         self.stats: Dict[str, object] = {}
 
     # -- control -------------------------------------------------------------
@@ -232,14 +236,18 @@ class ExportPipeline:
         with self._memo_lock:
             if key in self._memo:
                 return self._memo[key]
+        failed = False
         try:
             w = decode_window(g, self.bbox, self.base_req.crs,
                               self.base_req.resample,
                               dst_hw=(self.height, self.width))
         except Exception:
             w = None
+            failed = True
         with self._memo_lock:
             self._memo.setdefault(key, w)
+            if failed:
+                self._memo_failed.add(key)
             return self._memo[key]
 
     def _decode_stage(self, plan: List[List[Granule]],
@@ -314,6 +322,12 @@ class ExportPipeline:
         if sc is None:
             ws = [self._memo_window(g) if not g.geo_loc else None
                   for g in gs]
+            # this runs on the warp stage (the request's to_thread
+            # context), so degradation marks reach the OWS handler
+            with self._memo_lock:
+                failed = sum(1 for g in gs
+                             if _scene_key(g) in self._memo_failed)
+            check_partial(failed, len(gs), "decode")
             live = [(g, w) for g, w in zip(gs, ws) if w is not None]
             if not live:
                 return _empty_result(exprs, H, W)

@@ -150,10 +150,21 @@ class SceneCache:
                 self._inflight.pop(key).set()
         return scene
 
+    def clear(self) -> None:
+        """Drop every resident scene (chaos/ops hook — forces the next
+        request through the full decode path again).  In-flight loads
+        are untouched: they re-insert under the lock when they finish."""
+        with self._lock:
+            self._scenes.clear()
+            self._order.clear()
+            self._bytes = 0
+
     def _load(self, g: Granule, level: int = 1) -> Optional[DeviceScene]:
         from .decode import _handles
         gt = GeoTransform.from_gdal(g.geo_transform)
         try:
+            from ..resilience import faults
+            faults.inject("decode")
             h = _handles.get(g.path, g.is_netcdf)
             if g.is_netcdf:
                 v = h.variables.get(g.var_name)

@@ -20,6 +20,7 @@ from ..index.client import MASClient
 from ..index.store import fmt_time
 from ..ops import mosaic as M
 from ..ops.expr import BandExpressions
+from ..resilience import check_partial
 from .decode import decode_all
 from .executor import WarpExecutor, _prefetch, default_executor
 from .granule import expand_granules
@@ -191,8 +192,10 @@ class TilePipeline:
             granules, ns_ids, prio, req.dst_gt(), req.crs, H, W,
             len(ns_names), req.resample)
         if sc is None:
+            errs: List[Exception] = []
             ws = decode_all(granules, req.bbox, req.crs, req.resample,
-                            self.decode_workers, dst_hw=(H, W))
+                            self.decode_workers, dst_hw=(H, W), errors=errs)
+            check_partial(len(errs), len(granules), "decode")
             live = [(g, w) for g, w in zip(granules, ws) if w is not None]
             if not live:
                 return _empty_result(exprs, H, W)
@@ -401,9 +404,11 @@ class TilePipeline:
             reg = [i for i in idxs if not granules[i].geo_loc]
             gl = [i for i in idxs if granules[i].geo_loc]
             if reg:
+                errs: List[Exception] = []
                 ws = decode_all([granules[i] for i in reg], req.bbox,
                                 req.crs, method, self.decode_workers,
-                                dst_hw=(H, W))
+                                dst_hw=(H, W), errors=errs)
+                check_partial(len(errs), len(reg), "decode")
                 wr = self.executor.warp_all(ws, req.dst_gt(), req.crs,
                                             H, W, method)
                 for k, i in enumerate(reg):

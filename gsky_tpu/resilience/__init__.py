@@ -1,0 +1,31 @@
+"""Cross-cutting resilience layer: retries, circuit breakers, deadline
+budgets, graceful degradation and deterministic fault injection.
+
+See docs/RESILIENCE.md for the operator-facing story.
+"""
+from . import faults
+from .breaker import (BackendUnavailable, BreakerOpen, CircuitBreaker,
+                      get_breaker, reset_breakers)
+from .deadline import (Deadline, DeadlineExceeded, clamp_timeout,
+                       current_deadline, deadline_scope)
+from .degrade import (RequestState, TooManyFailures, check_partial,
+                      degraded_reasons, mark_degraded, request_scope)
+from .faults import InjectedFault
+from .registry import registry
+from .retry import RetryPolicy, call_with_retry, is_retryable
+
+__all__ = [
+    "BackendUnavailable", "BreakerOpen", "CircuitBreaker", "Deadline",
+    "DeadlineExceeded", "InjectedFault", "RequestState", "RetryPolicy",
+    "TooManyFailures", "call_with_retry", "check_partial", "clamp_timeout",
+    "current_deadline", "deadline_scope", "degraded_reasons", "faults",
+    "get_breaker", "is_retryable", "mark_degraded", "registry",
+    "request_scope", "reset", "reset_breakers",
+]
+
+
+def reset() -> None:
+    """Test hook: clear counters, shared breakers and fault plans."""
+    registry.reset()
+    reset_breakers()
+    faults.reset()

@@ -1,0 +1,168 @@
+"""Per-backend circuit breakers (closed / open / half-open).
+
+A breaker guards one backend endpoint (one MAS address, one worker
+node).  While *closed* every call is allowed; after
+``failure_threshold`` consecutive failures it *opens* and rejects calls
+immediately — sparing the caller the connect timeout and the backend
+the retry storm.  After ``reset_timeout`` seconds it moves to
+*half-open* and admits exactly one probe call at a time: a successful
+probe closes the breaker, a failed one re-opens it for another cooldown.
+
+Breakers are looked up by name through :func:`get_breaker` so every
+client instance guarding the same endpoint (e.g. rebuilt worker clients
+after a SIGHUP config reload) shares one breaker and one view of the
+backend's health.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+from .registry import registry
+
+
+class BackendUnavailable(RuntimeError):
+    """A backend stayed unreachable after retries / failover.
+
+    The OWS layer maps this to a clean 503 OGC ServiceException with a
+    ``Retry-After`` hint rather than a bare 500.
+    """
+
+    def __init__(self, message: str, site: str = "", retry_after: float = 5.0):
+        super().__init__(message)
+        self.site = site
+        self.retry_after = retry_after
+
+
+class BreakerOpen(BackendUnavailable):
+    """Rejected without calling the backend: its breaker is open."""
+
+
+class CircuitBreaker:
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, name: str, failure_threshold: int = 5,
+                 reset_timeout: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 register: bool = True):
+        self.name = name
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout = float(reset_timeout)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self.opens = 0
+        self.successes = 0
+        self.failures = 0
+        self.probes = 0
+        self.rejections = 0
+        if register:
+            registry.register_breaker(self)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._state == self.OPEN and \
+                    self._clock() - self._opened_at >= self.reset_timeout:
+                return self.HALF_OPEN
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed right now?
+
+        In half-open state only one in-flight probe is admitted; its
+        outcome (``record_success`` / ``record_failure``) decides the
+        next state.
+        """
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at < self.reset_timeout:
+                    self.rejections += 1
+                    return False
+                self._state = self.HALF_OPEN
+                self._probing = False
+            if self._probing:
+                self.rejections += 1
+                return False
+            self._probing = True
+            self.probes += 1
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.successes += 1
+            self._consecutive = 0
+            self._probing = False
+            self._state = self.CLOSED
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            self._consecutive += 1
+            if self._state == self.HALF_OPEN:
+                self._trip()
+            elif self._state == self.CLOSED and \
+                    self._consecutive >= self.failure_threshold:
+                self._trip()
+
+    def _trip(self) -> None:
+        # caller holds self._lock
+        self._state = self.OPEN
+        self._opened_at = self._clock()
+        self._probing = False
+        self.opens += 1
+
+    def retry_after(self) -> float:
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(0.0, self.reset_timeout -
+                       (self._clock() - self._opened_at))
+
+    def open_error(self) -> BreakerOpen:
+        return BreakerOpen(
+            f"circuit breaker {self.name!r} is open",
+            site=self.name, retry_after=max(1.0, self.retry_after()))
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            state = self._state
+            if state == self.OPEN and \
+                    self._clock() - self._opened_at >= self.reset_timeout:
+                state = self.HALF_OPEN
+            return {
+                "state": state,
+                "consecutive_failures": self._consecutive,
+                "opens": self.opens,
+                "successes": self.successes,
+                "failures": self.failures,
+                "probes": self.probes,
+                "rejections": self.rejections,
+            }
+
+
+_BREAKERS: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def get_breaker(name: str, **kwargs) -> CircuitBreaker:
+    """Shared breaker for ``name``, created on first use."""
+    with _breakers_lock:
+        br = _BREAKERS.get(name)
+        if br is None:
+            br = _BREAKERS[name] = CircuitBreaker(name, **kwargs)
+        return br
+
+
+def reset_breakers() -> None:
+    """Drop all shared breakers (test hook)."""
+    with _breakers_lock:
+        _BREAKERS.clear()

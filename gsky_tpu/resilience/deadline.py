@@ -1,0 +1,87 @@
+"""Per-request deadline budgets.
+
+A request gets ONE time budget (e.g. the layer's ``wms_timeout``) when
+it enters the OWS handler; every downstream stage draws its own timeout
+from what is *left* of that budget instead of using a fresh full-size
+timeout.  A slow MAS query can no longer pin a WMS request past its own
+deadline: the index HTTP timeout, worker gRPC timeouts and shard-peer
+fetch timeouts are all clamped through :func:`clamp_timeout`.
+
+The active deadline travels in a ``contextvars.ContextVar`` so it
+crosses ``await`` boundaries and ``asyncio.to_thread`` hops (the thread
+runs under a *copy* of the context, but the :class:`Deadline` object —
+whose clock keeps running — is shared).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from typing import Callable, Optional
+
+from .registry import registry
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline budget is exhausted.
+
+    Subclasses ``TimeoutError`` so existing ``except asyncio.TimeoutError``
+    handlers (TimeoutError on py>=3.11) already treat it as a timeout.
+    """
+
+
+class Deadline:
+    __slots__ = ("budget", "_t0", "_clock")
+
+    def __init__(self, budget_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.budget = float(budget_s)
+        self._clock = clock
+        self._t0 = clock()
+
+    def remaining(self) -> float:
+        return self.budget - (self._clock() - self._t0)
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def clamp(self, timeout: Optional[float] = None) -> float:
+        """The smaller of ``timeout`` and the remaining budget.
+
+        Raises :class:`DeadlineExceeded` when nothing is left — callers
+        should not even start the downstream call.
+        """
+        rem = self.remaining()
+        if rem <= 0.0:
+            registry.count_deadline()
+            raise DeadlineExceeded(
+                f"deadline budget of {self.budget:.1f}s exhausted")
+        return rem if timeout is None else min(float(timeout), rem)
+
+
+_current: contextvars.ContextVar[Optional[Deadline]] = \
+    contextvars.ContextVar("gsky_deadline", default=None)
+
+
+def current_deadline() -> Optional[Deadline]:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline):
+    """Make ``deadline`` (a Deadline or a budget in seconds) current."""
+    if not isinstance(deadline, Deadline):
+        deadline = Deadline(deadline)
+    token = _current.set(deadline)
+    try:
+        yield deadline
+    finally:
+        _current.reset(token)
+
+
+def clamp_timeout(timeout: Optional[float]) -> Optional[float]:
+    """Clamp ``timeout`` against the current deadline, if any is set."""
+    dl = _current.get()
+    if dl is None:
+        return timeout
+    return dl.clamp(timeout)

@@ -1,0 +1,91 @@
+"""Graceful degradation: partial results instead of hard failures.
+
+A request that loses *some* of its granules (decode failure, dead
+shard peer, stale-cache replay) can still produce a useful mosaic.
+The OWS handler opens a :func:`request_scope`; any stage that absorbs
+a partial failure calls :func:`mark_degraded` with a short reason, and
+the handler stamps the union of reasons into an ``X-GSKY-Degraded``
+response header so clients (and the soak harness) can tell a partial
+2xx from a clean one.
+
+:func:`check_partial` is the policy knob: a stage that failed on
+``failed`` of ``total`` inputs either records the degradation (below
+the configured max-failure fraction) or raises :class:`TooManyFailures`
+(above it) — a mosaic missing most of its pixels is worse than an
+honest error.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+from typing import List, Optional, Tuple
+
+DEFAULT_MAX_FAILURE_FRACTION = 0.5
+
+
+class TooManyFailures(RuntimeError):
+    """Partial-failure fraction exceeded the degradation budget."""
+
+    def __init__(self, message: str, site: str = ""):
+        super().__init__(message)
+        self.site = site
+
+
+def max_failure_fraction() -> float:
+    raw = os.environ.get("GSKY_DEGRADE_MAX_FRACTION", "")
+    try:
+        v = float(raw) if raw else DEFAULT_MAX_FAILURE_FRACTION
+    except ValueError:
+        v = DEFAULT_MAX_FAILURE_FRACTION
+    return min(max(v, 0.0), 1.0)
+
+
+class RequestState:
+    __slots__ = ("reasons",)
+
+    def __init__(self) -> None:
+        self.reasons: List[str] = []
+
+
+_current: contextvars.ContextVar[Optional[RequestState]] = \
+    contextvars.ContextVar("gsky_request_state", default=None)
+
+
+@contextlib.contextmanager
+def request_scope():
+    state = RequestState()
+    token = _current.set(state)
+    try:
+        yield state
+    finally:
+        _current.reset(token)
+
+
+def mark_degraded(reason: str) -> None:
+    """Record a degradation reason on the current request (no-op when
+    no request scope is active, e.g. in bare pipeline tests)."""
+    state = _current.get()
+    if state is not None and reason not in state.reasons:
+        state.reasons.append(reason)
+
+
+def degraded_reasons() -> Tuple[str, ...]:
+    state = _current.get()
+    return tuple(state.reasons) if state is not None else ()
+
+
+def check_partial(failed: int, total: int, site: str) -> None:
+    """Apply the partial-failure policy for one stage.
+
+    No failures: no-op.  Failures at or below the max fraction: mark the
+    request degraded and continue with what decoded.  Above it (or total
+    loss): raise :class:`TooManyFailures`.
+    """
+    if failed <= 0 or total <= 0:
+        return
+    if failed >= total or failed / total > max_failure_fraction():
+        raise TooManyFailures(
+            f"{failed}/{total} {site} failures exceed the degradation "
+            f"budget ({max_failure_fraction():.0%})", site=site)
+    mark_degraded(site)

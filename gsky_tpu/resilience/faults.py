@@ -1,0 +1,170 @@
+"""Deterministic fault injection.
+
+Faults are described by a compact spec, normally supplied via the
+``GSKY_FAULTS`` environment variable::
+
+    GSKY_FAULTS="mas:error:0.2,worker:latency:500ms,decode:error:0.05"
+
+Each comma-separated clause is ``site:kind:arg``:
+
+``site``
+    the name a call site passes to :func:`inject` — the wired sites are
+    ``mas`` (index client transport), ``worker`` (gRPC stub call),
+    ``decode`` (granule window decode + scene-cache load) and ``pool``
+    (decode subprocess dispatch).
+``error:RATE``
+    raise :class:`InjectedFault` with probability ``RATE`` (0..1).
+``latency:DURATION[:RATE]``
+    sleep ``DURATION`` (``500ms``, ``2s``, or bare seconds) with
+    probability ``RATE`` (default 1.0) before the real call proceeds.
+
+Outcomes are drawn from a per-site ``random.Random`` seeded from
+``GSKY_FAULTS_SEED`` (default 0) xor a CRC of the site name, so a given
+(spec, seed) pair replays the exact same fault sequence — tests and the
+chaos soak are reproducible.
+
+When no spec is configured the module global ``_PLAN`` is ``None`` and
+:func:`inject` returns after a single attribute load + ``is None``
+check: zero measurable overhead on the serving path.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional
+
+
+class InjectedFault(ConnectionError):
+    """A synthetic transport failure.
+
+    Subclasses ``ConnectionError`` deliberately: injected faults ride the
+    exact same recovery paths as real transport failures — the worker
+    pool's ``except (ConnectionError, OSError)`` kill-and-retry clause,
+    and the retry policy's retryable classification — with no
+    test-only except branches anywhere.
+    """
+
+    retryable = True
+
+    def __init__(self, site: str, kind: str = "error"):
+        super().__init__(f"injected {kind} fault at {site!r}")
+        self.site = site
+
+
+class _Rule:
+    __slots__ = ("kind", "rate", "latency_s")
+
+    def __init__(self, kind: str, rate: float, latency_s: float = 0.0):
+        self.kind = kind
+        self.rate = rate
+        self.latency_s = latency_s
+
+
+class _SiteState:
+    __slots__ = ("rules", "rng", "lock")
+
+    def __init__(self, rules: List[_Rule], rng: random.Random):
+        self.rules = rules
+        self.rng = rng
+        self.lock = threading.Lock()
+
+
+def _duration(s: str) -> float:
+    s = s.strip().lower()
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("s"):
+        return float(s[:-1])
+    return float(s)
+
+
+def parse_spec(spec: str) -> Dict[str, List[_Rule]]:
+    """Parse a fault spec into ``{site: [rules]}``; raises ValueError."""
+    out: Dict[str, List[_Rule]] = {}
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        if len(parts) < 3:
+            raise ValueError(f"bad fault clause {clause!r} "
+                             "(want site:kind:arg)")
+        site, kind = parts[0].strip(), parts[1].strip()
+        if kind == "error":
+            rule = _Rule("error", float(parts[2]))
+        elif kind == "latency":
+            rate = float(parts[3]) if len(parts) > 3 else 1.0
+            rule = _Rule("latency", rate, _duration(parts[2]))
+        else:
+            raise ValueError(f"unknown fault kind {kind!r} in {clause!r}")
+        if not 0.0 <= rule.rate <= 1.0:
+            raise ValueError(f"rate out of range in {clause!r}")
+        out.setdefault(site, []).append(rule)
+    return out
+
+
+def site_rng(site: str, seed: int) -> random.Random:
+    """The per-site RNG used for a given seed (exposed for tests)."""
+    return random.Random(seed ^ zlib.crc32(site.encode()))
+
+
+# None when no faults are configured -> inject() is a no-op
+_PLAN: Optional[Dict[str, _SiteState]] = None
+
+
+def configure(spec: Optional[str], seed: int = 0) -> None:
+    """Install (or clear, with a falsy spec) the active fault plan."""
+    global _PLAN
+    if not spec:
+        _PLAN = None
+        return
+    rules = parse_spec(spec)
+    _PLAN = {site: _SiteState(rs, site_rng(site, seed))
+             for site, rs in rules.items()}
+
+
+def reset() -> None:
+    configure(None)
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def inject(site: str) -> None:
+    """Apply any configured faults for ``site``.
+
+    May sleep (latency fault) and/or raise :class:`InjectedFault`.
+    With no plan configured this is a single ``is None`` check.
+    """
+    plan = _PLAN
+    if plan is None:
+        return
+    st = plan.get(site)
+    if st is None:
+        return
+    delay = 0.0
+    boom: Optional[InjectedFault] = None
+    with st.lock:
+        for rule in st.rules:
+            if rule.rate >= 1.0 or st.rng.random() < rule.rate:
+                if rule.kind == "latency":
+                    delay += rule.latency_s
+                else:
+                    boom = InjectedFault(site)
+                    break
+    if delay > 0.0:
+        time.sleep(delay)
+    if boom is not None:
+        from .registry import registry
+        registry.count_fault(site)
+        raise boom
+
+
+# honour the environment at import so every process (server, workers,
+# soak subprocesses) picks the plan up without plumbing
+configure(os.environ.get("GSKY_FAULTS") or None,
+          int(os.environ.get("GSKY_FAULTS_SEED", "0") or "0"))

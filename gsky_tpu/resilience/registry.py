@@ -1,0 +1,85 @@
+"""Process-wide counters for the resilience layer.
+
+A single shared registry collects retry counts, injected-fault counts,
+deadline exhaustions and degraded-response totals, plus a handle on
+every circuit breaker created through ``get_breaker``.  The serving
+metrics endpoint (``server/metrics.py``) snapshots it under the
+``resilience`` key of ``/debug``.
+
+Everything here is plain ``threading.Lock`` counters: the hot path
+(``count_retry`` etc.) only runs when something already went wrong, so
+contention is never a concern.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class ResilienceRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._retries: Dict[str, int] = {}
+        self._exhausted: Dict[str, int] = {}
+        self._faults: Dict[str, int] = {}
+        self._breakers: Dict[str, object] = {}
+        self.degraded_responses = 0
+        self.deadline_exhausted = 0
+
+    # ---- counters ----------------------------------------------------
+    def count_retry(self, site: str) -> None:
+        with self._lock:
+            self._retries[site] = self._retries.get(site, 0) + 1
+
+    def count_exhausted(self, site: str) -> None:
+        with self._lock:
+            self._exhausted[site] = self._exhausted.get(site, 0) + 1
+
+    def count_fault(self, site: str) -> None:
+        with self._lock:
+            self._faults[site] = self._faults.get(site, 0) + 1
+
+    def count_degraded(self) -> None:
+        with self._lock:
+            self.degraded_responses += 1
+
+    def count_deadline(self) -> None:
+        with self._lock:
+            self.deadline_exhausted += 1
+
+    # ---- breakers ----------------------------------------------------
+    def register_breaker(self, breaker) -> None:
+        with self._lock:
+            self._breakers[breaker.name] = breaker
+
+    def unregister_breakers(self) -> None:
+        with self._lock:
+            self._breakers.clear()
+
+    # ---- reporting ---------------------------------------------------
+    def stats(self) -> Dict:
+        with self._lock:
+            breakers = dict(self._breakers)
+            out = {
+                "retries": dict(self._retries),
+                "retry_exhausted": dict(self._exhausted),
+                "faults_injected": dict(self._faults),
+                "degraded_responses": self.degraded_responses,
+                "deadline_exhausted": self.deadline_exhausted,
+            }
+        # breaker snapshots take each breaker's own lock; never nested
+        # inside the registry lock (no lock-order inversion possible)
+        out["breakers"] = {n: b.snapshot() for n, b in breakers.items()}
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._retries.clear()
+            self._exhausted.clear()
+            self._faults.clear()
+            self._breakers.clear()
+            self.degraded_responses = 0
+            self.deadline_exhausted = 0
+
+
+registry = ResilienceRegistry()

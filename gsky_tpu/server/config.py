@@ -326,6 +326,9 @@ class ServiceConfig:
     temp_dir: str = ""
     max_grpc_buffer_size: int = 0
     namespace: str = ""
+    # MAS index HTTP timeout (seconds); further clamped per request by
+    # the resilience deadline budget
+    mas_timeout: int = 60
 
 
 @dataclass
@@ -527,6 +530,7 @@ def load_config_file(path: str, namespace: str = "") -> Config:
             temp_dir=sc.get("temp_dir", ""),
             max_grpc_buffer_size=int(sc.get("max_grpc_buffer_size") or 0),
             namespace=namespace,
+            mas_timeout=_int_or(sc.get("mas_timeout"), 60),
         ),
         layers=[Layer.from_json(l) for l in j.get("layers", []) or []],
         processes=[ProcessConfig.from_json(p)
@@ -560,8 +564,14 @@ def load_config_tree(root: str, mas_factory=None,
         raise ValueError(f"no config.json found under {root}")
     if load_dates:
         for cfg in out.values():
-            mas = mas_factory(cfg.service_config.mas_address) \
-                if mas_factory else None
+            sc = cfg.service_config
+            if mas_factory:
+                mas = mas_factory(sc.mas_address)
+            elif sc.mas_address:
+                from ..index.client import MASClient
+                mas = MASClient(sc.mas_address, timeout=sc.mas_timeout)
+            else:
+                mas = None
             for lay in cfg.layers:
                 if lay.timestamps_load_strategy != "on_demand":
                     try:

@@ -43,10 +43,10 @@ def main(argv=None):
         n = ingest_file(local_store, args.local_mas)
         print(f"in-process MAS: ingested {n} datasets from {args.local_mas}")
 
-    def mas_factory(addr: str):
-        if local_store is not None:
-            return MASClient(local_store)
-        return MASClient(addr)
+    # with no --local-mas override, leave mas_factory unset so OWSServer
+    # builds clients itself with the configured service mas_timeout
+    mas_factory = (lambda addr: MASClient(local_store)) \
+        if local_store is not None else None
 
     try:
         watcher = ConfigWatcher(args.conf, mas_factory)

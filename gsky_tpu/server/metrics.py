@@ -228,6 +228,11 @@ class MetricsLogger:
             if self._export.get("exports"):
                 out["export_pipeline"] = dict(self._export)
         out["cache"] = _cache_stats()
+        try:
+            from ..resilience import registry as _resilience
+            out["resilience"] = _resilience.stats()
+        except Exception:   # observability must never fail a request
+            pass
         return out
 
     def write(self, info: Dict):

@@ -55,6 +55,10 @@ from ..pipeline.export import pipeline_enabled as export_pipeline_enabled
 from ..pipeline.extent import compute_reprojection_extent
 from ..pipeline.feature_info import get_feature_info
 from ..pipeline.types import AxisSelector, MaskSpec
+from ..resilience import (BackendUnavailable, Deadline, DeadlineExceeded,
+                          TooManyFailures, deadline_scope, degraded_reasons,
+                          mark_degraded, request_scope)
+from ..resilience import registry as resilience_registry
 from ..serving import (AdmissionShed, ServingGateway, canonical_key,
                        default_gateway, layer_fingerprint, make_entry,
                        quantise_bbox)
@@ -87,7 +91,7 @@ class OWSServer:
                  static_dir: str = "", temp_dir: str = "",
                  gateway=_GATEWAY_DEFAULT):
         self.watcher = watcher
-        self.mas_factory = mas_factory or (lambda addr: MASClient(addr))
+        self.mas_factory = mas_factory
         self.metrics = metrics or MetricsLogger()
         self.static_dir = static_dir
         self.temp_dir = temp_dir or tempfile.gettempdir()
@@ -106,7 +110,10 @@ class OWSServer:
     # -- plumbing -----------------------------------------------------------
 
     def _mas(self, cfg: Config) -> MASClient:
-        return self.mas_factory(cfg.service_config.mas_address)
+        sc = cfg.service_config
+        if self.mas_factory is not None:
+            return self.mas_factory(sc.mas_address)
+        return MASClient(sc.mas_address, timeout=sc.mas_timeout)
 
     def _pipeline(self, cfg: Config) -> TilePipeline:
         # one pipeline per namespace, rebuilt (and the old WorkerClient
@@ -165,6 +172,15 @@ class OWSServer:
         cache contract: strong ETag, If-None-Match -> 304, per-layer
         Cache-Control."""
         headers = {"X-Gsky-Cache": cache_status}
+        if cache_status == "stale":
+            # stale-on-error replay: past its TTL, served only because
+            # the backend is down — downstream caches must not keep it
+            headers["Cache-Control"] = "no-store"
+            for k, v in ent.headers:
+                headers[k] = v
+            return web.Response(body=ent.body, status=ent.status,
+                                content_type=ent.content_type,
+                                headers=headers)
         if ent.status == 200:
             # Age = time already spent in our cache, so downstream
             # caches don't stretch the layer TTL to ~2x (RFC 9111 §5.1)
@@ -207,7 +223,17 @@ class OWSServer:
             async with gw.admission.admit(svc):
                 return _freeze_response(await render_inner())
 
-        frozen, joined = await gw.flight.do(key, flight_fn)
+        try:
+            frozen, joined = await gw.flight.do(key, flight_fn)
+        except (BackendUnavailable, TooManyFailures):
+            # backend-open breaker / dead dependency: a stale cached
+            # tile beats an error page.  Served degraded + labelled.
+            stale = gw.cache.get_stale(key)
+            if stale is None:
+                raise
+            mark_degraded("stale-cache")
+            collector.info["response_cache"] = "stale"
+            return self._replay(request, stale, "stale")
         if not isinstance(frozen, tuple):     # passthrough response
             if joined:
                 async with self._admit(svc):
@@ -217,7 +243,9 @@ class OWSServer:
         ns, layer_name, fp, max_age = meta
         ent = make_entry(body, ctype, status, ns, layer_name, fp,
                          max_age, keep)
-        if status == 200 and not joined:
+        # degraded (partial) renders must not be cached: joiners would
+        # replay the holes long after the fault cleared
+        if status == 200 and not joined and not degraded_reasons():
             gw.cache.put(key, ent)
         tag = "join" if joined else "miss"
         collector.info["response_cache"] = tag
@@ -330,22 +358,35 @@ class OWSServer:
         collector.set_remote(request.headers.get(
             "X-Forwarded-For", peer).split(",")[0].strip())
         try:
-            cfg = self.watcher.get(ns)
-            if cfg is None:
-                raise OWSError(f"no configuration for namespace {ns!r}",
-                               status=404)
-            if "dap4.ce" in q:
-                async with self._admit("DAP4"):
-                    resp = await self.serve_dap(request, cfg, q,
-                                                collector)
-            else:
-                svc = infer_service(q)
-                if svc == "WMS":
-                    resp = await self.serve_wms(request, cfg, q, collector)
-                elif svc == "WCS":
-                    resp = await self.serve_wcs(request, cfg, q, collector)
+            with request_scope() as rstate:
+                cfg = self.watcher.get(ns)
+                if cfg is None:
+                    raise OWSError(
+                        f"no configuration for namespace {ns!r}",
+                        status=404)
+                if "dap4.ce" in q:
+                    async with self._admit("DAP4"):
+                        resp = await self.serve_dap(request, cfg, q,
+                                                    collector)
                 else:
-                    resp = await self.serve_wps(request, cfg, q, collector)
+                    svc = infer_service(q)
+                    if svc == "WMS":
+                        resp = await self.serve_wms(request, cfg, q,
+                                                    collector)
+                    elif svc == "WCS":
+                        resp = await self.serve_wcs(request, cfg, q,
+                                                    collector)
+                    else:
+                        resp = await self.serve_wps(request, cfg, q,
+                                                    collector)
+                reasons = sorted(set(rstate.reasons))
+            if reasons and resp.status == 200:
+                # partial result: still a 2xx, but honestly labelled so
+                # clients (and the chaos soak) can tell it from a clean
+                # render
+                resp.headers["X-GSKY-Degraded"] = ",".join(reasons)
+                resilience_registry.count_degraded()
+                collector.info["degraded"] = reasons
             collector.log(resp.status)
             return resp
         except AdmissionShed as e:
@@ -358,7 +399,23 @@ class OWSServer:
         except OWSError as e:
             collector.log(e.status)
             return _exception_response(e)
-        except asyncio.TimeoutError:
+        except BackendUnavailable as e:
+            # a dependency (MAS / worker fleet / shard peer) stayed down
+            # through retries and failover: clean 503 + Retry-After, not
+            # a bare 500
+            collector.log(503)
+            return _exception_response(
+                OWSError(f"backend unavailable: {e}", "ServerBusy",
+                         status=503),
+                headers={"Retry-After":
+                         str(max(1, int(getattr(e, "retry_after", 5))))})
+        except TooManyFailures as e:
+            # more granules lost than the degradation budget allows: an
+            # honest error beats a mostly-empty mosaic
+            collector.log(503)
+            return _exception_response(
+                OWSError(str(e), "ServerBusy", status=503))
+        except (asyncio.TimeoutError, DeadlineExceeded):
             collector.log(504)
             return _exception_response(OWSError("request timed out",
                                                 status=504))
@@ -529,85 +586,92 @@ class OWSServer:
                                  style.clip_value)
         scaled = None
         n_exprs = len(req.band_exprs.expr_names)
-        if not lay.input_layers and 1 <= n_exprs <= 4:
-            # single-dispatch fast path: fused warp+mosaic+scale on
-            # device, one pull (the modular path below costs several
-            # device round trips per request); single-band styles
-            # composite, RGB styles emit per-band planes
-            stats: Dict[str, int] = {}
-            if n_exprs == 1:
-                sb = await asyncio.wait_for(
-                    asyncio.to_thread(pipe.render_composite_byte, req,
-                                      style.offset_value,
-                                      style.scale_value,
-                                      style.clip_value,
-                                      style.colour_scale, auto, stats),
-                    timeout=lay.wms_timeout)
-            elif n_exprs == 3:
-                # channel-packed single-scene RGB kernel first (indices
-                # computed once for all bands, one RGBA pull), then the
-                # general per-band path
-                sb = await asyncio.wait_for(
-                    asyncio.to_thread(self._render_rgb, pipe, req, style,
-                                      auto, stats),
-                    timeout=lay.wms_timeout)
-            else:
-                sb = await asyncio.wait_for(
-                    asyncio.to_thread(pipe.render_bands_byte, req,
-                                      style.offset_value,
-                                      style.scale_value,
-                                      style.clip_value,
-                                      style.colour_scale, auto, stats),
-                    timeout=lay.wms_timeout)
-            if sb is not None:
-                td = time.time()
-                rgba = None
-                if isinstance(sb, tuple):   # tagged RGB-ladder result
-                    kind, dev = sb
-                    arr = np.asarray(dev)   # the one device pull
-                    if kind == "rgba":
-                        rgba = arr          # (H, W, 4)
-                        scaled = [arr[..., 0], arr[..., 1], arr[..., 2]]
-                    else:                   # "planes": (3, H, W)
-                        scaled = list(arr)
+        # one deadline budget for the whole render: every stage's
+        # wait_for AND every downstream timeout (MAS HTTP, worker gRPC)
+        # draws from what is LEFT of wms_timeout, not a fresh allowance
+        with deadline_scope(Deadline(lay.wms_timeout)) as dl:
+            if not lay.input_layers and 1 <= n_exprs <= 4:
+                # single-dispatch fast path: fused warp+mosaic+scale on
+                # device, one pull (the modular path below costs several
+                # device round trips per request); single-band styles
+                # composite, RGB styles emit per-band planes
+                stats: Dict[str, int] = {}
+                if n_exprs == 1:
+                    sb = await asyncio.wait_for(
+                        asyncio.to_thread(pipe.render_composite_byte, req,
+                                          style.offset_value,
+                                          style.scale_value,
+                                          style.clip_value,
+                                          style.colour_scale, auto, stats),
+                        timeout=dl.remaining())
+                elif n_exprs == 3:
+                    # channel-packed single-scene RGB kernel first
+                    # (indices computed once for all bands, one RGBA
+                    # pull), then the general per-band path
+                    sb = await asyncio.wait_for(
+                        asyncio.to_thread(self._render_rgb, pipe, req,
+                                          style, auto, stats),
+                        timeout=dl.remaining())
                 else:
-                    arr = np.asarray(sb)  # the one device pull
-                    scaled = [arr] if arr.ndim == 2 else list(arr)
-                collector.info["device"]["duration"] = \
-                    int((time.time() - td) * 1e9)
-                collector.info["device"]["platform"] = _jax_platform()
+                    sb = await asyncio.wait_for(
+                        asyncio.to_thread(pipe.render_bands_byte, req,
+                                          style.offset_value,
+                                          style.scale_value,
+                                          style.clip_value,
+                                          style.colour_scale, auto, stats),
+                        timeout=dl.remaining())
+                if sb is not None:
+                    td = time.time()
+                    rgba = None
+                    if isinstance(sb, tuple):  # tagged RGB-ladder result
+                        kind, dev = sb
+                        arr = np.asarray(dev)   # the one device pull
+                        if kind == "rgba":
+                            rgba = arr          # (H, W, 4)
+                            scaled = [arr[..., 0], arr[..., 1],
+                                      arr[..., 2]]
+                        else:                   # "planes": (3, H, W)
+                            scaled = list(arr)
+                    else:
+                        arr = np.asarray(sb)  # the one device pull
+                        scaled = [arr] if arr.ndim == 2 else list(arr)
+                    collector.info["device"]["duration"] = \
+                        int((time.time() - td) * 1e9)
+                    collector.info["device"]["platform"] = _jax_platform()
+                    collector.info["indexer"]["num_granules"] = \
+                        stats.get("granules", 0)
+                    collector.info["indexer"]["num_files"] = \
+                        stats.get("files", 0)
+                    if rgba is not None and \
+                            p.format.lower() not in ("image/jpeg",
+                                                     "image/jpg"):
+                        collector.info["rpc"]["duration"] = \
+                            int((time.time() - t0) * 1e9)
+                        return _png(encode_rgba_png(rgba))
+            if scaled is None:
+                res = await asyncio.wait_for(
+                    asyncio.to_thread(_render_with_fusion, pipe, req, lay,
+                                      cfg, self),
+                    timeout=dl.remaining())
                 collector.info["indexer"]["num_granules"] = \
-                    stats.get("granules", 0)
-                collector.info["indexer"]["num_files"] = \
-                    stats.get("files", 0)
-                if rgba is not None and \
-                        p.format.lower() not in ("image/jpeg",
-                                                 "image/jpg"):
-                    collector.info["rpc"]["duration"] = \
-                        int((time.time() - t0) * 1e9)
-                    return _png(encode_rgba_png(rgba))
-        if scaled is None:
-            res = await asyncio.wait_for(
-                asyncio.to_thread(_render_with_fusion, pipe, req, lay,
-                                  cfg, self),
-                timeout=lay.wms_timeout)
-            collector.info["indexer"]["num_granules"] = res.granule_count
-            collector.info["indexer"]["num_files"] = res.file_count
+                    res.granule_count
+                collector.info["indexer"]["num_files"] = res.file_count
 
-            bands = [res.data[n] for n in res.namespaces if n in res.data]
-            valids = [res.valid[n] for n in res.namespaces
-                      if n in res.valid]
-            if not bands:
-                return _png(empty_tile_png(p.width, p.height))
-            scaled = []
-            for b, v in zip(bands[:4], valids[:4]):
-                sb = scale_to_byte(jnp.asarray(b), jnp.asarray(v),
-                                   offset=style.offset_value,
-                                   scale=style.scale_value,
-                                   clip=style.clip_value,
-                                   colour_scale=style.colour_scale,
-                                   auto=auto)
-                scaled.append(np.asarray(sb))
+                bands = [res.data[n] for n in res.namespaces
+                         if n in res.data]
+                valids = [res.valid[n] for n in res.namespaces
+                          if n in res.valid]
+                if not bands:
+                    return _png(empty_tile_png(p.width, p.height))
+                scaled = []
+                for b, v in zip(bands[:4], valids[:4]):
+                    sb = scale_to_byte(jnp.asarray(b), jnp.asarray(v),
+                                       offset=style.offset_value,
+                                       scale=style.scale_value,
+                                       clip=style.clip_value,
+                                       colour_scale=style.colour_scale,
+                                       auto=auto)
+                    scaled.append(np.asarray(sb))
         collector.info["rpc"]["duration"] = int((time.time() - t0) * 1e9)
         if p.format.lower() in ("image/jpeg", "image/jpg"):
             return web.Response(body=encode_jpeg(scaled[:3]),
@@ -641,9 +705,10 @@ class OWSServer:
             raise OWSError(f"i/j ({p.x},{p.y}) outside "
                            f"{req.width}x{req.height}", "InvalidPoint")
         pipe = self._pipeline(cfg)
-        fi = await asyncio.wait_for(
-            asyncio.to_thread(get_feature_info, pipe, req, p.x, p.y),
-            timeout=lay.wms_timeout)
+        with deadline_scope(Deadline(lay.wms_timeout)) as dl:
+            fi = await asyncio.wait_for(
+                asyncio.to_thread(get_feature_info, pipe, req, p.x, p.y),
+                timeout=dl.remaining())
         props = {k: (v if v is not None else "n/a")
                  for k, v in fi.values.items()}
         if lay.feature_info_max_dates != 0:
@@ -778,6 +843,9 @@ class OWSServer:
         # tiled render (`ows.go:815-833,1010-1092`)
         tiles = split_bbox(p.bbox, width, height, lay.wcs_max_tile_width,
                            lay.wcs_max_tile_height)
+        # one budget for the whole export; shard fetches, their local
+        # fallbacks and every downstream timeout draw from what remains
+        dl = Deadline(lay.wcs_timeout * max(1, len(tiles)))
         exprs = base_req.band_exprs
         ns_names = list(exprs.expr_names)
         # very large GeoTIFF exports stream tiles straight to disk
@@ -868,8 +936,11 @@ class OWSServer:
                     "format": "geotiff", "wshard": "1"})
                 url = node if "://" in node else f"http://{node}"
                 url = url.rstrip("/") + path
-                tmo = aiohttp.ClientTimeout(
-                    total=lay.wcs_timeout * max(1, len(tiles_in)))
+                # peer fetch charged against the request budget: a slow
+                # peer can't eat more than what's left, and the local
+                # fallback below runs on the remainder
+                tmo = aiohttp.ClientTimeout(total=dl.clamp(
+                    lay.wcs_timeout * max(1, len(tiles_in))))
                 async with aiohttp.ClientSession(timeout=tmo) as s:
                     async with s.get(url, params=params) as resp:
                         if resp.status != 200:
@@ -893,7 +964,22 @@ class OWSServer:
             except Exception:
                 log.exception("WCS shard via %s failed; rendering locally",
                               node)
-                await asyncio.gather(*(render_tile(*t) for t in tiles_in))
+                results = await asyncio.gather(
+                    *(render_tile(*t) for t in tiles_in),
+                    return_exceptions=True)
+                errs = [r for r in results if isinstance(r, BaseException)]
+                for r in errs:
+                    # cancellation (request teardown) must still unwind
+                    if isinstance(r, asyncio.CancelledError):
+                        raise r
+                if errs:
+                    # a failed fallback tile degrades its band instead of
+                    # 500ing the whole export — the rest keeps merging
+                    log.warning(
+                        "%d/%d local-fallback tiles failed after shard "
+                        "%s failure (first: %s)", len(errs),
+                        len(tiles_in), node, errs[0])
+                    mark_degraded("shard-fallback")
 
         # multi-tile exports go through the staged export engine: ONE
         # index query over the full bbox, cross-tile decode dedup, and
@@ -922,10 +1008,11 @@ class OWSServer:
                 pass
 
         try:
-            await asyncio.wait_for(
-                asyncio.gather(render_local(),
-                               *(fetch_shard(*j) for j in remote_jobs)),
-                timeout=lay.wcs_timeout * max(1, len(tiles)))
+            with deadline_scope(dl):
+                await asyncio.wait_for(
+                    asyncio.gather(render_local(),
+                                   *(fetch_shard(*j) for j in remote_jobs)),
+                    timeout=dl.remaining())
         except BaseException:
             # close + unlink the partial stream file on timeout/failure
             # (ADVICE r1: fd and temp-file leak)
@@ -1060,9 +1147,11 @@ class OWSServer:
             dp = DrillPipeline(self._mas(cfg))
             # year-stepped splitting (TimeSplitter parity) bounds the
             # per-window working set for multi-decade drills
-            res = await asyncio.wait_for(
-                asyncio.to_thread(dp.process_split, dreq, proc.year_step),
-                timeout=src.wcs_timeout or 30)
+            with deadline_scope(Deadline(src.wcs_timeout or 30)) as ddl:
+                res = await asyncio.wait_for(
+                    asyncio.to_thread(dp.process_split, dreq,
+                                      proc.year_step),
+                    timeout=ddl.remaining())
             from ..pipeline.drill import drill_csv
             names = list(res.values)
             csv_blocks.append(drill_csv(res, names))

@@ -44,6 +44,9 @@ def _env_int(name: str, default: int) -> int:
 DEFAULT_CACHE_BYTES = _env_int("GSKY_RESPONSE_CACHE_BYTES", 256 << 20)
 DEFAULT_MAX_ENTRY_BYTES = _env_int("GSKY_RESPONSE_CACHE_MAX_ENTRY",
                                    32 << 20)
+# how long past its TTL an entry stays replayable for stale-on-error
+# serving (breaker-open / dead-backend fallback); 0 disables retention
+DEFAULT_STALE_GRACE = _env_int("GSKY_RESPONSE_CACHE_STALE_S", 600)
 
 
 def quantise_bbox(xmin: float, ymin: float, xmax: float, ymax: float,
@@ -112,6 +115,7 @@ class CachedResponse:
     max_age: int
     expires: float                        # monotonic deadline
     headers: Tuple[Tuple[str, str], ...] = ()   # e.g. Content-Disposition
+    stale: bool = False     # past TTL, kept only for stale-on-error
 
 
 def make_entry(body: bytes, content_type: str, status: int,
@@ -131,17 +135,20 @@ class ResponseCache:
     digest, bounded by total body bytes."""
 
     def __init__(self, max_bytes: int = DEFAULT_CACHE_BYTES,
-                 max_entry_bytes: int = DEFAULT_MAX_ENTRY_BYTES):
+                 max_entry_bytes: int = DEFAULT_MAX_ENTRY_BYTES,
+                 stale_grace: int = DEFAULT_STALE_GRACE):
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, CachedResponse]" = OrderedDict()
         self._bytes = 0
         self.max_bytes = max_bytes
         self.max_entry_bytes = max_entry_bytes
+        self.stale_grace = stale_grace
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.expirations = 0
         self.invalidations = 0
+        self.stale_hits = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -159,12 +166,34 @@ class ResponseCache:
                 self.misses += 1
                 return None
             if now >= ent.expires:
-                self._drop(key)
-                self.expirations += 1
+                # expired entries stay resident (still LRU-bounded) for
+                # stale_grace so get_stale() can replay them while a
+                # backend is down; they never serve as normal hits and
+                # count exactly one expiration each
+                if not ent.stale:
+                    ent.stale = True
+                    self.expirations += 1
+                if now >= ent.expires + self.stale_grace:
+                    self._drop(key)
                 self.misses += 1
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
+            return ent
+
+    def get_stale(self, key: str) -> Optional[CachedResponse]:
+        """An entry usable for stale-on-error replay: fresh OR expired
+        within the stale grace window.  Does not count a hit/miss."""
+        now = time.monotonic()
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            if now >= ent.expires + self.stale_grace:
+                self._drop(key)
+                return None
+            self.stale_hits += 1
+            self._entries.move_to_end(key)
             return ent
 
     def put(self, key: str, ent: CachedResponse) -> bool:
@@ -216,4 +245,5 @@ class ResponseCache:
                     "max_bytes": self.max_bytes, "hits": self.hits,
                     "misses": self.misses, "evictions": self.evictions,
                     "expirations": self.expirations,
-                    "invalidations": self.invalidations}
+                    "invalidations": self.invalidations,
+                    "stale_hits": self.stale_hits}

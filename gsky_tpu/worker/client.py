@@ -21,6 +21,8 @@ import numpy as np
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
 from ..pipeline.types import GeoTileRequest, Granule
+from ..resilience import (BackendUnavailable, BreakerOpen, clamp_timeout,
+                          faults, get_breaker, registry)
 from . import gskyrpc_pb2 as pb
 from .serialize import granule_to_pb, unpack_raster
 from .server import METHOD
@@ -66,6 +68,9 @@ class WorkerClient:
             response_deserializer=pb.Result.FromString)
             for ch in self._channels]
         self._rr = itertools.count()
+        # one breaker per node, shared process-wide by address so a
+        # rebuilt client (SIGHUP reload) keeps the node's health history
+        self._breakers = [get_breaker(f"worker:{n}") for n in nodes]
         self.limiter = ConcLimiter(conc_per_node * len(nodes))
         self.timeout = timeout
         self.nodes = nodes
@@ -94,23 +99,66 @@ class WorkerClient:
                 max_workers=total, thread_name_prefix="gsky-warp-rpc")
         return total
 
-    def _stub(self):
-        return self._stubs[next(self._rr) % len(self._stubs)]
-
     def process(self, task: pb.Task) -> pb.Result:
+        """Dispatch with per-node health tracking and failover.
+
+        Starts at the round-robin position, skips nodes whose breaker is
+        open, and on transport failure records it against that node and
+        fails over to the next stub — ejecting a sick node costs one
+        failed RPC, not a request.  Only when every node has failed (or
+        is circuit-open) does the error surface, as
+        :class:`BackendUnavailable`.
+        """
         with self.limiter:
-            return self._stub()(task, timeout=self.timeout)
+            n = len(self._stubs)
+            start = next(self._rr)
+            last: Optional[Exception] = None
+            for k in range(n):
+                i = (start + k) % n
+                br = self._breakers[i]
+                if not br.allow():
+                    continue
+                try:
+                    faults.inject("worker")
+                    res = self._stubs[i](task,
+                                         timeout=clamp_timeout(self.timeout))
+                except Exception as e:
+                    br.record_failure()
+                    last = e
+                    if k + 1 < n:
+                        registry.count_retry("worker")
+                    continue
+                br.record_success()
+                return res
+        if last is None:
+            raise BreakerOpen("all worker nodes circuit-open",
+                              site="worker")
+        registry.count_exhausted("worker")
+        raise BackendUnavailable(
+            f"all {n} worker node(s) failed (last: {last})",
+            site="worker") from last
 
     # -- high-level ops ------------------------------------------------------
 
-    def worker_info(self) -> List[pb.WorkerInfo]:
-        """Pool info from every node (`getGrpcPoolSize`,
-        `utils/config.go:1124-1187`)."""
-        infos = []
-        for stub in self._stubs:
-            r = stub(pb.Task(operation="worker_info"), timeout=10.0)
-            infos.append(r.worker)
-        return infos
+    def worker_info(self, timeout: float = 10.0) -> List[pb.WorkerInfo]:
+        """Pool info from every reachable node (`getGrpcPoolSize`,
+        `utils/config.go:1124-1187`).  Nodes are queried concurrently
+        and unreachable ones are logged + flagged on their breaker and
+        skipped — a dead node costs one timeout in parallel with the
+        live queries, not a serial 10s stall each at startup."""
+        def one(arg):
+            node, stub, br = arg
+            try:
+                r = stub(pb.Task(operation="worker_info"), timeout=timeout)
+            except Exception as e:
+                br.record_failure()
+                log.warning("worker_info: node %s unreachable: %s", node, e)
+                return None
+            br.record_success()
+            return r.worker
+        infos = list(self._fanout.map(
+            one, zip(self.nodes, self._stubs, self._breakers)))
+        return [i for i in infos if i is not None]
 
     def warp(self, granule: Granule, dst_gt: GeoTransform, dst_crs: CRS,
              width: int, height: int,
@@ -243,10 +291,15 @@ class WorkerClient:
         if failures:
             log.warning("%d/%d warp RPCs failed (first: %s)",
                         len(failures), len(jobs), failures[0])
+            if len(failures) < len(jobs):
+                from ..resilience import mark_degraded
+                mark_degraded("worker")
             # outage visibility: a dead fleet must not look like "no
             # data" — per-granule failures degrade to empty granules,
             # total failure becomes an error response upstream
             if len(failures) == len(jobs):
+                if isinstance(failures[0], BackendUnavailable):
+                    raise failures[0]
                 raise RuntimeError(
                     f"all {len(jobs)} warp RPCs failed "
                     f"(first: {failures[0]})")

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import List, Optional
 
+from ..resilience import faults
 from . import gskyrpc_pb2 as pb
 from .ipc import call_subprocess
 
@@ -36,6 +37,18 @@ log = logging.getLogger("gsky.worker.pool")
 
 MAX_RETRIES = 5
 QUEUE_CAP_PER_PROCESS = 200
+
+
+def _recycle_threshold(max_tasks: int, size: int,
+                       rand=random.randrange) -> int:
+    """Jittered per-process recycle threshold, proportional to the
+    recycle period so a pool draining one shared queue doesn't restart
+    in lockstep (the reference jitters by pool size, `pool.go:29-33`;
+    our children block ~tens of seconds on startup imports, so the
+    spread must be much wider than a few tasks)."""
+    if size <= 1:
+        return max_tasks
+    return max_tasks + rand(max(size, max_tasks // 10))
 
 _PR_SET_PDEATHSIG = 1
 
@@ -70,14 +83,7 @@ class Process:
         self.idx = idx
         self.sock_path = os.path.join(
             pool.tmp_dir, f"gsky_decode_{os.getpid()}_{idx}.sock")
-        # jittered recycle threshold, proportional to the recycle period
-        # so a pool draining one shared queue doesn't restart in
-        # lockstep (the reference jitters by pool size, `pool.go:29-33`;
-        # our children block ~tens of seconds on startup imports, so the
-        # spread must be much wider than a few tasks)
-        self.max_tasks = pool.max_tasks + (
-            random.randrange(max(pool.size, pool.max_tasks // 10))
-            if pool.size > 1 else 0)
+        self.max_tasks = _recycle_threshold(pool.max_tasks, pool.size)
         self.proc: Optional[subprocess.Popen] = None
         self.tasks_done = 0
         self.thread = threading.Thread(target=self._run, daemon=True,
@@ -157,6 +163,10 @@ class Process:
             if item is None:
                 break
             try:
+                # injected "pool" faults raise a ConnectionError subclass
+                # here, driving the REAL kill/respawn/retry path below —
+                # no test-only branches in the recovery logic
+                faults.inject("pool")
                 res = call_subprocess(
                     self.sock_path, item.task,
                     timeout=self.pool.task_timeout + 10.0)

@@ -1,0 +1,490 @@
+"""Resilience layer: fault-spec parsing and deterministic injection,
+backoff schedule determinism, breaker state transitions, deadline
+exhaustion, partial-mosaic degradation, stale-cache retention, and the
+worker pool crash-retry contract (MAX_RETRIES / recycle jitter /
+queue-full) driven through the fault-injection layer rather than
+ad-hoc monkeypatching."""
+
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+from gsky_tpu import resilience
+from gsky_tpu.resilience import (BackendUnavailable, BreakerOpen,
+                                 CircuitBreaker, Deadline, DeadlineExceeded,
+                                 InjectedFault, RetryPolicy, TooManyFailures,
+                                 call_with_retry, check_partial,
+                                 clamp_timeout, deadline_scope,
+                                 degraded_reasons, faults, mark_degraded,
+                                 registry, request_scope)
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+# ---------------------------------------------------------------------------
+# fault spec + deterministic injection
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parse():
+    rules = faults.parse_spec(
+        "mas:error:0.2,worker:latency:500ms,decode:latency:2s:0.1")
+    assert rules["mas"][0].kind == "error"
+    assert rules["mas"][0].rate == 0.2
+    assert rules["worker"][0].kind == "latency"
+    assert rules["worker"][0].latency_s == 0.5
+    assert rules["worker"][0].rate == 1.0
+    assert rules["decode"][0].latency_s == 2.0
+    assert rules["decode"][0].rate == 0.1
+
+
+@pytest.mark.parametrize("spec", ["mas", "mas:error", "mas:explode:0.5",
+                                  "mas:error:1.5"])
+def test_fault_spec_rejects_bad_clauses(spec):
+    with pytest.raises(ValueError):
+        faults.parse_spec(spec)
+
+
+def _outcomes(site, n):
+    seq = []
+    for _ in range(n):
+        try:
+            faults.inject(site)
+            seq.append(0)
+        except InjectedFault:
+            seq.append(1)
+    return seq
+
+
+def test_injection_deterministic_per_seed():
+    faults.configure("mas:error:0.5", seed=11)
+    a = _outcomes("mas", 32)
+    faults.configure("mas:error:0.5", seed=11)
+    assert _outcomes("mas", 32) == a
+    faults.configure("mas:error:0.5", seed=12)
+    assert _outcomes("mas", 32) != a
+    assert 0 < sum(a) < 32          # actually probabilistic
+
+
+def test_injection_counts_to_registry():
+    faults.configure("decode:error:1.0", seed=0)
+    with pytest.raises(InjectedFault):
+        faults.inject("decode")
+    assert registry.stats()["faults_injected"]["decode"] == 1
+
+
+def test_inactive_plan_is_noop():
+    assert not faults.active()
+    faults.inject("mas")            # no raise, no counters
+    faults.configure("mas:error:1.0")
+    faults.inject("worker")         # unknown site: still a no-op
+    assert registry.stats()["faults_injected"] == {}
+
+
+def test_injected_fault_is_connection_error():
+    # rides the pool's existing except (ConnectionError, OSError) clause
+    assert issubclass(InjectedFault, ConnectionError)
+    assert resilience.is_retryable(InjectedFault("x"))
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_schedule_deterministic():
+    pol = RetryPolicy(max_attempts=5, base_delay=0.1, multiplier=2.0,
+                      max_delay=1.0, jitter=0.5)
+    a = list(pol.delays(random.Random(3)))
+    b = list(pol.delays(random.Random(3)))
+    assert a == b and len(a) == 4
+    for k, d in enumerate(a):
+        nominal = min(0.1 * 2.0 ** k, 1.0)
+        assert nominal * 0.5 <= d <= nominal * 1.5
+
+
+def test_backoff_no_jitter_is_pure_exponential():
+    pol = RetryPolicy(max_attempts=4, base_delay=0.1, multiplier=2.0,
+                      max_delay=10.0, jitter=0.0)
+    assert list(pol.delays()) == pytest.approx([0.1, 0.2, 0.4])
+
+
+def test_retry_recovers_from_transient():
+    calls, slept = [], []
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("flaky")
+        return "ok"
+    out = call_with_retry(fn, RetryPolicy(max_attempts=4, jitter=0.0),
+                          site="t", sleep=slept.append)
+    assert out == "ok" and len(calls) == 3
+    assert slept == pytest.approx([0.1, 0.2])
+    assert registry.stats()["retries"]["t"] == 2
+
+
+def test_retry_skips_non_retryable():
+    calls = []
+    def fn():
+        calls.append(1)
+        raise ValueError("bad request")
+    with pytest.raises(ValueError):
+        call_with_retry(fn, RetryPolicy(max_attempts=5), site="t",
+                        sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_retry_exhaustion_wraps_last_error():
+    def fn():
+        raise TimeoutError("still down")
+    with pytest.raises(BackendUnavailable) as ei:
+        call_with_retry(fn, RetryPolicy(max_attempts=3, jitter=0.0),
+                        site="t", sleep=lambda s: None)
+    assert isinstance(ei.value.__cause__, TimeoutError)
+    assert registry.stats()["retry_exhausted"]["t"] == 1
+
+
+def test_retry_respects_deadline():
+    calls = []
+    def fn():
+        calls.append(1)
+        raise ConnectionError("down")
+    # budget can't afford even the first 0.1s backoff sleep
+    dl = Deadline(0.05)
+    with pytest.raises(BackendUnavailable):
+        call_with_retry(fn, RetryPolicy(max_attempts=5, jitter=0.0),
+                        site="t", deadline=dl, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_transitions():
+    clk = FakeClock()
+    br = CircuitBreaker("b", failure_threshold=3, reset_timeout=10.0,
+                        clock=clk, register=False)
+    assert br.state == "closed"
+    for _ in range(3):
+        assert br.allow()
+        br.record_failure()
+    assert br.state == "open" and br.opens == 1
+    assert not br.allow()                     # rejected while open
+    assert br.retry_after() == pytest.approx(10.0)
+    clk.t += 10.0
+    assert br.state == "half_open"
+    assert br.allow()                         # the probe
+    assert not br.allow()                     # only ONE probe at a time
+    br.record_failure()                       # probe failed -> re-open
+    assert br.state == "open" and br.opens == 2
+    clk.t += 10.0
+    assert br.allow()
+    br.record_success()                       # probe succeeded -> closed
+    assert br.state == "closed"
+    assert br.allow() and br.allow()
+
+
+def test_breaker_consecutive_not_cumulative():
+    br = CircuitBreaker("b", failure_threshold=3, register=False)
+    for _ in range(10):
+        br.record_failure()
+        br.record_success()
+    assert br.state == "closed" and br.opens == 0
+
+
+def test_breaker_open_shortcircuits_retry():
+    clk = FakeClock()
+    br = CircuitBreaker("b", failure_threshold=1, reset_timeout=10.0,
+                        clock=clk, register=False)
+    br.record_failure()
+    calls = []
+    with pytest.raises(BreakerOpen):
+        call_with_retry(lambda: calls.append(1), site="t", breaker=br,
+                        sleep=lambda s: None)
+    assert calls == []
+
+
+def test_semantic_error_does_not_open_breaker():
+    br = CircuitBreaker("b", failure_threshold=1, register=False)
+    def fn():
+        raise ValueError("4xx-ish")
+    for _ in range(5):
+        with pytest.raises(ValueError):
+            call_with_retry(fn, site="t", breaker=br,
+                            sleep=lambda s: None)
+    assert br.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# deadline budget
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_decrements_and_exhausts():
+    clk = FakeClock()
+    dl = Deadline(10.0, clock=clk)
+    assert dl.clamp(60.0) == pytest.approx(10.0)
+    clk.t += 4.0
+    assert dl.remaining() == pytest.approx(6.0)
+    assert dl.clamp(3.0) == pytest.approx(3.0)
+    clk.t += 7.0
+    assert dl.expired()
+    with pytest.raises(DeadlineExceeded):
+        dl.clamp(1.0)
+    assert registry.stats()["deadline_exhausted"] == 1
+
+
+def test_deadline_exceeded_is_timeout():
+    # handle()'s except (asyncio.TimeoutError, DeadlineExceeded) relies
+    # on this subclassing
+    assert issubclass(DeadlineExceeded, TimeoutError)
+
+
+def test_clamp_timeout_uses_context_scope():
+    assert clamp_timeout(42.0) == 42.0        # no scope: untouched
+    with deadline_scope(Deadline(5.0)):
+        assert clamp_timeout(60.0) <= 5.0
+        assert clamp_timeout(1.0) == 1.0
+    assert clamp_timeout(42.0) == 42.0
+
+
+def test_deadline_scope_crosses_threads():
+    # asyncio.to_thread copies the context; the Deadline OBJECT (whose
+    # clock keeps running) must be the shared thing
+    import contextvars
+    with deadline_scope(Deadline(30.0)):
+        ctx = contextvars.copy_context()
+    got = ctx.run(lambda: clamp_timeout(60.0))
+    assert got <= 30.0
+
+
+# ---------------------------------------------------------------------------
+# degradation policy
+# ---------------------------------------------------------------------------
+
+
+def test_mark_degraded_collects_reasons():
+    mark_degraded("noop-outside-scope")       # no scope: silently ignored
+    with request_scope() as st:
+        mark_degraded("decode")
+        mark_degraded("decode")
+        mark_degraded("worker")
+        assert degraded_reasons() == ("decode", "worker")
+    assert degraded_reasons() == ()
+    assert st.reasons == ["decode", "worker"]
+
+
+def test_check_partial_policy():
+    with request_scope():
+        check_partial(0, 4, "decode")         # no failures: no-op
+        assert degraded_reasons() == ()
+        check_partial(2, 4, "decode")         # at the 0.5 default: degrade
+        assert degraded_reasons() == ("decode",)
+        with pytest.raises(TooManyFailures):
+            check_partial(3, 4, "decode")     # over budget
+        with pytest.raises(TooManyFailures):
+            check_partial(4, 4, "decode")     # total loss always raises
+
+
+def test_check_partial_fraction_env(monkeypatch):
+    monkeypatch.setenv("GSKY_DEGRADE_MAX_FRACTION", "0.1")
+    with request_scope():
+        with pytest.raises(TooManyFailures):
+            check_partial(1, 4, "decode")
+
+
+# ---------------------------------------------------------------------------
+# MAS client: retry + breaker wiring (both transports behind inject)
+# ---------------------------------------------------------------------------
+
+
+def test_mas_client_retries_injected_faults(tmp_path):
+    from gsky_tpu.index import MASStore
+    from gsky_tpu.index.client import MASClient
+
+    c = MASClient(MASStore())
+    c._retry = RetryPolicy(max_attempts=3, base_delay=0.001,
+                           max_delay=0.002)
+    faults.configure("mas:error:1.0", seed=0)
+    with pytest.raises(BackendUnavailable) as ei:
+        c.intersects("/does/not/matter")
+    assert isinstance(ei.value.__cause__, InjectedFault)
+    s = registry.stats()
+    assert s["retries"]["mas"] == 2
+    assert s["faults_injected"]["mas"] == 3
+    # 3 consecutive failures recorded; 2 more open the breaker mid-call
+    with pytest.raises(BackendUnavailable):
+        c.intersects("/does/not/matter")
+    assert c._breaker.state == "open"
+    with pytest.raises(BreakerOpen):
+        c.intersects("/x")                     # rejected without calling
+    # fault cleared + cooldown elapsed -> half-open probe recovers
+    faults.reset()
+    c._breaker.reset_timeout = 0.0
+    assert c.intersects("/x") == []
+    assert c._breaker.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# partial-mosaic degradation on the decode path
+# ---------------------------------------------------------------------------
+
+
+def _two_granules(tmp_path):
+    from gsky_tpu.geo.crs import EPSG4326
+    from gsky_tpu.geo.transform import GeoTransform
+    from gsky_tpu.io import write_geotiff
+    from gsky_tpu.pipeline.types import Granule
+
+    gt = GeoTransform(148.0, 0.01, 0.0, -35.0, 0.0, -0.01)
+    gs = []
+    for name in ("good", "bad"):
+        p = os.path.join(str(tmp_path), f"{name}.tif")
+        write_geotiff(p, np.ones((64, 64), np.int16), gt, EPSG4326,
+                      nodata=-999)
+        gs.append(Granule(
+            path=p, ds_name=f"{name}.tif", namespace="b1",
+            base_namespace="b1", band=1, time_index=None, timestamp=0.0,
+            srs="EPSG:4326", geo_transform=list(gt.to_gdal()),
+            nodata=-999.0, array_type="Int16", is_netcdf=False))
+    with open(gs[1].path, "wb") as fp:
+        fp.write(b"this is not a tiff")
+    return gs
+
+
+def test_decode_all_reports_errors_separately(tmp_path):
+    from gsky_tpu.geo.crs import EPSG4326
+    from gsky_tpu.geo.transform import BBox
+    from gsky_tpu.pipeline.decode import decode_all
+
+    gs = _two_granules(tmp_path)
+    bbox = BBox(148.0, -35.64, 148.64, -35.0)
+    errs = []
+    ws = decode_all(gs, bbox, EPSG4326, workers=1, errors=errs)
+    assert ws[0] is not None and ws[1] is None
+    assert len(errs) == 1                    # corrupt file, not non-overlap
+    with request_scope():
+        check_partial(len(errs), len(gs), "decode")
+        assert degraded_reasons() == ("decode",)
+
+
+def test_decode_faults_flow_through_decode_all(tmp_path):
+    from gsky_tpu.geo.crs import EPSG4326
+    from gsky_tpu.geo.transform import BBox
+    from gsky_tpu.pipeline.decode import decode_all
+
+    gs = _two_granules(tmp_path)[:1]
+    bbox = BBox(148.0, -35.64, 148.64, -35.0)
+    faults.configure("decode:error:1.0", seed=0)
+    errs = []
+    ws = decode_all(gs, bbox, EPSG4326, workers=1, errors=errs)
+    assert ws == [None]
+    assert len(errs) == 1 and isinstance(errs[0], InjectedFault)
+    with request_scope():
+        with pytest.raises(TooManyFailures):   # 1/1 lost: total loss
+            check_partial(len(errs), len(gs), "decode")
+
+
+# ---------------------------------------------------------------------------
+# stale-on-error response cache retention
+# ---------------------------------------------------------------------------
+
+
+def test_response_cache_stale_grace():
+    from gsky_tpu.serving.response_cache import ResponseCache, make_entry
+
+    rc = ResponseCache(max_bytes=1 << 20, stale_grace=300)
+    rc.put("k", make_entry(b"tile", "image/png", 200, "", "l", "fp", 60))
+    ent = rc._entries["k"]
+    ent.expires = time.monotonic() - 1.0     # expired, within grace
+    assert rc.get("k") is None               # never a normal hit
+    assert rc.expirations == 1
+    assert rc.get("k") is None               # expiration counted ONCE
+    assert rc.expirations == 1
+    stale = rc.get_stale("k")
+    assert stale is not None and stale.body == b"tile"
+    assert rc.stale_hits == 1
+    ent.expires = time.monotonic() - 301.0   # past the grace window
+    assert rc.get_stale("k") is None
+    assert "k" not in rc._entries
+
+
+def test_response_cache_fresh_entry_also_stale_servable():
+    from gsky_tpu.serving.response_cache import ResponseCache, make_entry
+
+    rc = ResponseCache(max_bytes=1 << 20, stale_grace=300)
+    rc.put("k", make_entry(b"x", "image/png", 200, "", "l", "fp", 60))
+    assert rc.get_stale("k") is not None
+
+
+# ---------------------------------------------------------------------------
+# worker pool crash-retry contract, via fault injection
+# ---------------------------------------------------------------------------
+
+
+def test_recycle_jitter_bounds():
+    from gsky_tpu.worker.pool import _recycle_threshold
+
+    assert _recycle_threshold(20000, 1) == 20000       # size 1: exact
+    rng = random.Random(7)
+    draws = {_recycle_threshold(20000, 4, rand=rng.randrange)
+             for _ in range(64)}
+    assert all(20000 <= d < 20000 + 2000 for d in draws)
+    assert len(draws) > 8                    # actually spread out
+    # small max_tasks: spread is at least the pool size
+    assert all(10 <= _recycle_threshold(10, 4, rand=rng.randrange) < 14
+               for _ in range(32))
+
+
+def test_pool_queue_full_rejects():
+    import queue as queue_mod
+    from gsky_tpu.worker import gskyrpc_pb2 as pb
+    from gsky_tpu.worker.pool import PoolFullError, ProcessPool
+
+    p = ProcessPool.__new__(ProcessPool)     # no children: can't drain
+    p.closed = False
+    p.queue = queue_mod.Queue(maxsize=1)
+    p.task_timeout = 1.0
+    p.queue.put_nowait(object())
+    with pytest.raises(PoolFullError):
+        p.submit(pb.Task(operation="decode"))
+
+
+def test_pool_max_retries_then_recovery():
+    """pool:error:1.0 drives the REAL kill/respawn/retry path on every
+    dispatch: the task fails after exactly MAX_RETRIES attempts with the
+    contract error string; clearing the faults, the same pool serves
+    again (the supervisor kept replacing children throughout)."""
+    from gsky_tpu.worker import gskyrpc_pb2 as pb
+    from gsky_tpu.worker.pool import MAX_RETRIES, ProcessPool
+
+    pool = ProcessPool(size=1, task_timeout=30.0, quiet=True)
+    try:
+        faults.configure("pool:error:1.0", seed=0)
+        res = pool.submit(pb.Task(operation="no_such_op"))
+        assert res.error == f"task failed after {MAX_RETRIES} attempts"
+        assert registry.stats()["faults_injected"]["pool"] == MAX_RETRIES
+        faults.reset()
+        res = pool.submit(pb.Task(operation="no_such_op"))
+        # reached a live child again: a real (semantic) worker reply
+        assert "unknown operation" in res.error
+    finally:
+        faults.reset()
+        pool.close()

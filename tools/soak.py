@@ -32,6 +32,18 @@ sustained load.  Asserts every export succeeds, RSS stays bounded, and
 with non-zero per-stage timings.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario wcs --seconds 60
+
+``--scenario chaos``: mixed GetMap/GetCoverage load with deterministic
+injected faults (default 20% MAS + worker + decode errors, see
+``--faults``) against a gateway-fronted server.  Every response must be
+a clean 2xx, a degraded-but-labelled 2xx (``X-GSKY-Degraded``), or a
+well-formed OGC ServiceException (503/504 + ``se_xml`` body + honest
+``Retry-After``); a bare HTTP 500 — an unhandled internal error — or a
+dropped connection fails the soak.  Also requires /debug's
+``resilience`` block to show the machinery actually firing: non-zero
+retry, injected-fault, breaker-failure and degraded-response counters.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario chaos --seconds 30
 """
 
 from __future__ import annotations
@@ -62,10 +74,15 @@ def main(argv=None):
     ap.add_argument("--seconds", type=float, default=120.0)
     ap.add_argument("--conc", type=int, default=8)
     ap.add_argument("--max-rss-growth-mb", type=float, default=256.0)
-    ap.add_argument("--scenario", choices=("churn", "hot", "wcs"),
+    ap.add_argument("--scenario", choices=("churn", "hot", "wcs", "chaos"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
+    ap.add_argument("--faults",
+                    default="mas:error:0.2,worker:error:0.2,"
+                            "decode:error:0.2",
+                    help="chaos scenario: GSKY_FAULTS-style spec")
+    ap.add_argument("--fault-seed", type=int, default=11)
     args = ap.parse_args(argv)
 
     from gsky_tpu.device import ensure_platform
@@ -99,6 +116,20 @@ def main(argv=None):
                 "rgb_products": [f"LC08_20200{110 + k}_T1"
                                  for k in range(B.N_SCENES)],
                 "time_generator": "mas",
+                "wcs_max_width": 4096, "wcs_max_height": 4096,
+                "wcs_max_tile_width": 256,
+                "wcs_max_tile_height": 256},
+                # chaos twin: a short response-cache TTL so entries
+                # expire DURING the run and the stale-on-error path
+                # (gateway serving an expired tile while a backend is
+                # down) actually executes, not just in theory
+                {
+                "name": "landsat_chaos", "title": "chaos soak",
+                "data_source": root,
+                "rgb_products": [f"LC08_20200{110 + k}_T1"
+                                 for k in range(B.N_SCENES)],
+                "time_generator": "mas",
+                "cache_max_age": 3,
                 "wcs_max_width": 4096, "wcs_max_height": 4096,
                 "wcs_max_tile_width": 256,
                 "wcs_max_tile_height": 256}],
@@ -141,6 +172,8 @@ def main(argv=None):
         return run_hot(args, watcher, mas_client, merc, boot)
     if args.scenario == "wcs":
         return run_wcs(args, watcher, mas_client, merc, boot)
+    if args.scenario == "chaos":
+        return run_chaos(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -297,6 +330,175 @@ def run_hot(args, watcher, mas_client, merc, boot) -> int:
     print(json.dumps(out))
     ok = (base["failed"] == 0 and gate["failed"] == 0
           and gate["hit_rate"] > 0.3)
+    print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+    return 0 if ok else 1
+
+
+def run_chaos(args, watcher, mas_client, merc, boot) -> int:
+    """Mixed GetMap/GetCoverage under deterministic injected faults.
+
+    Outcome classes per request:
+
+    - ``ok``: clean 2xx
+    - ``degraded``: 2xx carrying ``X-GSKY-Degraded`` (partial mosaic or
+      stale-cache replay — honest, labelled, still useful)
+    - ``ogc_error``: OGC ServiceException XML (admission shed, backend
+      unavailable after retries, over-budget partial loss, deadline) —
+      a *clean* refusal with the right status + Retry-After
+    - ``hard_5xx`` / ``transport``: a bare internal 500 or a dropped
+      connection.  These fail the soak: the whole point of the
+      resilience layer is that injected backend faults never surface as
+      unhandled errors.
+    """
+    import threading
+
+    import numpy as np
+
+    from gsky_tpu.resilience import faults
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+    from gsky_tpu.serving import ServingGateway
+
+    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                       metrics=MetricsLogger(), gateway=ServingGateway())
+    host = boot(server)
+
+    grid = 4
+    frac = np.linspace(0.0, 0.75, grid)
+    hot = [(float(fx), float(fy)) for fx in frac for fy in frac]
+    w = merc.width * 0.25
+
+    def getmap_url(fx: float, fy: float, date: int) -> str:
+        bb = (f"{merc.xmin + fx * merc.width},"
+              f"{merc.ymin + fy * merc.height},"
+              f"{merc.xmin + fx * merc.width + w},"
+              f"{merc.ymin + fy * merc.height + w}")
+        return (f"http://{host}/ows?service=WMS&request=GetMap"
+                f"&version=1.3.0&layers=landsat_chaos&crs=EPSG:3857"
+                f"&bbox={bb}&width=256&height=256&format=image/png"
+                f"&time=2020-01-{date:02d}T00:00:00.000Z")
+
+    def getcov_url(fx: float, fy: float) -> str:
+        cw = merc.width * 0.4
+        bb = (f"{merc.xmin + fx * merc.width},"
+              f"{merc.ymin + fy * merc.height},"
+              f"{merc.xmin + fx * merc.width + cw},"
+              f"{merc.ymin + fy * merc.height + cw}")
+        return (f"http://{host}/ows?service=WCS&request=GetCoverage"
+                f"&coverage=landsat_chaos&crs=EPSG:3857&bbox={bb}"
+                f"&width=512&height=512&format=GeoTIFF"
+                f"&time=2020-01-10T00:00:00.000Z")
+
+    def classify(url: str) -> str:
+        try:
+            with urllib.request.urlopen(url, timeout=120) as r:
+                degraded = r.headers.get("X-GSKY-Degraded")
+                r.read()
+                return "degraded" if degraded else "ok"
+        except urllib.error.HTTPError as e:
+            ctype = e.headers.get("Content-Type", "")
+            e.read()
+            if e.code == 500 or "vnd.ogc.se_xml" not in ctype:
+                return "hard_5xx"
+            return "ogc_error"
+        except Exception:
+            return "transport"
+
+    # warm the hot tiles fault-free so the response cache holds clean
+    # bytes; with cache_max_age=3 they expire mid-run and failed
+    # re-renders fall back to stale-on-error replay
+    warm_bad = sum(classify(getmap_url(fx, fy, 10)) not in ("ok",)
+                   for fx, fy in hot)
+
+    faults.configure(args.faults, seed=args.fault_seed)
+    rng = np.random.default_rng(args.fault_seed)
+    counter = itertools.count()
+    counts: dict = {}
+    lock = threading.Lock()
+
+    # periodically evict the resident scenes: a warmed scene cache would
+    # otherwise absorb every decode after the first minute, and the
+    # decode-site faults (plus the partial-mosaic degradation they
+    # trigger) would never execute.  Real deployments hit this via LRU
+    # pressure; the soak compresses it to a few seconds.
+    stop_churn = threading.Event()
+    from gsky_tpu.pipeline.scene_cache import default_scene_cache
+
+    def churn_scene_cache():
+        while not stop_churn.wait(2.0):
+            default_scene_cache.clear()
+
+    threading.Thread(target=churn_scene_cache, daemon=True).start()
+
+    def one(_):
+        i = next(counter)
+        if i % 6 == 5:
+            u = getcov_url(float(rng.uniform(0.0, 0.5)),
+                           float(rng.uniform(0.0, 0.5)))
+        elif i % 3 == 0:
+            fx, fy = hot[i // 3 % len(hot)]
+            u = getmap_url(fx, fy, 10)
+        else:
+            u = getmap_url(float(rng.uniform(0.0, 0.75)),
+                           float(rng.uniform(0.0, 0.75)),
+                           10 + i % 4)
+        c = classify(u)
+        with lock:
+            counts[c] = counts.get(c, 0) + 1
+
+    t_end = time.time() + args.seconds
+    try:
+        with cf.ThreadPoolExecutor(args.conc) as ex:
+            while time.time() < t_end:
+                list(ex.map(one, range(args.conc * 4)))
+    finally:
+        stop_churn.set()
+        faults.reset()
+
+    # deterministic stale-on-error exercise on top of the probabilistic
+    # load above: cache one tile cleanly, let its 3s TTL lapse, take the
+    # backends down HARD, and require the gateway to answer with the
+    # expired bytes as a labelled degraded 200 rather than an error
+    u0 = getmap_url(*hot[0], 10)
+    # fault-free refresh; "degraded" is legal here too (the load phase
+    # may have left the MAS breaker open -> stale replay while it cools)
+    refresh_cls = classify(u0)
+    time.sleep(3.5)                         # past TTL, within stale grace
+    default_scene_cache.clear()
+    faults.configure("mas:error:1.0,decode:error:1.0", seed=1)
+    try:
+        stale_cls = classify(u0)
+    finally:
+        faults.reset()
+
+    with urllib.request.urlopen(f"http://{host}/debug",
+                                timeout=30) as r:
+        res = json.loads(r.read()).get("resilience", {})
+    breakers = res.get("breakers", {})
+    out = {
+        "scenario": "chaos", "faults": args.faults,
+        "warm_failures": warm_bad, "responses": counts,
+        "stale_on_error": {"refresh": refresh_cls, "replay": stale_cls},
+        "resilience": {
+            "retries": res.get("retries", {}),
+            "retry_exhausted": res.get("retry_exhausted", {}),
+            "faults_injected": res.get("faults_injected", {}),
+            "degraded_responses": res.get("degraded_responses", 0),
+            "breaker_failures": {n: b.get("failures", 0)
+                                 for n, b in breakers.items()},
+        },
+    }
+    print(json.dumps(out))
+    ok = (warm_bad == 0
+          and counts.get("hard_5xx", 0) == 0
+          and counts.get("transport", 0) == 0
+          and counts.get("ok", 0) > 0
+          and refresh_cls in ("ok", "degraded")
+          and stale_cls == "degraded"
+          and sum(res.get("retries", {}).values()) > 0
+          and sum(res.get("faults_injected", {}).values()) > 0
+          and res.get("degraded_responses", 0) > 0
+          and any(b.get("failures", 0) > 0 for b in breakers.values()))
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
 
