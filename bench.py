@@ -661,6 +661,68 @@ def bench_kernels():
         "chip_drills_per_s": round(1e3 / pipe_ms, 1),
         "approx_hbm_gbps": round(traffic / (pipe_ms * 1e-3) / 1e9, 2)}
 
+    # --- pallas-vs-xla A/B at the cfg3 (warp render) and cfg5 (drill
+    # stats) shapes: BENCH_TPU_* records show which implementation
+    # actually serves, not just the raced winner's time
+    from gsky_tpu.ops import kernel_ledger
+    from gsky_tpu.ops import pallas_tpu as pt
+    if pt.use_pallas():
+        interp = pt.pallas_interpret()
+
+        def ab(pallas_fn, xla_fn, n=10):
+            try:
+                ps, pp = timeit(pallas_fn, n=n)
+            except Exception as e:    # noqa: BLE001 - A/B must not
+                return {"pallas_error":     # kill the whole bench run
+                        f"{type(e).__name__}: {e}"[:200]}
+            xs, xp = timeit(xla_fn, n=n)
+            return {"pallas_sync_ms": ps, "pallas_pipelined_ms": pp,
+                    "xla_sync_ms": xs, "xla_pipelined_ms": xp,
+                    "speedup_pipelined":
+                        round(xp / pp, 2) if pp else None,
+                    "interpret": interp}
+
+        def render_pallas():
+            return pt.render_scenes_pallas(stack, ctrl, params, sp,
+                                           "near", 1, (h, w), 16, True,
+                                           0, interpret=interp)
+
+        out["warp_render_ab_cfg3"] = ab(render_pallas, render)
+
+        if "render_mosaic_256_win" in out:
+            def render_pallas_win():
+                return pt.render_scenes_pallas(stack, ctrl, params, sp,
+                                               "near", 1, (h, w), 16,
+                                               True, 0, win=winb,
+                                               win0=win0_dev,
+                                               interpret=interp)
+
+            out["warp_render_ab_cfg3_win"] = ab(render_pallas_win,
+                                                render_win)
+
+        sdata = jnp.asarray(
+            rng.uniform(0, 1, (1024, 16384)).astype(np.float32))
+        svalid = jnp.asarray(rng.uniform(0, 1, (1024, 16384)) < 0.6)
+
+        def stats_pallas():
+            s, c = pt.masked_stats_pallas(sdata, svalid,
+                                          interpret=interp)
+            return s + c
+
+        def stats_xla():
+            v, c = D.masked_mean(sdata, svalid)
+            return v + c
+
+        out["drill_stats_ab_cfg5"] = ab(stats_pallas, stats_xla)
+    else:
+        out["pallas_xla_ab"] = {
+            "skipped": "pallas disabled (GSKY_PALLAS=0 / no TPU "
+                       "backend; set GSKY_PALLAS=interpret to force)"}
+    try:
+        out["kernel_ledger"] = kernel_ledger.stats()
+    except Exception:
+        pass
+
     plat = jax.devices()[0].platform
     out["platform"] = plat
     if plat != "cpu":

@@ -9,6 +9,7 @@ the (C-accelerated) codec.
 from __future__ import annotations
 
 import io
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -16,11 +17,41 @@ from PIL import Image
 
 NODATA_BYTE = 255
 
+# zlib level 1 default: on satellite composites levels 6-9 buy ~10%
+# smaller tiles for >2x the encode time, and the encode sits on the
+# per-tile critical path.  Operators serving over thin links can trade
+# CPU for bytes via GSKY_PNG_LEVEL or per-layer `png_compress_level`.
+_LEVEL_ENV = "GSKY_PNG_LEVEL"
+_DEFAULT_LEVEL = 1
+
+
+def _resolve_level(level: Optional[int]) -> int:
+    """Effective zlib level: explicit per-call (layer config) beats the
+    GSKY_PNG_LEVEL env beats the level-1 default; anything outside 0-9
+    is a configuration error, not a clamp."""
+    if level is None:
+        env = os.environ.get(_LEVEL_ENV)
+        if env is None or env == "":
+            return _DEFAULT_LEVEL
+        try:
+            level = int(env)
+        except ValueError:
+            raise ValueError(
+                f"{_LEVEL_ENV} must be an integer 0-9, got {env!r}")
+    level = int(level)
+    if not 0 <= level <= 9:
+        raise ValueError(
+            f"PNG compress level must be 0-9, got {level}")
+    return level
+
 
 def encode_png(bands: Sequence[np.ndarray],
-               palette: Optional[np.ndarray] = None) -> bytes:
+               palette: Optional[np.ndarray] = None,
+               compress_level: Optional[int] = None) -> bytes:
     """bands: list of (H, W) uint8 arrays (1, 3 or 4 of them);
-    palette: (256, 4) uint8 RGBA LUT for the 1-band case."""
+    palette: (256, 4) uint8 RGBA LUT for the 1-band case;
+    compress_level: zlib 0-9 (None -> GSKY_PNG_LEVEL -> 1)."""
+    level = _resolve_level(compress_level)
     if len(bands) == 1:
         img = Image.fromarray(bands[0], "P")
         if palette is None:
@@ -36,7 +67,7 @@ def encode_png(bands: Sequence[np.ndarray],
         img.info["transparency"] = bytes(lut[:, 3].tolist())
         buf = io.BytesIO()
         img.save(buf, "PNG", transparency=bytes(lut[:, 3].tolist()),
-                 compress_level=1)
+                 compress_level=level)
         return buf.getvalue()
     if len(bands) == 3:
         h, w = bands[0].shape
@@ -48,27 +79,25 @@ def encode_png(bands: Sequence[np.ndarray],
         rgba[..., 3] = np.where(nodata, 0, 255)
         img = Image.fromarray(rgba, "RGBA")
         buf = io.BytesIO()
-        # zlib level 1: on satellite composites levels 6-9 buy ~10%
-        # smaller tiles for >2x the encode time, and the encode sits on
-        # the per-tile critical path
-        img.save(buf, "PNG", compress_level=1)
+        img.save(buf, "PNG", compress_level=level)
         return buf.getvalue()
     if len(bands) == 4:
         h, w = bands[0].shape
         rgba = np.stack(bands, axis=-1)
         img = Image.fromarray(rgba, "RGBA")
         buf = io.BytesIO()
-        img.save(buf, "PNG", compress_level=1)
+        img.save(buf, "PNG", compress_level=level)
         return buf.getvalue()
     raise ValueError(f"cannot encode {len(bands)} bands as PNG")
 
 
-def encode_rgba_png(rgba: np.ndarray) -> bytes:
+def encode_rgba_png(rgba: np.ndarray,
+                    compress_level: Optional[int] = None) -> bytes:
     """(H, W, 4) uint8 -> PNG bytes (the device palette / packed-RGB
     path output — already interleaved, no host assembly pass)."""
     buf = io.BytesIO()
     Image.fromarray(np.asarray(rgba, np.uint8), "RGBA").save(
-        buf, "PNG", compress_level=1)
+        buf, "PNG", compress_level=_resolve_level(compress_level))
     return buf.getvalue()
 
 
@@ -93,7 +122,8 @@ def decode_png(data: bytes) -> np.ndarray:
 
 
 def empty_tile_png(width: int, height: int,
-                   tile_image: Optional[bytes] = None) -> bytes:
+                   tile_image: Optional[bytes] = None,
+                   compress_level: Optional[int] = None) -> bytes:
     """Transparent (or tiled-image) PNG of the requested size — the
     zoom-limit / error tile of `utils/empty_tile.go:14-53`."""
     canvas = Image.new("RGBA", (width, height), (0, 0, 0, 0))
@@ -103,5 +133,5 @@ def empty_tile_png(width: int, height: int,
             for y in range(0, height, tile.height):
                 canvas.paste(tile, (x, y))
     buf = io.BytesIO()
-    canvas.save(buf, "PNG")
+    canvas.save(buf, "PNG", compress_level=_resolve_level(compress_level))
     return buf.getvalue()

@@ -60,10 +60,23 @@ def tpu_like_backend() -> bool:
         return False
 
 
+def pallas_interpret() -> bool:
+    """True when GSKY_PALLAS=interpret: run every pallas kernel in
+    interpreter mode on whatever backend is present.  The CI/parity
+    mode — CPU tier-1 drives the REAL dispatch paths (executor, drill)
+    through the pallas kernels and checks answers, without a TPU."""
+    return os.environ.get("GSKY_PALLAS", "1").lower() == "interpret"
+
+
 def use_pallas() -> bool:
-    """True when the pallas kernels should run for real (TPU backend and
-    not disabled via GSKY_PALLAS=0)."""
-    if os.environ.get("GSKY_PALLAS", "1") == "0" or not _HAVE_PLTPU:
+    """True when the pallas kernels should run (real TPU backend, or
+    forced interpreter mode) and not disabled via GSKY_PALLAS=0."""
+    v = os.environ.get("GSKY_PALLAS", "1")
+    if v == "0":
+        return False
+    if pallas_interpret():
+        return True
+    if not _HAVE_PLTPU:
         return False
     return tpu_like_backend()
 
@@ -116,6 +129,50 @@ def _timed_best(thunk, n=2):
     return r, best
 
 
+def _ledger_record(name, token, verdict, tp_ms=None, tx_ms=None):
+    """Durable verdict append — guarded: the ledger is an optimisation
+    and must never fail a dispatch."""
+    try:
+        from . import kernel_ledger
+        kernel_ledger.record(name, token, verdict, tp_ms, tx_ms)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def reload_ledger() -> int:
+    """Replay the persistent race ledger (`ops.kernel_ledger`) into the
+    in-process race state, last-verdict-wins: ``demoted`` pre-populates
+    `_SLOW` (the kernel is never re-raced at that token), ``promoted``
+    pre-populates `_PROVEN` with count 0 (the first dispatch still
+    materialises once, but skips the race), ``failed`` blacklists the
+    kernel name.  Returns the number of records applied.  Deleting the
+    ledger file and calling this (or restarting) re-races everything."""
+    applied = 0
+    try:
+        from . import kernel_ledger
+        for (name, tok), rec in kernel_ledger.entries().items():
+            verdict = rec.get("verdict")
+            if verdict == "failed":
+                _FAILED.add(name)
+                applied += 1
+                continue
+            token = kernel_ledger.decode_token(tok)
+            if token is None:
+                continue
+            if verdict == "demoted":
+                while len(_SLOW) >= 4096:
+                    _SLOW.pop()
+                _SLOW.add((name, token))
+                applied += 1
+            elif verdict == "promoted":
+                if (name, token) not in _PROVEN:
+                    _proven_put(name, token, 0)
+                applied += 1
+    except Exception:  # noqa: BLE001 - a bad ledger must never wedge
+        pass           # import (delete-file recovers)
+    return applied
+
+
 def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
     """Run `pallas_thunk()` when the Pallas path is enabled and healthy,
     else `xla_thunk()`.  Any Pallas failure (VMEM OOM, Mosaic lowering
@@ -134,9 +191,30 @@ def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
     (second-invocation timings, so compilation doesn't bias it) and
     demotes the pallas kernel at that (name, token) when it loses by
     more than ``_RACE_MARGIN`` — correctness-equivalent paths should
-    compete on speed, not default on provenance."""
+    compete on speed, not default on provenance.
+
+    Race verdicts are durable: demotions/promotions append to the
+    kernel ledger (`ops.kernel_ledger`, loaded at import), so a fresh
+    worker process inherits every decided race instead of re-paying it
+    (the r5 1.45 s warm-drill outlier was a per-process re-race).
+    ``GSKY_PALLAS=interpret`` bypasses the race entirely — interpreter
+    timings are meaningless and must not poison the ledger."""
     if name in _FAILED or not use_pallas():
         return xla_thunk()
+    if pallas_interpret():
+        # parity mode: always run the pallas kernel, materialised so a
+        # kernel bug surfaces here (and falls back) instead of
+        # downstream; no race, no ledger writes
+        try:
+            return jax.block_until_ready(pallas_thunk())
+        except Exception as e:  # noqa: BLE001
+            _FAILED.add(name)
+            import warnings
+            warnings.warn(
+                f"pallas kernel {name!r} failed (interpret); using XLA "
+                f"fallback: {type(e).__name__}: {str(e)[:300]}",
+                stacklevel=2)
+            return xla_thunk()
     if sync_token is not None and (name, sync_token) in _SLOW:
         return xla_thunk()
     try:
@@ -165,12 +243,16 @@ def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
                 while len(_SLOW) >= 4096:
                     _SLOW.pop()
                 _SLOW.add((name, sync_token))
+                _ledger_record(name, sync_token, "demoted",
+                               tp * 1e3, tx * 1e3)
                 import warnings
                 warnings.warn(
                     f"pallas kernel {name!r} measured {tp * 1e3:.1f} ms"
                     f" vs XLA {tx * 1e3:.1f} ms at {sync_token}; using"
                     " XLA for this shape", stacklevel=2)
                 return rx
+            _ledger_record(name, sync_token, "promoted",
+                           tp * 1e3, tx * 1e3)
             return r
         r = pallas_thunk()
         if sync_token is not None:
@@ -181,6 +263,7 @@ def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
         return r
     except Exception as e:  # noqa: BLE001 - any compile/runtime failure
         _FAILED.add(name)
+        _ledger_record(name, sync_token, "failed")
         import warnings
         warnings.warn(
             f"pallas kernel {name!r} failed; using XLA fallback: "
@@ -301,3 +384,318 @@ def masked_stats_pallas(data, valid, clip_lower=-3.0e38, clip_upper=3.0e38,
         interpret=interpret,
     )(data, valid8, clip)
     return jnp.sum(psum, axis=-1)[:B], jnp.sum(pcnt, axis=-1)[:B]
+
+
+# ---------------------------------------------------------------------------
+# fused warp-render: windowed gather + interpolate + mosaic, one kernel
+# ---------------------------------------------------------------------------
+
+# output tile block (f32 min tile is (8, 128); 128x128 balances VMEM
+# against grid overhead for 256-px tiles)
+_WARP_BLK = 128
+# VMEM ceiling for one grid step's working set: the windowed granule
+# block (double-buffered by the pipeline) + the per-namespace
+# accumulators + the coordinate blocks must stay well inside the
+# ~16 MiB per-core VMEM
+_WARP_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _warp_vmem_bytes(wr: int, wc: int, n_ns: int) -> int:
+    wrp = -(-wr // 8) * 8
+    wcp = -(-wc // 128) * 128
+    src = wrp * wcp * 4 * 2                 # (1, WRp, WCp) f32, x2 DMA
+    acc = n_ns * _WARP_BLK * _WARP_BLK * 4 * 2 * 2  # canv+best, x2
+    grids = _WARP_BLK * _WARP_BLK * 4 * 2 * 2       # sx+sy, x2
+    return src + acc + grids
+
+
+def warp_pallas_ok(wr: int, wc: int, n_ns: int) -> bool:
+    """Eligibility gate for the fused warp kernel, checked BEFORE
+    `run_with_fallback`: an over-budget gather window must go straight
+    to XLA rather than burn the name-level blacklist on a predictable
+    VMEM OOM (which would disable the kernel for every shape)."""
+    if not use_pallas():
+        return False
+    return _warp_vmem_bytes(int(wr), int(wc), int(n_ns)) \
+        <= _WARP_VMEM_BUDGET
+
+
+def _warp_render_kernel(method: str, n_ns: int, WR: int, WC: int,
+                        WRp: int, WCp: int):
+    """Kernel-body closure over the static config.  Grid (by, bx, t)
+    with the granule axis t INNERMOST: the stack BlockSpec indexes by t,
+    so the pallas pipeline DMAs granule t+1's gather window HBM->VMEM
+    while granule t computes — double-buffered overlapped-tile staging
+    (the model-based warp-tiling discipline), with the per-namespace
+    canvas/priority accumulators VMEM-resident across the whole t sweep
+    (initialised at t == 0, the `_stats_kernel` pattern).
+
+    Per granule the body mirrors `ops.warp._warp_scenes_scored` op for
+    op: full-frame affine coords -> true-extent oob NaN-poisoning ->
+    window rebase -> taps with tap-side validity (finite and != nodata)
+    -> running strictly-greater priority mosaic (identical winners to
+    XLA's argmax because priorities are strictly unique by contract)."""
+
+    def kernel(params_ref, sx_ref, sy_ref, stack_ref, canv_ref, best_ref):
+        t = pl.program_id(2)
+
+        @pl.when(t == 0)
+        def _init():
+            canv_ref[:] = jnp.zeros(canv_ref.shape, canv_ref.dtype)
+            best_ref[:] = jnp.full(best_ref.shape, -jnp.inf,
+                                   best_ref.dtype)
+
+        def p(k):
+            return params_ref[t, k]
+
+        sx = sx_ref[:]
+        sy = sy_ref[:]
+        cols = (p(0) + p(1) * sx + p(2) * sy) - 0.5
+        rows = (p(3) + p(4) * sx + p(5) * sy) - 0.5
+        oob = (rows < -0.5) | (rows > p(6) - 0.5) \
+            | (cols < -0.5) | (cols > p(7) - 0.5)
+        rows = jnp.where(oob, jnp.nan, rows)
+        rows = rows - p(11)     # window-origin rebase (exact: int <=
+        cols = cols - p(12)     # 4096 off an f32 coord < 2^12)
+        flat = stack_ref[0].reshape(WRp * WCp)
+        nd = p(8)
+
+        def tap(ri, ci, inb):
+            # flat index with the PADDED row stride addresses the same
+            # element as the unpadded (WR, WC) window for every clipped
+            # index, so values match `_gather2d` bit for bit
+            v = flat[ri * WCp + ci]
+            ok = inb & jnp.isfinite(v) & (v != nd)
+            return jnp.where(ok, v, 0.0), ok
+
+        if method in ("near", "nearest"):
+            ri = jnp.floor(rows + (0.5 + 1e-10)).astype(jnp.int32)
+            ci = jnp.floor(cols + (0.5 + 1e-10)).astype(jnp.int32)
+            inb = (ri >= 0) & (ri < WR) & (ci >= 0) & (ci < WC) \
+                & jnp.isfinite(rows) & jnp.isfinite(cols)
+            val, ok = tap(jnp.clip(ri, 0, WR - 1),
+                          jnp.clip(ci, 0, WC - 1), inb)
+        else:
+            finite = jnp.isfinite(rows) & jnp.isfinite(cols)
+            rows = jnp.where(finite, rows, -10.0)
+            cols = jnp.where(finite, cols, -10.0)
+            r0 = jnp.floor(rows)
+            c0 = jnp.floor(cols)
+            fr = rows - r0
+            fc = cols - c0
+            r0 = r0.astype(jnp.int32)
+            c0 = c0.astype(jnp.int32)
+            if method == "bilinear":
+                taps = [(dr, dc,
+                         (fr if dr else 1 - fr) * (fc if dc else 1 - fc))
+                        for dr in (0, 1) for dc in (0, 1)]
+                thresh = 1e-6
+            else:               # cubic (Catmull-Rom)
+                from .warp import _cubic_weights
+                wr_ = _cubic_weights(fr)
+                wc_ = _cubic_weights(fc)
+                taps = [(dr - 1, dc - 1, wr_[dr] * wc_[dc])
+                        for dr in range(4) for dc in range(4)]
+                thresh = 0.05
+            acc = jnp.zeros(rows.shape, jnp.float32)
+            wacc = jnp.zeros(rows.shape, jnp.float32)
+            for dr, dc, wt in taps:
+                ri = r0 + dr
+                ci = c0 + dc
+                inb = (ri >= 0) & (ri < WR) & (ci >= 0) & (ci < WC)
+                v, okt = tap(jnp.clip(ri, 0, WR - 1),
+                             jnp.clip(ci, 0, WC - 1), inb)
+                okf = okt.astype(jnp.float32)
+                acc = acc + wt * okf * v
+                wacc = wacc + wt * okf
+            ok = finite & (wacc > thresh)
+            val = acc / jnp.where(wacc > thresh, wacc, 1.0)
+
+        prio = p(9)
+        ns = p(10)
+        for n in range(n_ns):   # static unroll (n_ns is pow2-bounded)
+            member = ns == jnp.float32(n)
+            s_n = jnp.where(member & ok, prio, -jnp.inf)
+            b = best_ref[n, :, :]
+            take = s_n > b      # strict: first-seen wins ties, matching
+            canv_ref[n, :, :] = jnp.where(take, val,    # argmax order
+                                          canv_ref[n, :, :])
+            best_ref[n, :, :] = jnp.where(take, s_n, b)
+
+    return kernel
+
+
+def _warp_scored_pallas(stack, ctrl, params, method, n_ns, out_hw, step,
+                        win, win0, interpret):
+    """Shared core: XLA prologue (ctrl-grid upsample, window slice,
+    f32 + lane-alignment padding) feeding one fused pallas_call.
+    Returns (canv (n_ns, h, w) f32, best (n_ns, h, w) f32, -inf =
+    invalid) — the `warp_scenes_ctrl_scored` contract."""
+    from .warp import _bilerp_grid, _window_slice
+    h, w = out_hw
+    sx = _bilerp_grid(ctrl[0], h, w, step)
+    sy = _bilerp_grid(ctrl[1], h, w, step)
+    if win is not None:
+        stack, r0f, c0f = _window_slice(stack, win, win0, axis=1)
+        WR, WC = int(win[0]), int(win[1])
+    else:
+        WR, WC = int(stack.shape[1]), int(stack.shape[2])
+        r0f = c0f = jnp.float32(0.0)
+    B = int(stack.shape[0])
+    WRp = -(-WR // 8) * 8
+    WCp = -(-WC // 128) * 128
+    stackf = stack.astype(jnp.float32)
+    if (WRp, WCp) != (WR, WC):
+        stackf = jnp.pad(stackf, ((0, 0), (0, WRp - WR), (0, WCp - WC)))
+    Hp = -(-h // _WARP_BLK) * _WARP_BLK
+    Wp = -(-w // _WARP_BLK) * _WARP_BLK
+    if (Hp, Wp) != (h, w):
+        sx = jnp.pad(sx, ((0, Hp - h), (0, Wp - w)))
+        sy = jnp.pad(sy, ((0, Hp - h), (0, Wp - w)))
+    # params slots 11/12 carry the window origins so the kernel's only
+    # traced per-granule state is one SMEM row
+    pp = jnp.zeros((B, 16), jnp.float32)
+    pp = pp.at[:, :11].set(params[:, :11].astype(jnp.float32))
+    pp = pp.at[:, 11].set(r0f)
+    pp = pp.at[:, 12].set(c0f)
+    kernel = _warp_render_kernel(method, n_ns, WR, WC, WRp, WCp)
+    if _HAVE_PLTPU and not interpret:
+        params_spec = pl.BlockSpec(
+            memory_space=getattr(pltpu, "SMEM", None))
+    else:
+        params_spec = pl.BlockSpec((B, 16), lambda i, j, t: (0, 0))
+    canv, best = pl.pallas_call(
+        kernel,
+        grid=(Hp // _WARP_BLK, Wp // _WARP_BLK, B),
+        in_specs=[
+            params_spec,
+            pl.BlockSpec((_WARP_BLK, _WARP_BLK), lambda i, j, t: (i, j)),
+            pl.BlockSpec((_WARP_BLK, _WARP_BLK), lambda i, j, t: (i, j)),
+            pl.BlockSpec((1, WRp, WCp), lambda i, j, t: (t, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((n_ns, _WARP_BLK, _WARP_BLK),
+                         lambda i, j, t: (0, i, j)),
+            pl.BlockSpec((n_ns, _WARP_BLK, _WARP_BLK),
+                         lambda i, j, t: (0, i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_ns, Hp, Wp), jnp.float32),
+            jax.ShapeDtypeStruct((n_ns, Hp, Wp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(pp, sx, sy, stackf)
+    return canv[:, :h, :w], best[:, :h, :w]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "win", "interpret"))
+def warp_scenes_scored_pallas(stack, ctrl, params, method: str = "near",
+                              n_ns: int = 1, out_hw=(256, 256),
+                              step: int = 16, win=None, win0=None,
+                              interpret: bool = False):
+    """Pallas counterpart of `ops.warp.warp_scenes_ctrl_scored`: the
+    fused warp-gather replacing XLA's gather lowering on the mosaic hot
+    path.  Same signature contract (stack (B, sh, sw) native, ctrl
+    (2, gh, gw) f32, params (B, 11) f32, optional static win + traced
+    win0) and same outputs (canvases, best-priority, -inf = invalid);
+    parity is tested bit-exact for nearest and <= 2 ulp for
+    interpolated methods (tests/test_warp_pallas.py)."""
+    return _warp_scored_pallas(stack, ctrl, params, method, n_ns,
+                               tuple(out_hw), step, win, win0, interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "auto", "colour_scale", "win",
+                                    "interpret"))
+def render_scenes_pallas(stack, ctrl, params, scale_params,
+                         method: str = "near", n_ns: int = 1,
+                         out_hw=(256, 256), step: int = 16,
+                         auto: bool = True, colour_scale: int = 0,
+                         win=None, win0=None, interpret: bool = False):
+    """Pallas counterpart of `ops.warp.render_scenes_ctrl`: fused warp +
+    mosaic in the kernel, then the SAME composite/byte-scale epilogue
+    the XLA render uses (`ops.warp.composite_scale` on the 64 KB
+    canvases — cross-block min/max doesn't fit a one-pass grid, and at
+    canvas size the epilogue is noise).  Returns the PNG-ready uint8
+    (h, w) tile."""
+    from .warp import composite_scale
+    canv, best = _warp_scored_pallas(stack, ctrl, params, method, n_ns,
+                                     tuple(out_hw), step, win, win0,
+                                     interpret)
+    return composite_scale(canv, best > -jnp.inf, scale_params, auto,
+                           colour_scale)
+
+
+def _warp_token(stack, win, out_hw, method, n_ns, step):
+    """Bucketed race token: stacks arrive bucket-padded and windows
+    bucket-sized, so the token set — and with it the race count and the
+    ledger cardinality — is bounded.  Plain ints/strs/tuples only (the
+    ledger round-trips tokens through repr/literal_eval)."""
+    return (tuple(int(d) for d in stack.shape), str(stack.dtype),
+            None if win is None else (int(win[0]), int(win[1])),
+            (int(out_hw[0]), int(out_hw[1])), str(method), int(n_ns),
+            int(step))
+
+
+def warp_scored_raced(stack, ctrl_dev, params_dev, method, n_ns, out_hw,
+                      step, win=None, win0_dev=None):
+    """(canvases, best) — the fused pallas warp raced (via
+    `run_with_fallback` + the durable ledger) against
+    `ops.warp.warp_scenes_ctrl_scored`.  The executor's scene and
+    decoded-window mosaic paths dispatch here."""
+    from .warp import warp_scenes_ctrl_scored
+
+    def _xla():
+        return warp_scenes_ctrl_scored(stack, ctrl_dev, params_dev,
+                                       method, n_ns, out_hw, step,
+                                       win=win, win0=win0_dev)
+
+    wr, wc = win if win is not None else stack.shape[1:3]
+    if not warp_pallas_ok(wr, wc, n_ns):
+        return _xla()
+
+    def _pallas():
+        return warp_scenes_scored_pallas(
+            stack, ctrl_dev, params_dev, method, n_ns, out_hw, step,
+            win=win, win0=win0_dev, interpret=pallas_interpret())
+
+    return run_with_fallback(
+        "warp_scored", _pallas, _xla,
+        sync_token=_warp_token(stack, win, out_hw, method, n_ns, step))
+
+
+def render_byte_raced(stack, ctrl_dev, params_dev, sp_dev, method, n_ns,
+                      out_hw, step, auto, colour_scale, win=None,
+                      win0_dev=None):
+    """uint8 tile — the fully fused pallas warp+mosaic+scale raced
+    against `ops.warp.render_scenes_ctrl` (the GetMap hot path)."""
+    from .warp import render_scenes_ctrl
+
+    def _xla():
+        return render_scenes_ctrl(stack, ctrl_dev, params_dev, sp_dev,
+                                  method, n_ns, out_hw, step, auto,
+                                  colour_scale, win=win, win0=win0_dev)
+
+    wr, wc = win if win is not None else stack.shape[1:3]
+    if not warp_pallas_ok(wr, wc, n_ns):
+        return _xla()
+
+    def _pallas():
+        return render_scenes_pallas(stack, ctrl_dev, params_dev, sp_dev,
+                                    method, n_ns, out_hw, step, auto,
+                                    colour_scale, win=win, win0=win0_dev,
+                                    interpret=pallas_interpret())
+
+    token = _warp_token(stack, win, out_hw, method, n_ns, step) \
+        + (bool(auto), int(colour_scale))
+    return run_with_fallback("warp_render", _pallas, _xla,
+                             sync_token=token)
+
+
+# durable race verdicts from previous processes apply from the first
+# dispatch of this one (delete the ledger file to re-race everything;
+# see ops/kernel_ledger.py for path resolution and format)
+reload_ledger()

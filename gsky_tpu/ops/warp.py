@@ -289,16 +289,14 @@ def warp_scenes_ctrl(stack, ctrl, params, method: str = "near",
                              win=win, win0=win0)
 
 
-def _render_scenes_core(stack, ctrl, params, scale_params, method: str,
-                        n_ns: int, out_hw: Tuple[int, int], step: int,
-                        auto: bool, colour_scale: int, win=None,
-                        win0=None):
+def composite_scale(canv, vals, scale_params, auto: bool,
+                    colour_scale: int):
+    """Shared render epilogue: first-valid composite across namespace
+    canvases + byte scaling.  canv (n_ns, h, w) f32, vals (n_ns, h, w)
+    bool -> uint8 (h, w), 255 = nodata.  Factored out so the fused
+    pallas warp kernel (`ops.pallas_tpu.render_scenes_pallas`) reuses
+    the exact op sequence — render parity is composite parity."""
     from .scale import auto_byte_scale, scale_to_byte
-    h, w = out_hw
-    sx = _bilerp_grid(ctrl[0], h, w, step)
-    sy = _bilerp_grid(ctrl[1], h, w, step)
-    canv, vals = _warp_scenes_core(stack, sx, sy, params, method, n_ns,
-                                   win=win, win0=win0)
     idx = jnp.argmax(vals, axis=0)
     data = jnp.take_along_axis(canv, idx[None], axis=0)[0]
     ok = jnp.any(vals, axis=0)
@@ -315,6 +313,18 @@ def _render_scenes_core(stack, ctrl, params, scale_params, method: str,
     return scale_to_byte(data, ok, scale_params[0], scale_params[1],
                          scale_params[2], colour_scale=colour_scale,
                          auto=False)
+
+
+def _render_scenes_core(stack, ctrl, params, scale_params, method: str,
+                        n_ns: int, out_hw: Tuple[int, int], step: int,
+                        auto: bool, colour_scale: int, win=None,
+                        win0=None):
+    h, w = out_hw
+    sx = _bilerp_grid(ctrl[0], h, w, step)
+    sy = _bilerp_grid(ctrl[1], h, w, step)
+    canv, vals = _warp_scenes_core(stack, sx, sy, params, method, n_ns,
+                                   win=win, win0=win0)
+    return composite_scale(canv, vals, scale_params, auto, colour_scale)
 
 
 @functools.partial(jax.jit,

@@ -563,13 +563,15 @@ def _stats_tail(dataf, validf, req: GeoDrillRequest):
                                  req.clip_upper, req.pixel_count)
         return (np.asarray(v), np.asarray(c),
                 np.zeros((dataf.shape[0], 0), np.float32))
-    from ..ops.pallas_tpu import masked_stats_pallas, run_with_fallback
+    from ..ops.pallas_tpu import (masked_stats_pallas, pallas_interpret,
+                                  run_with_fallback)
 
     def _via_pallas():
         # VMEM-streamed reduction kernel on TPU backends
         s, c = masked_stats_pallas(
             jnp.asarray(dataf), jnp.asarray(validf),
-            req.clip_lower, req.clip_upper)
+            req.clip_lower, req.clip_upper,
+            interpret=pallas_interpret())
         c = np.asarray(c)
         v = np.where(c > 0, np.asarray(s) / np.maximum(c, 1),
                      0.0).astype(np.float32)
@@ -590,9 +592,11 @@ def _stats_tail(dataf, validf, req: GeoDrillRequest):
         # faster.  The shape is BUCKETED (`_drill_device` pads the band
         # axis to pow2 and the window to shape buckets), so the token
         # cardinality — and with it the number of races — is bounded
+        # plain-int token: the durable ledger round-trips tokens through
+        # repr/literal_eval, so numpy ints must not leak in
         vals, counts = run_with_fallback(
             "masked_stats", _via_pallas, _via_xla,
-            sync_token=tuple(dataf.shape))
+            sync_token=tuple(int(d) for d in dataf.shape))
     else:
         vals, counts = _via_xla()
     if req.deciles:

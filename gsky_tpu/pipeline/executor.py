@@ -22,9 +22,9 @@ import numpy as np
 
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
+from ..ops.pallas_tpu import render_byte_raced, warp_scored_raced
 from ..ops.warp import (combine_scored, render_scenes_bands_ctrl,
-                        render_scenes_ctrl, warp_gather_batch,
-                        warp_scenes_ctrl, warp_scenes_ctrl_scored)
+                        warp_gather_batch)
 from ..parallel.spmd import default_spmd
 from .decode import DecodedWindow
 
@@ -462,7 +462,7 @@ class WarpExecutor:
                 params[k, 8] = np.nan   # validity is NaN-encoded in src
                 params[k, 9] = prios[i]
                 params[k, 10] = ns_ids[i]
-            parts.append(warp_scenes_ctrl_scored(
+            parts.append(warp_scored_raced(
                 jnp.asarray(src), jnp.asarray(ctrl),
                 jnp.asarray(params.astype(np.float32)), method, n_pad,
                 (height, width), step))
@@ -507,10 +507,12 @@ class WarpExecutor:
                 return canv, best > -jnp.inf
             self._count("scene_mosaic", (stack.shape, win))
             self._note_win(win)
-            return warp_scenes_ctrl(stack, ctrl_dev,
-                                    jnp.asarray(params), method,
-                                    n_pad, (height, width), step,
-                                    win=win, win0=_dev_win0(win0))
+            canv, best = warp_scored_raced(stack, ctrl_dev,
+                                           jnp.asarray(params), method,
+                                           n_pad, (height, width), step,
+                                           win=win,
+                                           win0_dev=_dev_win0(win0))
+            return canv, best > -jnp.inf
         # multi-CRS granule set (e.g. scenes across UTM zones): one
         # scored dispatch per source-CRS group, then a per-pixel
         # priority combine — newest-wins survives the grouping because
@@ -518,10 +520,10 @@ class WarpExecutor:
         self._count("scene_mosaic_multicrs", len(groups))
         for g in groups:
             self._note_win(g[6])
-        parts = [warp_scenes_ctrl_scored(
+        parts = [warp_scored_raced(
                     stack, ctrl_dev, jnp.asarray(params),
                     method, n_pad, (height, width), step,
-                    win=win, win0=_dev_win0(win0))
+                    win=win, win0_dev=_dev_win0(win0))
                  for stack, _, params, step, _, ctrl_dev, win,
                  win0, _ in groups]
         canvs = jnp.stack([p[0] for p in parts])
@@ -567,9 +569,9 @@ class WarpExecutor:
                                         statics, win_raw=win_raw)
         self._count("render_byte", (stack.shape, win))
         self._note_win(win)
-        out = render_scenes_ctrl(stack, ctrl_dev,
-                                 jnp.asarray(params), jnp.asarray(sp),
-                                 *statics, win=win, win0=_dev_win0(win0))
+        out = render_byte_raced(stack, ctrl_dev, jnp.asarray(params),
+                                jnp.asarray(sp), *statics, win=win,
+                                win0_dev=_dev_win0(win0))
         return _prefetch(out)
 
     def render_bands_byte(self, granules, ns_ids: Sequence[int],

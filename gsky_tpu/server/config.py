@@ -137,6 +137,9 @@ class Layer:
     wms_timeout: int = DEFAULT_WMS_TIMEOUT
     wcs_timeout: int = DEFAULT_WCS_TIMEOUT
     cache_max_age: int = DEFAULT_CACHE_MAX_AGE
+    # PNG zlib level 0-9; -1 = unset (fall through to GSKY_PNG_LEVEL,
+    # then the io.png level-1 default)
+    png_compress_level: int = -1
     wms_max_width: int = DEFAULT_WMS_MAX_WIDTH
     wms_max_height: int = DEFAULT_WMS_MAX_HEIGHT
     wcs_max_width: int = DEFAULT_WCS_MAX_WIDTH
@@ -248,6 +251,9 @@ class Layer:
             # survive, and `0 or default` would swallow it
             cache_max_age=_int_or(j.get("cache_max_age"),
                                   DEFAULT_CACHE_MAX_AGE),
+            # _int_or, not `i`: an explicit 0 (store-only PNG) must
+            # survive
+            png_compress_level=_int_or(j.get("png_compress_level"), -1),
             wms_max_width=i("wms_max_width", DEFAULT_WMS_MAX_WIDTH),
             wms_max_height=i("wms_max_height", DEFAULT_WMS_MAX_HEIGHT),
             wcs_max_width=i("wcs_max_width", DEFAULT_WCS_MAX_WIDTH),
@@ -278,6 +284,11 @@ class Layer:
             disable_services=list(j.get("disable_services", []) or []),
             timestamps_load_strategy=j.get("timestamps_load_strategy", ""),
         )
+        if not (lay.png_compress_level == -1
+                or 0 <= lay.png_compress_level <= 9):
+            raise ValueError(
+                f"layer {lay.name!r}: png_compress_level must be 0-9, "
+                f"got {lay.png_compress_level}")
         return lay
 
 

@@ -73,6 +73,14 @@ def main(argv=None):
               f"{plat['probe_attempts']} probe(s); serving on CPU",
               file=sys.stderr)
 
+    if args.log_dir:
+        # durable kernel race verdicts live next to the metrics log
+        # (GSKY_KERNEL_LEDGER still overrides); replay them so this
+        # process skips every already-decided pallas-vs-XLA race
+        from ..ops import kernel_ledger, pallas_tpu
+        kernel_ledger.set_default_dir(args.log_dir)
+        pallas_tpu.reload_ledger()
+
     metrics = MetricsLogger(args.log_dir, verbose=args.verbose)
     server = OWSServer(watcher, mas_factory, metrics,
                        static_dir=args.static, temp_dir=args.temp_dir)

@@ -233,6 +233,11 @@ class MetricsLogger:
             out["resilience"] = _resilience.stats()
         except Exception:   # observability must never fail a request
             pass
+        try:
+            from ..ops import kernel_ledger
+            out["kernels"] = kernel_ledger.stats()
+        except Exception:   # observability must never fail a request
+            pass
         return out
 
     def write(self, info: Dict):

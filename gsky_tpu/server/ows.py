@@ -572,8 +572,9 @@ class OWSServer:
             if res > lay.zoom_limit:
                 use = _best_overview(lay, res)
                 if use is None:
-                    png = self._placeholder_tile(lay.nodata_legend_path,
-                                                 p.width, p.height)
+                    png = self._placeholder_tile(
+                        lay.nodata_legend_path, p.width, p.height,
+                        compress_level=_png_level(lay, style))
                     return _png(png)
                 source = use  # render the overview collection; the style
                 # keeps supplying scaling/palette below
@@ -647,7 +648,8 @@ class OWSServer:
                                                      "image/jpg"):
                         collector.info["rpc"]["duration"] = \
                             int((time.time() - t0) * 1e9)
-                        return _png(encode_rgba_png(rgba))
+                        return _png(encode_rgba_png(
+                            rgba, compress_level=_png_level(lay, style)))
             if scaled is None:
                 res = await asyncio.wait_for(
                     asyncio.to_thread(_render_with_fusion, pipe, req, lay,
@@ -662,7 +664,9 @@ class OWSServer:
                 valids = [res.valid[n] for n in res.namespaces
                           if n in res.valid]
                 if not bands:
-                    return _png(empty_tile_png(p.width, p.height))
+                    return _png(empty_tile_png(
+                        p.width, p.height,
+                        compress_level=_png_level(lay, style)))
                 scaled = []
                 for b, v in zip(bands[:4], valids[:4]):
                     sb = scale_to_byte(jnp.asarray(b), jnp.asarray(v),
@@ -681,7 +685,8 @@ class OWSServer:
             spec = style.palette or lay.palette
             palette = with_nodata_entry(
                 gradient_palette(spec.colours, spec.interpolate))
-        return _png(encode_png(scaled, palette))
+        return _png(encode_png(scaled, palette,
+                               compress_level=_png_level(lay, style)))
 
     @staticmethod
     def _render_rgb(pipe, req, style, auto: bool, stats):
@@ -738,15 +743,17 @@ class OWSServer:
         ramp = np.linspace(254, 0, h).astype(np.uint8)
         img[:] = lut[ramp][:, None, :]
         from ..io.png import encode_rgba_png
-        return _png(encode_rgba_png(img))
+        return _png(encode_rgba_png(
+            img, compress_level=_png_level(lay, style)))
 
     def _placeholder_tile(self, image_path: str, width: int,
-                          height: int) -> bytes:
+                          height: int, compress_level=None) -> bytes:
         img_bytes = None
         if image_path and os.path.exists(image_path):
             with open(image_path, "rb") as fp:
                 img_bytes = fp.read()
-        return empty_tile_png(width, height, img_bytes)
+        return empty_tile_png(width, height, img_bytes,
+                              compress_level=compress_level)
 
     # -- DAP4 (`dap.go:13-36`) ----------------------------------------------
 
@@ -1299,6 +1306,15 @@ def _xml(doc: str) -> web.Response:
 
 def _png(data: bytes) -> web.Response:
     return web.Response(body=data, content_type="image/png")
+
+
+def _png_level(lay, style=None):
+    """Effective per-layer PNG zlib level: style (when it sets one)
+    beats layer beats None (= GSKY_PNG_LEVEL / the io.png default)."""
+    for src in (style, lay):
+        if src is not None and src.png_compress_level >= 0:
+            return src.png_compress_level
+    return None
 
 
 def _exception_response(e: OWSError,

@@ -13,6 +13,18 @@ from gsky_tpu.ops.pallas_tpu import (masked_stats_pallas,
                                      mosaic_first_valid_pallas)
 
 
+@pytest.fixture(autouse=True)
+def _tmp_ledger(tmp_path, monkeypatch):
+    """Race verdicts are durable now (ops/kernel_ledger.py): point every
+    test at its own ledger file so races here never leak demotions into
+    the shared default ledger (or read stale ones from it).  Also pin
+    the dispatch mode: GSKY_PALLAS=interpret (the CI kernel-parity
+    step) bypasses the race entirely, and the race tests below need the
+    race to happen."""
+    monkeypatch.setenv("GSKY_KERNEL_LEDGER", str(tmp_path / "ledger.jsonl"))
+    monkeypatch.setenv("GSKY_PALLAS", "1")
+
+
 class TestMosaicKernel:
     def test_matches_xla_first_valid(self):
         rng = np.random.default_rng(7)

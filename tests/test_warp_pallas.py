@@ -1,0 +1,295 @@
+"""Interpret-mode parity tier for the fused Pallas warp-render kernel
+(`gsky_tpu/ops/pallas_tpu.py::warp_scenes_scored_pallas` /
+`render_scenes_pallas`) against the XLA reference (`gsky_tpu/ops/warp.py`):
+bit-exact nearest, <= 2 ulp bilinear, edge-straddling windows, all-nodata
+scenes, mosaic priority order, and executor-level dispatch parity under
+GSKY_PALLAS=interpret."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from gsky_tpu.ops import pallas_tpu as pt
+from gsky_tpu.ops.warp import render_scenes_ctrl, warp_scenes_ctrl_scored
+
+
+@pytest.fixture(autouse=True)
+def _tmp_ledger(tmp_path, monkeypatch):
+    """Hermetic ledger per test: parity runs must never read or write
+    the shared default race ledger."""
+    monkeypatch.setenv("GSKY_KERNEL_LEDGER", str(tmp_path / "ledger.jsonl"))
+
+
+def _inputs(seed=0, B=4, S=96, h=64, w=64, step=16, n_ns=2,
+            lo=-500.0, hi=3000.0, c_lo=4.0, c_hi=None):
+    """Scene stack + ctrl grid + params covering the interesting cases:
+    NaN patches, an all-nodata granule, oob-straddling affines, two
+    namespaces, strictly-unique priorities.
+
+    Interpolated-method parity tests pass lo > 0: with sign changes in
+    the data, weighted taps cancel and a 1-ulp coordinate difference
+    (XLA contracts the affine with FMA; the interpret kernel doesn't)
+    shows up as a large RELATIVE error on a near-zero mean — ulp
+    comparisons are only meaningful on sign-stable data."""
+    rng = np.random.default_rng(seed)
+    stack = rng.uniform(lo, hi, (B, S, S)).astype(np.float32)
+    stack[0, 10:20, 10:20] = np.nan          # stored-NaN invalidity
+    stack[1, :, :] = -999.0                  # all-nodata granule
+    gh = (h - 1 + step - 1) // step + 1
+    gw = (w - 1 + step - 1) // step + 1
+    # dst tile maps across part of the scene; per-granule affines shift
+    # it so some granules straddle the true extent (oob poisoning)
+    if c_hi is None:
+        c_hi = S - 12.0
+    ctrl = np.stack([
+        np.linspace(c_lo, c_hi, gw,
+                    dtype=np.float32)[None, :].repeat(gh, 0),
+        np.linspace(c_lo, c_hi, gh,
+                    dtype=np.float32)[:, None].repeat(gw, 1)])
+    params = np.zeros((B, 11), np.float32)
+    for k in range(B):
+        params[k] = [0.4 * k - 0.2, 1.01, 0.02, 0.3 * k, -0.01, 0.99,
+                     S, S, -999.0, 100.0 - k, k % n_ns]
+    return (jnp.asarray(stack), jnp.asarray(ctrl), jnp.asarray(params),
+            h, w, step, n_ns)
+
+
+class TestScoredParity:
+    @pytest.mark.parametrize("seed", [0, 3, 11])
+    def test_nearest_bit_exact(self, seed):
+        stack, ctrl, params, h, w, step, n_ns = _inputs(seed)
+        cx, bx = warp_scenes_ctrl_scored(stack, ctrl, params, "near",
+                                         n_ns, (h, w), step)
+        cp, bp = pt.warp_scenes_scored_pallas(stack, ctrl, params,
+                                              "near", n_ns, (h, w),
+                                              step, interpret=True)
+        np.testing.assert_array_equal(np.asarray(bx), np.asarray(bp))
+        np.testing.assert_array_equal(np.asarray(cx), np.asarray(cp))
+
+    def test_bilinear_2ulp(self):
+        stack, ctrl, params, h, w, step, n_ns = _inputs(
+            1, lo=1.0, hi=4000.0)
+        cx, bx = warp_scenes_ctrl_scored(stack, ctrl, params, "bilinear",
+                                         n_ns, (h, w), step)
+        cp, bp = pt.warp_scenes_scored_pallas(stack, ctrl, params,
+                                              "bilinear", n_ns, (h, w),
+                                              step, interpret=True)
+        np.testing.assert_array_equal(np.asarray(bx), np.asarray(bp))
+        np.testing.assert_array_almost_equal_nulp(
+            np.asarray(cx), np.asarray(cp), nulp=2)
+
+    def test_cubic_close(self):
+        stack, ctrl, params, h, w, step, n_ns = _inputs(2)
+        cx, bx = warp_scenes_ctrl_scored(stack, ctrl, params, "cubic",
+                                         n_ns, (h, w), step)
+        cp, bp = pt.warp_scenes_scored_pallas(stack, ctrl, params,
+                                              "cubic", n_ns, (h, w),
+                                              step, interpret=True)
+        np.testing.assert_array_equal(np.asarray(bx), np.asarray(bp))
+        np.testing.assert_allclose(np.asarray(cx), np.asarray(cp),
+                                   rtol=1e-6, atol=1e-4)
+
+    def test_nonsquare_tile_pads_clean(self):
+        """Output dims off the 128 block (h=100, w=200): the padded
+        grid blocks must not leak into the sliced result."""
+        stack, ctrl, params, h, w, step, n_ns = _inputs(
+            4, h=100, w=200)
+        cx, bx = warp_scenes_ctrl_scored(stack, ctrl, params, "near",
+                                         n_ns, (h, w), step)
+        cp, bp = pt.warp_scenes_scored_pallas(stack, ctrl, params,
+                                              "near", n_ns, (h, w),
+                                              step, interpret=True)
+        assert np.asarray(cp).shape == (n_ns, h, w)
+        np.testing.assert_array_equal(np.asarray(bx), np.asarray(bp))
+        np.testing.assert_array_equal(np.asarray(cx), np.asarray(cp))
+
+
+class TestWindowedParity:
+    def _window(self, params, ctrl, S):
+        from gsky_tpu.pipeline.executor import _gather_window
+        ctrl_np = np.asarray(ctrl, np.float64)
+        made = _gather_window(np.asarray(params, np.float64),
+                              ctrl_np[0], ctrl_np[1], S, S)
+        assert made is not None
+        win, win0, _raw = made
+        return win, jnp.asarray(win0)
+
+    def test_edge_straddling_window_bit_exact(self):
+        """Tile footprint straddles the scene edge (oob poisoning live)
+        AND gathers through a bucketed window: the windowed pallas
+        kernel must match both the windowed and the UNwindowed XLA
+        reference bit for bit (nearest)."""
+        stack, ctrl, params, h, w, step, n_ns = _inputs(
+            5, S=256, c_lo=40.0, c_hi=150.0)
+        # shift granule affines so the footprint runs off the top-left
+        params = np.asarray(params).copy()
+        params[:, 0] -= 60.0
+        params[:, 3] -= 55.0
+        params = jnp.asarray(params)
+        S = int(stack.shape[1])
+        win, win0 = self._window(params, ctrl, S)
+        cfull, bfull = warp_scenes_ctrl_scored(stack, ctrl, params,
+                                               "near", n_ns, (h, w),
+                                               step)
+        cwin, bwin = warp_scenes_ctrl_scored(stack, ctrl, params,
+                                             "near", n_ns, (h, w), step,
+                                             win=win, win0=win0)
+        cp, bp = pt.warp_scenes_scored_pallas(stack, ctrl, params,
+                                              "near", n_ns, (h, w),
+                                              step, win=win, win0=win0,
+                                              interpret=True)
+        np.testing.assert_array_equal(np.asarray(bwin), np.asarray(bp))
+        np.testing.assert_array_equal(np.asarray(cwin), np.asarray(cp))
+        np.testing.assert_array_equal(np.asarray(bfull), np.asarray(bp))
+        np.testing.assert_array_equal(np.asarray(cfull), np.asarray(cp))
+
+    def test_windowed_bilinear_2ulp(self):
+        stack, ctrl, params, h, w, step, n_ns = _inputs(
+            6, S=256, lo=1.0, hi=4000.0, c_lo=40.0, c_hi=150.0)
+        S = int(stack.shape[1])
+        win, win0 = self._window(params, ctrl, S)
+        cx, bx = warp_scenes_ctrl_scored(stack, ctrl, params, "bilinear",
+                                         n_ns, (h, w), step, win=win,
+                                         win0=win0)
+        cp, bp = pt.warp_scenes_scored_pallas(stack, ctrl, params,
+                                              "bilinear", n_ns, (h, w),
+                                              step, win=win, win0=win0,
+                                              interpret=True)
+        np.testing.assert_array_equal(np.asarray(bx), np.asarray(bp))
+        np.testing.assert_array_almost_equal_nulp(
+            np.asarray(cx), np.asarray(cp), nulp=2)
+
+
+class TestMosaicSemantics:
+    def test_all_nodata_tile(self):
+        """Every granule entirely nodata -> no valid pixel, zero-filled
+        canvases, -inf best, and a 255 byte tile."""
+        stack, ctrl, params, h, w, step, n_ns = _inputs(7)
+        stack = jnp.full_like(stack, -999.0)
+        cp, bp = pt.warp_scenes_scored_pallas(stack, ctrl, params,
+                                              "near", n_ns, (h, w),
+                                              step, interpret=True)
+        assert not np.isfinite(np.asarray(bp)).any()
+        assert (np.asarray(cp) == 0.0).all()
+        sp = jnp.zeros(3, jnp.float32)
+        tile = pt.render_scenes_pallas(stack, ctrl, params, sp, "near",
+                                       n_ns, (h, w), step, True, 0,
+                                       interpret=True)
+        assert (np.asarray(tile) == 255).all()
+
+    def test_multi_scene_priority_order(self):
+        """Constant-valued overlapping scenes with priorities REVERSED
+        from stack order: the highest priority must win everywhere it is
+        valid, independent of granule order."""
+        B, S, h, w, step = 3, 96, 64, 64, 16
+        stack = np.stack([np.full((S, S), 10.0 * (k + 1), np.float32)
+                          for k in range(B)])
+        stack[2, :, :48] = -999.0       # top priority invalid on left
+        gh = (h - 1 + step - 1) // step + 1
+        ctrl = np.stack(
+            [np.linspace(8, 72, gh, np.float32)[None, :].repeat(gh, 0),
+             np.linspace(8, 72, gh, np.float32)[:, None].repeat(gh, 1)])
+        params = np.zeros((B, 11), np.float32)
+        for k in range(B):
+            # identity affine; priority 1, 2, 3 in stack order
+            params[k] = [0, 1, 0, 0, 0, 1, S, S, -999.0, k + 1.0, 0]
+        cp, bp = pt.warp_scenes_scored_pallas(
+            jnp.asarray(stack), jnp.asarray(ctrl), jnp.asarray(params),
+            "near", 1, (h, w), step, interpret=True)
+        cx, bx = warp_scenes_ctrl_scored(
+            jnp.asarray(stack), jnp.asarray(ctrl), jnp.asarray(params),
+            "near", 1, (h, w), step)
+        np.testing.assert_array_equal(np.asarray(cx), np.asarray(cp))
+        np.testing.assert_array_equal(np.asarray(bx), np.asarray(bp))
+        cp = np.asarray(cp)[0]
+        bp = np.asarray(bp)[0]
+        # where granule 2 (value 30) is valid it wins; elsewhere
+        # granule 1 (value 20) does
+        assert set(np.unique(cp)) <= {20.0, 30.0}
+        assert set(np.unique(bp)) <= {2.0, 3.0}
+        assert (cp == 30.0).any() and (cp == 20.0).any()
+
+    def test_namespace_separation(self):
+        """Granules land only in their own namespace canvas."""
+        stack, ctrl, params, h, w, step, n_ns = _inputs(8)
+        cp, bp = pt.warp_scenes_scored_pallas(stack, ctrl, params,
+                                              "near", n_ns, (h, w),
+                                              step, interpret=True)
+        ns = np.asarray(params)[:, 10].astype(int)
+        prios = np.asarray(params)[:, 9]
+        bp = np.asarray(bp)
+        for n in range(n_ns):
+            allowed = set(prios[ns == n]) | {-np.inf}
+            assert set(np.unique(bp[n])) <= allowed
+
+
+class TestRenderByteParity:
+    @pytest.mark.parametrize("auto,colour_scale", [
+        (True, 0), (True, 1), (False, 0)])
+    def test_render_bit_exact(self, auto, colour_scale):
+        # positive data: colour_scale=1 goes through log10
+        stack, ctrl, params, h, w, step, n_ns = _inputs(
+            9, lo=1.0, hi=4000.0)
+        sp = jnp.asarray(np.array([10.0, 250.0, 0.0], np.float32))
+        rx = render_scenes_ctrl(stack, ctrl, params, sp, "near", n_ns,
+                                (h, w), step, auto, colour_scale)
+        rp = pt.render_scenes_pallas(stack, ctrl, params, sp, "near",
+                                     n_ns, (h, w), step, auto,
+                                     colour_scale, interpret=True)
+        np.testing.assert_array_equal(np.asarray(rx), np.asarray(rp))
+
+
+class TestDispatchAndEligibility:
+    def test_warp_pallas_ok_gates_big_windows(self, monkeypatch):
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        assert pt.warp_pallas_ok(512, 512, 2)
+        assert not pt.warp_pallas_ok(4096, 4096, 2)
+        monkeypatch.setenv("GSKY_PALLAS", "0")
+        assert not pt.warp_pallas_ok(128, 128, 1)
+
+    def test_raced_dispatch_interpret_runs_pallas(self, monkeypatch):
+        """Under GSKY_PALLAS=interpret the raced dispatcher must run the
+        pallas kernel (no race, no ledger writes) and match XLA."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        from gsky_tpu.ops import kernel_ledger
+        stack, ctrl, params, h, w, step, n_ns = _inputs(10)
+        canv, best = pt.warp_scored_raced(stack, ctrl, params, "near",
+                                          n_ns, (h, w), step)
+        cx, bx = warp_scenes_ctrl_scored(stack, ctrl, params, "near",
+                                         n_ns, (h, w), step)
+        np.testing.assert_array_equal(np.asarray(cx), np.asarray(canv))
+        np.testing.assert_array_equal(np.asarray(bx), np.asarray(best))
+        assert kernel_ledger.entries() == {}  # interpret never records
+
+    def test_executor_warp_mosaic_parity(self, monkeypatch):
+        """Executor-level: the decoded-window mosaic path produces the
+        same canvases under GSKY_PALLAS=interpret (fused pallas kernel)
+        and GSKY_PALLAS=0 (XLA)."""
+        from gsky_tpu.geo.crs import EPSG3857
+        from gsky_tpu.geo.transform import GeoTransform
+        from gsky_tpu.pipeline.decode import DecodedWindow
+        from gsky_tpu.pipeline.executor import WarpExecutor
+
+        rng = np.random.default_rng(12)
+        gt0 = GeoTransform(0.0, 30.0, 0.0, 6000.0, 0.0, -30.0)
+        windows = []
+        for k in range(3):
+            data = rng.uniform(0, 100, (200, 220)).astype(np.float32)
+            valid = rng.uniform(0, 1, (200, 220)) > 0.2
+            gt = GeoTransform(gt0.x0 + 300.0 * k, 30.0, 0.0,
+                              gt0.y0 - 150.0 * k, 0.0, -30.0)
+            windows.append(DecodedWindow(None, data, valid, gt,
+                                         EPSG3857))
+        dst_gt = GeoTransform(900.0, 15.0, 0.0, 5400.0, 0.0, -15.0)
+        args = (windows, [0, 0, 1], [3.0, 2.0, 1.0], dst_gt, EPSG3857,
+                128, 128, 2, "near")
+
+        monkeypatch.setenv("GSKY_PALLAS", "0")
+        cx, vx = WarpExecutor().warp_mosaic(*args)
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        cp, vp = WarpExecutor().warp_mosaic(*args)
+        assert np.asarray(vx).any()     # the tile actually hits data
+        np.testing.assert_array_equal(np.asarray(vx), np.asarray(vp))
+        np.testing.assert_array_equal(np.asarray(cx), np.asarray(cp))

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""One-shot Pallas-vs-XLA race table (docs/KERNELS.md).
+
+Prints the backend, the dispatch mode, and every verdict in the
+persistent kernel ledger — the same data /debug serves, without
+needing a server:
+
+    python tools/kernel_probe.py               # dump the race table
+    python tools/kernel_probe.py --selftest    # + tiny interpret parity run
+    python tools/kernel_probe.py --reset       # delete the ledger (re-race)
+
+Honours GSKY_KERNEL_LEDGER / GSKY_PALLAS like the server does.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fmt_ms(v):
+    return "-" if v is None else "%.3f" % v
+
+
+def dump_table():
+    from gsky_tpu.ops import kernel_ledger, pallas_tpu as pt
+
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception as exc:  # noqa: BLE001 - probe must still print
+        backend = "unavailable (%s)" % exc
+
+    doc = kernel_ledger.stats()
+    print("backend:         ", backend)
+    print("pallas enabled:  ", pt.use_pallas())
+    print("interpret mode:  ", pt.pallas_interpret())
+    print("ledger path:     ", doc["ledger_path"])
+    print("ledger present:  ", doc["ledger_present"])
+    sess = doc.get("session", {})
+    print("session state:    failed=%s demoted=%d proven=%d" % (
+        sess.get("failed_kernels", []), sess.get("demoted_pairs", 0),
+        sess.get("proven_pairs", 0)))
+    print()
+    if not doc["kernels"]:
+        print("no race verdicts recorded yet")
+        return
+    hdr = "%-14s %-9s %11s %11s  %s" % (
+        "kernel", "verdict", "pallas_ms", "xla_ms", "token")
+    print(hdr)
+    print("-" * len(hdr))
+    for kernel in sorted(doc["kernels"]):
+        k = doc["kernels"][kernel]
+        for e in k["entries"]:
+            print("%-14s %-9s %11s %11s  %s" % (
+                kernel, e["verdict"], _fmt_ms(e["t_pallas_ms"]),
+                _fmt_ms(e["t_xla_ms"]), e["token"]))
+        print("%-14s totals: promoted=%d demoted=%d failed=%d" % (
+            kernel, k["promoted"], k["demoted"], k["failed"]))
+
+
+def selftest():
+    """Tiny interpret-mode parity run: the fused warp kernel vs the XLA
+    warp on one 64x64 tile.  Exit non-zero on mismatch."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from gsky_tpu.ops.pallas_tpu import warp_scenes_scored_pallas
+    from gsky_tpu.ops.warp import warp_scenes_ctrl_scored
+
+    rng = np.random.default_rng(0)
+    B, S, h, w, step = 2, 96, 64, 64, 16
+    stack = rng.uniform(1.0, 100.0, size=(B, S, S)).astype(np.float32)
+    gh = (h - 1 + step - 1) // step + 1
+    gw = (w - 1 + step - 1) // step + 1
+    ctrl = np.stack(np.meshgrid(np.linspace(4.0, 80.0, gw),
+                                np.linspace(4.0, 80.0, gh)),
+                    axis=0).astype(np.float32)
+    params = np.array(
+        [[0.1 * k, 1.0, 0.0, 0.1 * k, 0.0, 1.0, S, S, -999.0,
+          100.0 - k, 0.0] for k in range(B)], np.float32)
+
+    canv_p, best_p = warp_scenes_scored_pallas(
+        jnp.asarray(stack), jnp.asarray(ctrl), jnp.asarray(params),
+        method="near", n_ns=1, out_hw=(h, w), step=step, interpret=True)
+    canv_x, best_x = warp_scenes_ctrl_scored(
+        jnp.asarray(stack), jnp.asarray(ctrl), jnp.asarray(params),
+        method="near", n_ns=1, out_hw=(h, w), step=step)
+    np.testing.assert_array_equal(np.asarray(canv_p), np.asarray(canv_x))
+    np.testing.assert_array_equal(np.asarray(best_p), np.asarray(best_x))
+    print("selftest: interpret warp kernel parity OK "
+          "(%dx%d tile, %d scenes, nearest, bit-exact)" % (h, w, B))
+
+
+def reset():
+    from gsky_tpu.ops import kernel_ledger
+
+    path = kernel_ledger.ledger_path()
+    if os.path.exists(path):
+        os.unlink(path)
+        print("deleted", path, "- every kernel re-races on next start")
+    else:
+        print("no ledger at", path)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--selftest", action="store_true",
+                    help="run a tiny interpret-mode parity check")
+    ap.add_argument("--reset", action="store_true",
+                    help="delete the ledger file (re-race everything)")
+    args = ap.parse_args()
+    if args.reset:
+        reset()
+        return
+    dump_table()
+    if args.selftest:
+        print()
+        selftest()
+
+
+if __name__ == "__main__":
+    main()
