@@ -752,6 +752,31 @@ def run_all():
     }
 
 
+def _host_overhead(configs, kernels):
+    """Per-config host-overhead split: e2e p50 tile latency minus the
+    matching device kernel's single-dispatch wall (``sync_ms`` on
+    pre-staged inputs) = everything the HOST adds per tile — index,
+    scene decode, dispatch glue, readback, PNG encode.  This is the
+    number the staged tile path attacks; the device term is the floor
+    it cannot cross."""
+    mapping = {"cfg1_single_nearest": "render_mosaic_256",
+               "cfg3_mosaic": "render_mosaic_256",
+               "cfg2_rgb_bilinear": "render_rgba_256"}
+    out = {}
+    for cfg_key, kern_key in mapping.items():
+        p50 = (configs.get(cfg_key, {}).get("latency") or {}).get("p50_ms")
+        kern = kernels.get(kern_key) or {}
+        dev = kern.get("sync_ms")
+        if p50 is None or dev is None:
+            continue
+        host = round(max(0.0, p50 - dev), 3)
+        out[cfg_key] = {
+            "e2e_p50_ms": p50, "device_sync_ms": dev, "host_ms": host,
+            "host_fraction": round(host / p50, 3) if p50 else None,
+            "device_pipelined_ms": kern.get("pipelined_ms")}
+    return out
+
+
 def _ratio(cfg_key, measured, baseline):
     """>1 == faster than the measured CPU baseline."""
     m, b = measured[cfg_key], baseline[cfg_key]
@@ -820,6 +845,7 @@ def main(argv=None):
         "p50_tile_ms": head["latency"]["p50_ms"],
         "configs": configs,
         "device_kernels": kernels,
+        "host_overhead": _host_overhead(configs, kernels),
         "cpu_baseline": baseline if baseline is not configs else None,
         "vs_baseline_per_config": (
             {k: _ratio(k, configs, baseline) for k in configs}

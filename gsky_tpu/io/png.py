@@ -8,14 +8,114 @@ the (C-accelerated) codec.
 
 from __future__ import annotations
 
+import asyncio
 import io
 import os
-from typing import Optional, Sequence
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 from PIL import Image
 
 NODATA_BYTE = 255
+
+# -- sized encode pool -------------------------------------------------------
+# PNG/JPEG encode is pure-CPU PIL work that used to run INLINE in the
+# async GetMap handler, stalling the event loop for the encode of every
+# tile.  The staged tile path runs encodes here instead: a bounded pool
+# (GSKY_PNG_ENCODE_WORKERS) so concurrent requests' encodes overlap
+# each other and the next request's device readback, without unbounded
+# thread growth under burst load.
+
+_POOL_ENV = "GSKY_PNG_ENCODE_WORKERS"
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_lock = threading.Lock()
+_pool_stats: Dict = {"workers": 0, "pending": 0, "queue_max": 0,
+                     "encoded": 0, "errors": 0, "busy_s": 0.0}
+
+
+def _pool_workers() -> int:
+    try:
+        v = int(os.environ.get(_POOL_ENV, 4))
+    except ValueError:
+        return 4
+    return max(1, min(32, v))
+
+
+def encode_pool() -> ThreadPoolExecutor:
+    global _pool
+    if _pool is None:
+        with _pool_lock:
+            if _pool is None:
+                n = _pool_workers()
+                _pool_stats["workers"] = n
+                _pool = ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix="gsky-png")
+    return _pool
+
+
+def encode_pool_stats() -> Dict:
+    with _pool_lock:
+        out = dict(_pool_stats)
+    out["busy_s"] = round(out["busy_s"], 6)
+    return out
+
+
+def reset_encode_pool() -> None:
+    """Shut the pool down so the next encode re-reads the sizing knob
+    (tests; a serving process keeps one pool for its lifetime)."""
+    global _pool
+    with _pool_lock:
+        pool, _pool = _pool, None
+        for k, v in (("workers", 0), ("pending", 0), ("queue_max", 0),
+                     ("encoded", 0), ("errors", 0), ("busy_s", 0.0)):
+            _pool_stats[k] = v
+    if pool is not None:
+        pool.shutdown(wait=False)
+
+
+async def encode_async(fn, *args, spans: Optional[Dict] = None, **kw):
+    """Run one encode callable on the sized pool, awaitable from the
+    event loop.  Exceptions propagate to the awaiting handler exactly
+    as they would inline.  ``spans`` (the staged tile path's
+    per-request record) gets ``encode_s`` and the observed
+    ``encode_queue_max`` occupancy folded in."""
+    loop = asyncio.get_running_loop()
+    pool = encode_pool()
+    with _pool_lock:
+        _pool_stats["pending"] += 1
+        occupancy = _pool_stats["pending"]
+        if occupancy > _pool_stats["queue_max"]:
+            _pool_stats["queue_max"] = occupancy
+    if spans is not None:
+        spans["encode_queue_max"] = max(
+            spans.get("encode_queue_max", 0), occupancy)
+    t0 = time.perf_counter()
+
+    def run():
+        t1 = time.perf_counter()
+        try:
+            return fn(*args, **kw)
+        finally:
+            with _pool_lock:
+                _pool_stats["busy_s"] += time.perf_counter() - t1
+
+    ok = False
+    try:
+        out = await loop.run_in_executor(pool, run)
+        ok = True
+        return out
+    finally:
+        # finally (not except Exception): a cancelled await must still
+        # release its pending slot or the occupancy telemetry leaks
+        with _pool_lock:
+            _pool_stats["pending"] -= 1
+            _pool_stats["encoded" if ok else "errors"] += 1
+        if ok and spans is not None:
+            spans["encode_s"] = spans.get("encode_s", 0.0) \
+                + time.perf_counter() - t0
 
 # zlib level 1 default: on satellite composites levels 6-9 buy ~10%
 # smaller tiles for >2x the encode time, and the encode sits on the

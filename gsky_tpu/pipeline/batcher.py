@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Tuple
 
@@ -37,9 +38,28 @@ from ..ops.warp import render_scenes_ctrl_many
 
 _MAX_BATCH = 16
 
+# EMA weight of the newest per-tile latency sample; ~5 samples to
+# converge, enough inertia to ride out scheduler noise
+_EMA_ALPHA = 0.3
+# a padded size is past the knee when its per-tile latency exceeds the
+# best smaller size by this factor (BENCH_r05: x8 batches measured
+# 2.26x the single-tile per-tile cost on a bandwidth-bound link)
+_KNEE_RATIO = 1.25
+
 
 def batching_enabled() -> bool:
     return os.environ.get("GSKY_RENDER_BATCH", "0") == "1"
+
+
+def _knee_cap() -> int:
+    """Static coalesce cap (GSKY_RENDER_BATCH_MAX): operators who have
+    already measured their link can pin the knee instead of waiting for
+    the adaptive ratchet to find it."""
+    try:
+        v = int(os.environ.get("GSKY_RENDER_BATCH_MAX", _MAX_BATCH))
+    except ValueError:
+        return _MAX_BATCH
+    return max(1, min(_MAX_BATCH, v))
 
 
 class RenderBatcher:
@@ -54,6 +74,46 @@ class RenderBatcher:
         # (engagement telemetry, mirroring WarpExecutor.win_engaged)
         self.win_batches = 0
         self.full_batches = 0
+        # adaptive throughput knee: coalescing amortises device round
+        # trips, but past some batch size the padded pull's BYTES cost
+        # more than the round trips saved (render_mosaic_256_x8
+        # regression: 9.29 ms/tile batched vs 4.10 single in
+        # BENCH_r05).  Per padded-size EMAs of measured per-tile
+        # latency feed a ratchet that caps the flush threshold at the
+        # largest size still pulling its weight.
+        self.knee = min(max_batch, _knee_cap())
+        self._tile_ms: Dict[int, float] = {}   # padded size -> EMA ms
+        self._tile_n: Dict[int, int] = {}      # samples per size
+
+    def _observe(self, np_size: int, n_tiles: int, ms: float) -> None:
+        """Fold one executed batch's per-tile latency into the EMA for
+        its padded size and ratchet the knee down when this size
+        measures slower than a smaller one.  The FIRST sample at each
+        size is discarded: it carries the jit compile."""
+        with self._lock:
+            seen = self._tile_n.get(np_size, 0)
+            self._tile_n[np_size] = seen + 1
+            if seen == 0:
+                return
+            per_tile = ms / max(1, n_tiles)
+            ema = self._tile_ms.get(np_size)
+            self._tile_ms[np_size] = per_tile if ema is None else \
+                (1 - _EMA_ALPHA) * ema + _EMA_ALPHA * per_tile
+            if np_size <= 1:
+                return
+            smaller = [v for k, v in self._tile_ms.items()
+                       if k < np_size]
+            if smaller and self._tile_ms[np_size] > \
+                    _KNEE_RATIO * min(smaller):
+                self.knee = min(self.knee, max(1, np_size // 2))
+
+    def stats(self) -> Dict:
+        """/debug `gather_window` payload: where the knee sits and the
+        evidence (per padded-size per-tile EMA ms) behind it."""
+        with self._lock:
+            return {"batch_knee": self.knee,
+                    "tile_ms": {k: round(v, 3)
+                                for k, v in sorted(self._tile_ms.items())}}
 
     def render(self, key: tuple, stack, ctrl, params, sp,
                statics: tuple, win_raw=None) -> np.ndarray:
@@ -78,7 +138,7 @@ class RenderBatcher:
                 timer.start()
             else:
                 entry[1].append((ctrl, params, sp, win_raw, fut))
-                if len(entry[1]) >= self.max_batch:
+                if len(entry[1]) >= min(self.max_batch, self.knee):
                     flush_now = self._groups.pop(key)
         if flush_now is not None:
             # the pending wait timer would still fire, take the lock and
@@ -136,11 +196,13 @@ class RenderBatcher:
                     self.win_batches += 1
                 else:
                     self.full_batches += 1
+            t0 = time.perf_counter()
             out = np.asarray(render_scenes_ctrl_many(
                 stack, jnp.asarray(ctrls), jnp.asarray(params),
                 jnp.asarray(sps), method, n_ns, out_hw, step, auto,
                 colour_scale, win=win,
                 win0=None if win is None else jnp.asarray(win0)))
+            self._observe(Np, N, (time.perf_counter() - t0) * 1e3)
             for i, it in enumerate(items):
                 it[4].set_result(out[i])
         except Exception as e:  # pragma: no cover - propagate to callers

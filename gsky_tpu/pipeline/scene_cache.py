@@ -237,7 +237,14 @@ class SceneCache:
             pad = np.full((bh, bw), np.nan, np.float32)
             pad[:true_h, :true_w] = data
             data = pad
-        dev = jnp.asarray(data)
+        # device_put, not jnp.asarray: the async host->device upload
+        # returns immediately with the transfer in flight, so the
+        # loading thread (the staged tile path's decode stage) moves on
+        # to the next scene while DMA drains; the first kernel that
+        # consumes the scene synchronizes.  nbytes accounting is exact
+        # either way: the cache charges bucket dims x itemsize, which
+        # is precisely the committed device allocation.
+        dev = jax.device_put(data)
         return DeviceScene(dev=dev, height=true_h, width=true_w,
                            nodata=float("nan"), gt=gt, crs=crs)
 

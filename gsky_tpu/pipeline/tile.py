@@ -11,6 +11,7 @@ the merger is a vectorised mosaic + jit'd expressions.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -211,6 +212,50 @@ class TilePipeline:
             granule_count=len(granules),
             file_count=len({g.path for g in granules}))
 
+    def _timed_index(self, req: GeoTileRequest,
+                     spans: Optional[Dict[str, float]] = None):
+        """`index()` with the MAS-query seconds recorded into ``spans``
+        (the staged tile path's per-request "index" stage span)."""
+        if spans is None:
+            return self.index(req)
+        t0 = time.perf_counter()
+        try:
+            return self.index(req)
+        finally:
+            spans["index_s"] = spans.get("index_s", 0.0) \
+                + time.perf_counter() - t0
+
+    def composite_prep(self, req: GeoTileRequest,
+                       stats: Optional[Dict[str, int]] = None,
+                       spans: Optional[Dict[str, float]] = None):
+        """Qualification + ONE index pass for the fused composite path:
+        (granules, ns_ids, prio, n_ns) or None.  Split from the dispatch
+        half so the staged tile pipeline can run indexing, scene decode
+        and device dispatch as separately bounded stages."""
+        if self.remote is not None or req.mask is not None:
+            return None
+        exprs = req.band_exprs
+        if any(ce._ast[0] != "var" for ce in exprs.expressions):
+            return None
+        granules = self._timed_index(req, spans)
+        if not granules:
+            return None
+        if stats is not None:
+            stats["granules"] = len(granules)
+            stats["files"] = len({g.path for g in granules})
+        ns_names, ns_ids, prio = ns_prio(granules)
+        return granules, ns_ids, prio, len(ns_names)
+
+    def composite_dispatch(self, req: GeoTileRequest, made,
+                           offset: float = 0.0, scale: float = 0.0,
+                           clip: float = 0.0, colour_scale: int = 0,
+                           auto: bool = True):
+        granules, ns_ids, prio, n_ns = made
+        return self.executor.render_byte_scenes(
+            granules, ns_ids, prio, req.dst_gt(), req.crs,
+            req.height, req.width, n_ns, req.resample,
+            offset, scale, clip, colour_scale, auto)
+
     def render_composite_byte(self, req: GeoTileRequest,
                               offset: float = 0.0, scale: float = 0.0,
                               clip: float = 0.0, colour_scale: int = 0,
@@ -223,35 +268,15 @@ class TilePipeline:
         remote workers, non-trivial band expressions, uncacheable
         scenes) — callers then use `process()` + `ops.scale`.
         """
-        if self.remote is not None or req.mask is not None:
+        made = self.composite_prep(req, stats)
+        if made is None:
             return None
-        exprs = req.band_exprs
-        if any(ce._ast[0] != "var" for ce in exprs.expressions):
-            return None
-        granules = self.index(req)
-        if not granules:
-            return None
-        if stats is not None:
-            stats["granules"] = len(granules)
-            stats["files"] = len({g.path for g in granules})
-        ns_names: List[str] = []
-        ns_index: Dict[str, int] = {}
-        for g in granules:
-            if g.namespace not in ns_index:
-                ns_index[g.namespace] = len(ns_names)
-                ns_names.append(g.namespace)
-        ns_ids = [ns_index[g.namespace] for g in granules]
-        order = M.priority_order([g.timestamp for g in granules])
-        prio = [0.0] * len(granules)
-        for rank, i in enumerate(order):
-            prio[i] = float(len(granules) - rank)
-        return self.executor.render_byte_scenes(
-            granules, ns_ids, prio, req.dst_gt(), req.crs,
-            req.height, req.width, len(ns_names), req.resample,
-            offset, scale, clip, colour_scale, auto)
+        return self.composite_dispatch(req, made, offset, scale, clip,
+                                       colour_scale, auto)
 
     def _bands_prep(self, req: GeoTileRequest, n_bands: int = 0,
-                    stats: Optional[Dict[str, int]] = None):
+                    stats: Optional[Dict[str, int]] = None,
+                    spans: Optional[Dict[str, float]] = None):
         """Shared index + namespace/selection resolution for the fused
         multi-band paths: (granules, ns_index, out_sel) or None.  ONE
         index pass feeds both rungs of the RGB ladder."""
@@ -262,7 +287,7 @@ class TilePipeline:
                 (n_bands and len(exprs.expressions) != n_bands) or \
                 any(ce._ast[0] != "var" for ce in exprs.expressions):
             return None
-        granules = self.index(req)
+        granules = self._timed_index(req, spans)
         if not granules:
             return None
         if stats is not None:

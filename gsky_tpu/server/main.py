@@ -81,6 +81,17 @@ def main(argv=None):
         kernel_ledger.set_default_dir(args.log_dir)
         pallas_tpu.reload_ledger()
 
+    # persistent compilation cache + shape-bucket prewarm: every
+    # bucketed render program the configured layers can dispatch is
+    # compiled BEFORE the listen socket opens, so the first burst of
+    # real traffic sees zero compile stalls (GSKY_PREWARM=0 skips)
+    from .prewarm import prewarm_from_watcher
+    warm = prewarm_from_watcher(watcher)
+    if warm is not None:
+        print(f"prewarm: {warm['programs']} program(s) for "
+              f"{warm['specs']} layer spec(s) in {warm['seconds']}s "
+              f"({warm['compiles']} fresh compile(s))")
+
     metrics = MetricsLogger(args.log_dir, verbose=args.verbose)
     server = OWSServer(watcher, mas_factory, metrics,
                        static_dir=args.static, temp_dir=args.temp_dir)

@@ -145,6 +145,8 @@ class MetricsLogger:
         self._summary_lock = threading.Lock()
         # cumulative staged-export (pipeline/export.py) aggregates
         self._export: Dict = {}
+        # cumulative staged-tile (pipeline/tile_stages.py) aggregates
+        self._tiles: Dict = {}
 
     def collector(self) -> MetricsCollector:
         return MetricsCollector(self)
@@ -205,6 +207,31 @@ class MetricsLogger:
         except Exception:   # observability must never fail a request
             pass
 
+    # staged-tile span folding (pipeline/tile_stages.py), mirroring the
+    # export aggregates above: per-stage seconds sum, queue high-water
+    # marks max, the raw per-request record kept as "last"
+    _TILE_SUMS = ("plan_s", "index_s", "decode_s", "dispatch_s",
+                  "readback_s", "encode_s", "granules")
+    _TILE_MAXES = ("decode_queue_max", "dispatch_queue_max",
+                   "encode_queue_max")
+
+    def record_tile(self, spans: Dict) -> None:
+        """Fold one staged GetMap render's stage spans into the /debug
+        `tile_stages` aggregates."""
+        try:
+            with self._summary_lock:
+                e = self._tiles
+                e["tiles"] = e.get("tiles", 0) + 1
+                for k in self._TILE_SUMS:
+                    if k in spans:
+                        e[k] = round(e.get(k, 0) + spans[k], 6)
+                for k in self._TILE_MAXES:
+                    if k in spans:
+                        e[k] = max(e.get(k, 0), spans[k])
+                e["last"] = dict(spans)
+        except Exception:   # observability must never fail a request
+            pass
+
     def summary(self) -> Dict:
         """The /debug document body: uptime, per-verb counts + latency
         percentiles over the rolling window, cumulative device/pipeline
@@ -227,6 +254,15 @@ class MetricsLogger:
                     "pipeline_ms_total": round(s["rpc_ms"], 1)}
             if self._export.get("exports"):
                 out["export_pipeline"] = dict(self._export)
+            if self._tiles.get("tiles"):
+                out["tile_stages"] = dict(self._tiles)
+                try:
+                    from ..io.png import encode_pool_stats
+                    from ..pipeline.tile_stages import gate_stats
+                    out["tile_stages"]["gates"] = gate_stats()
+                    out["tile_stages"]["encode_pool"] = encode_pool_stats()
+                except Exception:
+                    pass
         out["cache"] = _cache_stats()
         try:
             from ..resilience import registry as _resilience

@@ -43,8 +43,8 @@ from ..index.client import MASClient
 from ..index.store import fmt_time, parse_time
 from ..io.geotiff import GeoTIFF, write_geotiff
 from ..io.netcdf import write_netcdf3
-from ..io.png import (empty_tile_png, encode_jpeg, encode_png,
-                      encode_rgba_png)
+from ..io.png import (empty_tile_png, encode_async, encode_jpeg,
+                      encode_png, encode_rgba_png)
 from ..ops.palette import gradient_palette, with_nodata_entry
 from ..ops.raster import DTYPE_NP
 from ..ops.scale import scale_params_auto, scale_to_byte
@@ -54,6 +54,7 @@ from ..pipeline.export import ExportPipeline
 from ..pipeline.export import pipeline_enabled as export_pipeline_enabled
 from ..pipeline.extent import compute_reprojection_extent
 from ..pipeline.feature_info import get_feature_info
+from ..pipeline.tile_stages import render_staged, tile_pipeline_enabled
 from ..pipeline.types import AxisSelector, MaskSpec
 from ..resilience import (BackendUnavailable, Deadline, DeadlineExceeded,
                           TooManyFailures, deadline_scope, degraded_reasons,
@@ -294,7 +295,10 @@ class OWSServer:
                     "engaged": ex.win_engaged,
                     "declined": ex.win_declined,
                     "batches_windowed": ex._batcher.win_batches,
-                    "batches_full": ex._batcher.full_batches}}
+                    "batches_full": ex._batcher.full_batches,
+                    # adaptive coalesce cap + the per-padded-size
+                    # per-tile latency EMAs that set it
+                    **ex._batcher.stats()}}
             doc["scene_cache_bytes"] = sc._bytes
             doc["drill_cache_bytes"] = dc._bytes
         except Exception:
@@ -587,16 +591,67 @@ class OWSServer:
                                  style.clip_value)
         scaled = None
         n_exprs = len(req.band_exprs.expr_names)
+        # per-request span record of the staged tile path; stays None
+        # on the serial path (GSKY_TILE_PIPELINE=0) and on renders that
+        # fell back to the modular pipeline
+        spans = None
         # one deadline budget for the whole render: every stage's
         # wait_for AND every downstream timeout (MAS HTTP, worker gRPC)
         # draws from what is LEFT of wms_timeout, not a fresh allowance
         with deadline_scope(Deadline(lay.wms_timeout)) as dl:
-            if not lay.input_layers and 1 <= n_exprs <= 4:
-                # single-dispatch fast path: fused warp+mosaic+scale on
-                # device, one pull (the modular path below costs several
-                # device round trips per request); single-band styles
-                # composite, RGB styles emit per-band planes
+            if not lay.input_layers and 1 <= n_exprs <= 4 \
+                    and tile_pipeline_enabled():
+                # staged fast path: the same fused prep/dispatch halves
+                # as the serial ladder below, decomposed into bounded
+                # plan/index/decode/dispatch/readback stages so
+                # concurrent requests overlap (tile N+1's output is in
+                # flight while tile N encodes) — byte-identical output
                 stats: Dict[str, int] = {}
+                made_spans: Dict = {}
+                made = await asyncio.wait_for(
+                    asyncio.to_thread(render_staged, pipe, req, n_exprs,
+                                      style.offset_value,
+                                      style.scale_value,
+                                      style.clip_value,
+                                      style.colour_scale, auto, stats,
+                                      made_spans),
+                    timeout=dl.remaining())
+                if made is not None:
+                    spans = made_spans
+                    kind, arr = made
+                    rgba = None
+                    if kind == "rgba":
+                        rgba = arr              # (H, W, 4)
+                        scaled = [arr[..., 0], arr[..., 1], arr[..., 2]]
+                    elif kind == "planes":      # (n, H, W)
+                        scaled = list(arr)
+                    else:                       # "composite": (H, W)
+                        scaled = [arr] if arr.ndim == 2 else list(arr)
+                    collector.info["device"]["duration"] = int(
+                        (spans.get("dispatch_s", 0.0)
+                         + spans.get("readback_s", 0.0)) * 1e9)
+                    collector.info["device"]["platform"] = _jax_platform()
+                    collector.info["indexer"]["num_granules"] = \
+                        stats.get("granules", 0)
+                    collector.info["indexer"]["num_files"] = \
+                        stats.get("files", 0)
+                    spans["granules"] = stats.get("granules", 0)
+                    if rgba is not None and \
+                            p.format.lower() not in ("image/jpeg",
+                                                     "image/jpg"):
+                        collector.info["rpc"]["duration"] = \
+                            int((time.time() - t0) * 1e9)
+                        return _png(await self._encode_tile(
+                            encode_rgba_png, rgba,
+                            compress_level=_png_level(lay, style),
+                            spans=spans))
+            elif not lay.input_layers and 1 <= n_exprs <= 4:
+                # single-dispatch SERIAL fast path (the escape hatch):
+                # fused warp+mosaic+scale on device, one pull (the
+                # modular path below costs several device round trips
+                # per request); single-band styles composite, RGB
+                # styles emit per-band planes
+                stats = {}
                 if n_exprs == 1:
                     sb = await asyncio.wait_for(
                         asyncio.to_thread(pipe.render_composite_byte, req,
@@ -678,15 +733,33 @@ class OWSServer:
                     scaled.append(np.asarray(sb))
         collector.info["rpc"]["duration"] = int((time.time() - t0) * 1e9)
         if p.format.lower() in ("image/jpeg", "image/jpg"):
-            return web.Response(body=encode_jpeg(scaled[:3]),
-                                content_type="image/jpeg")
+            return web.Response(
+                body=await self._encode_tile(encode_jpeg, scaled[:3],
+                                             spans=spans),
+                content_type="image/jpeg")
         palette = None
         if len(scaled) == 1 and (style.palette or lay.palette):
             spec = style.palette or lay.palette
             palette = with_nodata_entry(
                 gradient_palette(spec.colours, spec.interpolate))
-        return _png(encode_png(scaled, palette,
-                               compress_level=_png_level(lay, style)))
+        return _png(await self._encode_tile(
+            encode_png, scaled, palette,
+            compress_level=_png_level(lay, style), spans=spans))
+
+    async def _encode_tile(self, fn, *args, spans=None, **kw):
+        """PNG/JPEG encode off the event loop on io/png's sized pool
+        when the staged tile path is on; inline under the
+        GSKY_TILE_PIPELINE=0 escape hatch (byte-identical either way —
+        same codec, same arguments).  A staged render's completed span
+        record rides along and is folded into the /debug `tile_stages`
+        aggregates once the encode lands."""
+        if not tile_pipeline_enabled():
+            return fn(*args, **kw)
+        try:
+            return await encode_async(fn, *args, spans=spans, **kw)
+        finally:
+            if spans is not None:
+                self.metrics.record_tile(spans)
 
     @staticmethod
     def _render_rgb(pipe, req, style, auto: bool, stats):

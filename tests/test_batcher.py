@@ -164,6 +164,61 @@ class TestExceptionFanOut:
         assert all(isinstance(e, RuntimeError) for e in errors)
 
 
+class TestThroughputKnee:
+    def test_first_sample_per_size_discarded(self):
+        b = RenderBatcher()
+        b._observe(8, 8, 800.0)            # carries the jit compile
+        assert b.stats()["tile_ms"] == {}
+        b._observe(8, 8, 80.0)
+        assert b.stats()["tile_ms"] == {8: 10.0}
+
+    def test_knee_ratchets_down_past_regression(self):
+        """BENCH_r05 shape: x8 batches at 9.29 ms/tile vs 4.10 single
+        -> the ratchet caps the flush threshold at 4."""
+        b = RenderBatcher(max_batch=16)
+        assert b.knee == 16
+        for _ in range(3):
+            b._observe(1, 1, 4.10)
+        for _ in range(3):
+            b._observe(8, 8, 8 * 9.29)
+        assert b.knee == 4
+        # the knee never ratchets back up on a lucky sample
+        b._observe(8, 8, 8 * 0.5)
+        assert b.knee == 4
+
+    def test_size_within_ratio_keeps_knee(self):
+        b = RenderBatcher(max_batch=16)
+        for _ in range(3):
+            b._observe(1, 1, 4.0)
+        for _ in range(3):
+            b._observe(8, 8, 8 * 4.5)      # 1.125x: under the 1.25 knee
+        assert b.knee == 16
+
+    def test_flush_threshold_respects_knee(self, fake):
+        b = RenderBatcher(max_batch=16, max_wait_s=30.0)
+        b.knee = 2
+        # far below max_batch, but at the knee: flushes immediately
+        # instead of waiting out the 30 s timer
+        results, errors = _submit(b, STACK, 2)
+        assert errors == [None, None]
+        assert [c["n"] for c in fake.calls] == [2]
+
+    def test_env_cap_pins_knee(self, monkeypatch):
+        monkeypatch.setenv("GSKY_RENDER_BATCH_MAX", "2")
+        assert RenderBatcher(max_batch=16).knee == 2
+        monkeypatch.setenv("GSKY_RENDER_BATCH_MAX", "not-a-number")
+        assert RenderBatcher(max_batch=16).knee == 16
+        monkeypatch.setenv("GSKY_RENDER_BATCH_MAX", "64")
+        # clamped to the module-wide max batch
+        assert RenderBatcher(max_batch=16).knee == 16
+
+    def test_stats_payload_shape(self):
+        b = RenderBatcher()
+        st = b.stats()
+        assert set(st) == {"batch_knee", "tile_ms"}
+        assert st["batch_knee"] == b.knee
+
+
 class TestSplitBBoxRaggedEdges:
     def test_ragged_last_row_and_column(self):
         from gsky_tpu.geo.transform import BBox, split_bbox

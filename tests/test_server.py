@@ -637,8 +637,8 @@ class TestDebugSideDoor:
                                  "window_batch", "render_rgba"))
                    for k in disp), disp
         gw = doc["executor"]["gather_window"]
-        assert set(gw) == {"engaged", "declined", "batches_windowed",
-                           "batches_full"}
+        assert set(gw) >= {"engaged", "declined", "batches_windowed",
+                           "batches_full", "batch_knee", "tile_ms"}
         assert "jax" in doc and doc["jax"]["backend"] == "cpu"
 
     def test_debug_errors_counted(self, env):

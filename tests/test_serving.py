@@ -86,16 +86,17 @@ def fetch(server, paths, headers=None):
 @pytest.fixture
 def render_calls(monkeypatch):
     """Count pipeline renders (the landsat layer takes the fused
-    single-band fast path -> render_composite_byte) and slow each one
-    slightly so concurrent requests genuinely overlap."""
+    single-band fast path; both the serial render_composite_byte and
+    the staged tile path funnel through composite_dispatch) and slow
+    each one slightly so concurrent requests genuinely overlap."""
     calls = {"n": 0}
-    orig = TilePipeline.render_composite_byte
+    orig = TilePipeline.composite_dispatch
 
     def counting(self, *a, **k):
         calls["n"] += 1
         time.sleep(0.3)
         return orig(self, *a, **k)
-    monkeypatch.setattr(TilePipeline, "render_composite_byte", counting)
+    monkeypatch.setattr(TilePipeline, "composite_dispatch", counting)
     return calls
 
 

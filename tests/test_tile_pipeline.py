@@ -1,0 +1,442 @@
+"""Staged GetMap pipeline tests (`pipeline/tile_stages.py`): byte
+identity between the staged (GSKY_TILE_PIPELINE=1) and serial (=0)
+paths across resample methods, the fused/multi-CRS/RGB ladder rungs and
+degraded partial mosaics; encode-pool exception/cancellation behaviour;
+stage-gate release on error; shape-bucket prewarm zero-recompile."""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gsky_tpu.geo.crs import EPSG4326, parse_crs
+from gsky_tpu.geo.transform import GeoTransform
+from gsky_tpu.index import MASClient, MASStore
+from gsky_tpu.index.crawler import extract
+from gsky_tpu.io import write_geotiff
+from gsky_tpu.io.png import (decode_png, encode_async, encode_pool_stats,
+                             reset_encode_pool)
+from gsky_tpu.pipeline import tile_stages
+from gsky_tpu.resilience import faults
+from gsky_tpu.server.config import ConfigWatcher
+from gsky_tpu.server.metrics import MetricsLogger
+from gsky_tpu.server.ows import OWSServer
+
+UTM55 = parse_crs("EPSG:32755")
+DATE = "2020-01-10T00:00:00.000Z"
+# granules sit around lon 148.0-148.3, lat -35.2..-35.4 (the shared
+# fixture footprint); bbox in EPSG:3857
+BBOX3857 = "16478548,-4211230,16489679,-4198025"
+SIZE = 512
+
+
+def _tif(root, name, *, origin=(590000.0, 6105000.0), crs=UTM55,
+         px=30.0, bands=1, seed=1):
+    """One int16 granule named so the crawler dates it 2020-01-10."""
+    rng = np.random.default_rng(seed)
+    gt = GeoTransform(origin[0], px, 0.0, origin[1], 0.0, -px)
+    shape = (bands, SIZE, SIZE) if bands > 1 else (SIZE, SIZE)
+    data = rng.uniform(200, 3000, shape).astype(np.int16)
+    data[..., : SIZE // 8, : SIZE // 8] = -999
+    p = os.path.join(root, name)
+    write_geotiff(p, data, gt, crs, nodata=-999)
+    return p
+
+
+def _ingest(store, path, namespace=None):
+    rec = extract(path, approx_stats=True)
+    assert not rec.get("error"), rec
+    if namespace is not None:
+        for ds in rec["geo_metadata"]:
+            ds["namespace"] = namespace
+    store.ingest(rec)
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tilepipe")
+    data = root / "data"
+    data.mkdir()
+    store = MASStore()
+    # two overlapping UTM granules sharing one product namespace (the
+    # single-product mosaic every byte-identity case renders)
+    _ingest(store, _tif(str(data), "MOSA_20200110.tif", seed=1),
+            namespace="MOS")
+    _ingest(store, _tif(str(data), "MOSB_20200110.tif", seed=2,
+                        origin=(590000.0 + SIZE * 30 // 2,
+                                6105000.0 - SIZE * 30 // 4)),
+            namespace="MOS")
+    # a UTM + EPSG:4326 pair over the same area: mixed-CRS granule sets
+    # fall off the single-group fused path on BOTH modes
+    _ingest(store, _tif(str(data), "MCRSA_20200110.tif", seed=3),
+            namespace="MCRS")
+    _ingest(store, _tif(str(data), "MCRSB_20200110.tif", seed=4,
+                        origin=(147.9, -35.0), crs=EPSG4326,
+                        px=0.6 / SIZE),
+            namespace="MCRS")
+    # one 3-band scene for the packed-RGBA ladder rung
+    _ingest(store, _tif(str(data), "S2RGB_20200110.tif", bands=3, seed=5))
+    # degraded mosaic: granule B's file is corrupted AFTER ingestion, so
+    # its window decode fails deterministically (1/2 <= the degradation
+    # budget -> a partial mosaic, not an error)
+    _ingest(store, _tif(str(data), "DEGA_20200110.tif", seed=6),
+            namespace="DEG")
+    broken = _tif(str(data), "DEGB_20200110.tif", seed=7,
+                  origin=(590000.0 + SIZE * 30 // 2,
+                          6105000.0 - SIZE * 30 // 4))
+    _ingest(store, broken, namespace="DEG")
+    with open(broken, "wb") as fp:
+        fp.write(b"this is no longer a GeoTIFF")
+
+    palette = {"interpolate": True, "colours": [
+        {"R": 0, "G": 0, "B": 128, "A": 255},
+        {"R": 255, "G": 255, "B": 0, "A": 255}]}
+    layers = [
+        {"name": "mosaic", "data_source": str(data),
+         "rgb_products": ["MOS"], "time_generator": "mas",
+         "palette": palette},
+        {"name": "mosaic_bi", "data_source": str(data),
+         "rgb_products": ["MOS"], "resample": "bilinear",
+         "time_generator": "mas", "palette": palette},
+        {"name": "mosaic_cu", "data_source": str(data),
+         "rgb_products": ["MOS"], "resample": "cubic",
+         "time_generator": "mas", "palette": palette},
+        {"name": "multicrs", "data_source": str(data),
+         "rgb_products": ["MCRS"], "time_generator": "mas",
+         "palette": palette},
+        {"name": "rgb", "data_source": str(data),
+         "rgb_products": ["S2RGB_20200110_b1", "S2RGB_20200110_b2",
+                          "S2RGB_20200110_b3"],
+         "resample": "bilinear", "time_generator": "mas"},
+        {"name": "degraded", "data_source": str(data),
+         "rgb_products": ["DEG"], "time_generator": "mas",
+         "palette": palette},
+    ]
+    conf_dir = root / "conf"
+    conf_dir.mkdir()
+    (conf_dir / "config.json").write_text(json.dumps({
+        "service_config": {"ows_hostname": "", "mas_address": "inproc"},
+        "layers": layers}))
+
+    mas_client = MASClient(store)
+    watcher = ConfigWatcher(str(conf_dir),
+                            mas_factory=lambda addr: mas_client,
+                            install_signal=False)
+    # gateway=None: the serving gateway's response cache + singleflight
+    # would satisfy the second fetch of every pair from cache and turn
+    # the byte-identity comparison into a tautology
+    server = OWSServer(watcher, mas_factory=lambda addr: mas_client,
+                       metrics=MetricsLogger(), gateway=None)
+    return {"server": server, "watcher": watcher}
+
+
+def _get(env, path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        client = TestClient(TestServer(env["server"].app()))
+        await client.start_server()
+        try:
+            resp = await client.get(path)
+            return (resp.status, resp.content_type, await resp.read(),
+                    dict(resp.headers))
+        finally:
+            await client.close()
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+def _getmap(layer, fmt="image/png", size=256):
+    return (f"/ows?service=WMS&request=GetMap&version=1.3.0"
+            f"&layers={layer}&crs=EPSG:3857&bbox={BBOX3857}"
+            f"&width={size}&height={size}&format={fmt}&time={DATE}")
+
+
+def _fetch_both(env, path):
+    """The same request through the serial then the staged path."""
+    old = os.environ.get("GSKY_TILE_PIPELINE")
+    try:
+        os.environ["GSKY_TILE_PIPELINE"] = "0"
+        serial = _get(env, path)
+        os.environ["GSKY_TILE_PIPELINE"] = "1"
+        staged = _get(env, path)
+    finally:
+        if old is None:
+            os.environ.pop("GSKY_TILE_PIPELINE", None)
+        else:
+            os.environ["GSKY_TILE_PIPELINE"] = old
+    return serial, staged
+
+
+class TestByteIdentity:
+    @pytest.mark.parametrize("layer,fmt,ctype", [
+        ("mosaic", "image/png", "image/png"),
+        ("mosaic_bi", "image/png", "image/png"),
+        ("mosaic_cu", "image/png", "image/png"),
+        ("multicrs", "image/png", "image/png"),
+        ("rgb", "image/png", "image/png"),
+        ("mosaic", "image/jpeg", "image/jpeg"),
+    ])
+    def test_staged_matches_serial(self, env, layer, fmt, ctype):
+        serial, staged = _fetch_both(env, _getmap(layer, fmt))
+        assert serial[0] == 200, serial[2][:300]
+        assert staged[0] == 200, staged[2][:300]
+        assert serial[1] == staged[1] == ctype
+        assert serial[2] == staged[2]
+        if ctype == "image/png":
+            assert decode_png(staged[2]).shape == (256, 256, 4)
+
+    def test_staged_output_not_empty(self, env):
+        _, staged = _fetch_both(env, _getmap("mosaic"))
+        rgba = decode_png(staged[2])
+        # the mosaic has real data: some opaque, non-uniform pixels
+        assert (rgba[..., 3] == 255).any()
+        assert len(np.unique(rgba[..., 0])) > 4
+
+    def test_degraded_partial_mosaic(self, env):
+        """Granule B's file is corrupt: both modes must serve the SAME
+        partial mosaic, labelled degraded — under an injected decode
+        latency fault, which stresses the stage overlap without
+        perturbing bytes (rate-1.0 latency clauses draw no RNG, so the
+        fault sequence is identical across the two runs)."""
+        faults.configure("decode:latency:1ms")
+        try:
+            serial, staged = _fetch_both(env, _getmap("degraded"))
+        finally:
+            faults.reset()
+        assert serial[0] == 200, serial[2][:300]
+        assert staged[0] == 200, staged[2][:300]
+        assert serial[3].get("X-GSKY-Degraded") == "decode"
+        assert staged[3].get("X-GSKY-Degraded") == "decode"
+        assert serial[2] == staged[2]
+
+    def test_total_decode_loss_identical_error(self, env):
+        """decode:error:1.0 fails every scene load AND every window
+        decode: both modes must raise the same TooManyFailures into the
+        same 503 body (the staged path degrades through the identical
+        fallback ladder, never a divergent error shape)."""
+        from gsky_tpu.pipeline.scene_cache import default_scene_cache
+        default_scene_cache.clear()    # force both modes through decode
+        faults.configure("decode:error:1.0", seed=0)
+        try:
+            serial, staged = _fetch_both(env, _getmap("mosaic"))
+        finally:
+            faults.reset()
+        assert serial[0] == staged[0] == 503
+        assert serial[2] == staged[2]
+        assert b"decode failures exceed" in staged[2]
+
+
+class TestStageTelemetry:
+    def test_debug_tile_stages_and_knee(self, env):
+        old = os.environ.get("GSKY_TILE_PIPELINE")
+        try:
+            os.environ["GSKY_TILE_PIPELINE"] = "1"
+            status, _, body, _ = _get(env, _getmap("mosaic"))
+            assert status == 200
+            status, _, body, _ = _get(env, "/debug")
+        finally:
+            if old is None:
+                os.environ.pop("GSKY_TILE_PIPELINE", None)
+            else:
+                os.environ["GSKY_TILE_PIPELINE"] = old
+        assert status == 200
+        doc = json.loads(body)
+        ts = doc["tile_stages"]
+        assert ts["tiles"] >= 1
+        for k in ("plan_s", "index_s", "decode_s", "dispatch_s",
+                  "readback_s", "encode_s"):
+            assert k in ts, ts
+        assert "decode" in ts["gates"] and "dispatch" in ts["gates"]
+        assert ts["gates"]["dispatch"]["entries"] >= 1
+        assert ts["encode_pool"]["encoded"] >= 1
+        gw = doc["executor"]["gather_window"]
+        assert "batch_knee" in gw and "tile_ms" in gw
+
+    def test_serial_path_records_no_tile_stages(self, env):
+        """The escape hatch must not half-engage: with the pipeline off
+        no staged spans are recorded for the request."""
+        m = MetricsLogger()
+        before = env["server"].metrics
+        env["server"].metrics = m
+        old = os.environ.get("GSKY_TILE_PIPELINE")
+        try:
+            os.environ["GSKY_TILE_PIPELINE"] = "0"
+            status, _, _, _ = _get(env, _getmap("mosaic"))
+        finally:
+            env["server"].metrics = before
+            if old is None:
+                os.environ.pop("GSKY_TILE_PIPELINE", None)
+            else:
+                os.environ["GSKY_TILE_PIPELINE"] = old
+        assert status == 200
+        assert "tile_stages" not in m.summary()
+
+
+class TestEncodePool:
+    def test_exception_fans_out_to_awaiter(self):
+        reset_encode_pool()
+
+        def boom():
+            raise ValueError("encode exploded")
+
+        async def go():
+            with pytest.raises(ValueError, match="encode exploded"):
+                await encode_async(boom)
+        try:
+            asyncio.new_event_loop().run_until_complete(go())
+            st = encode_pool_stats()
+            assert st["pending"] == 0
+            assert st["errors"] == 1
+        finally:
+            reset_encode_pool()
+
+    def test_concurrent_errors_each_reach_their_awaiter(self):
+        reset_encode_pool()
+
+        def boom(i):
+            raise RuntimeError(f"tile {i}")
+
+        async def go():
+            outs = await asyncio.gather(
+                *[encode_async(boom, i) for i in range(6)],
+                return_exceptions=True)
+            assert sorted(str(e) for e in outs) == \
+                [f"tile {i}" for i in range(6)]
+        try:
+            asyncio.new_event_loop().run_until_complete(go())
+            st = encode_pool_stats()
+            assert st["pending"] == 0
+            assert st["errors"] == 6
+        finally:
+            reset_encode_pool()
+
+    def test_cancellation_releases_pending_slot(self):
+        """A cancelled await must still decrement the pending gauge, or
+        the occupancy telemetry creeps up forever under client aborts."""
+        reset_encode_pool()
+
+        def slow():
+            time.sleep(0.2)
+            return b"late"
+
+        async def go():
+            task = asyncio.ensure_future(encode_async(slow))
+            await asyncio.sleep(0.05)     # encode is on the pool now
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+        try:
+            asyncio.new_event_loop().run_until_complete(go())
+            # the pool thread finishes its sleep, then the finally runs
+            deadline = time.time() + 5
+            while (encode_pool_stats()["pending"] != 0
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            st = encode_pool_stats()
+            assert st["pending"] == 0
+        finally:
+            reset_encode_pool()
+
+    def test_spans_and_result_round_trip(self):
+        reset_encode_pool()
+
+        async def go():
+            spans = {}
+            out = await encode_async(lambda: b"png-bytes", spans=spans)
+            assert out == b"png-bytes"
+            assert spans["encode_s"] >= 0.0
+            assert spans["encode_queue_max"] >= 1
+        try:
+            asyncio.new_event_loop().run_until_complete(go())
+        finally:
+            reset_encode_pool()
+
+
+class TestStageGate:
+    def test_release_on_exception(self):
+        tile_stages.reset_gates()
+        try:
+            gate = tile_stages._gate("dispatch")
+            with pytest.raises(RuntimeError):
+                with gate.enter():
+                    raise RuntimeError("dispatch blew up")
+            # every slot must be back: `limit` concurrent entries
+            # acquire without blocking
+            entered = []
+            import contextlib
+            with contextlib.ExitStack() as stack:
+                for _ in range(gate.limit):
+                    stack.enter_context(gate.enter())
+                    entered.append(1)
+            assert len(entered) == gate.limit
+            st = gate.stats()
+            assert st["waiting"] == 0
+            assert st["entries"] == 1 + gate.limit
+        finally:
+            tile_stages.reset_gates()
+
+    def test_queue_highwater_lands_in_spans(self):
+        tile_stages.reset_gates()
+        try:
+            gate = tile_stages._gate("decode")
+            spans = {}
+            with gate.enter(spans, "decode_queue_max"):
+                pass
+            assert spans["decode_queue_max"] == 1
+        finally:
+            tile_stages.reset_gates()
+
+    def test_env_sizing(self, monkeypatch):
+        monkeypatch.setenv("GSKY_TILE_DISPATCH_SLOTS", "5")
+        tile_stages.reset_gates()
+        try:
+            assert tile_stages._gate("dispatch").limit == 5
+        finally:
+            tile_stages.reset_gates()
+
+
+class TestPrewarm:
+    def test_layer_specs_from_config(self, env):
+        from gsky_tpu.server.prewarm import layer_specs
+        specs = layer_specs(env["watcher"].configs)
+        assert ("near", 1, True, 0) in specs
+        assert ("bilinear", 1, True, 0) in specs
+        assert ("cubic", 1, True, 0) in specs
+        assert ("bilinear", 3, True, 0) in specs
+
+    def test_prewarm_then_render_zero_recompile(self, env):
+        """After prewarming the configured layers at a tile size no
+        other test uses (128 px), rendering that exact shape through
+        the staged server path must compile nothing new."""
+        from gsky_tpu.server.prewarm import compile_count, prewarm
+        warm = prewarm(env["watcher"].configs, sizes=[128],
+                       bucket=512, max_scenes=2)
+        assert warm["failures"] == 0
+        assert warm["programs"] > 0
+        c0 = compile_count()
+        old = os.environ.get("GSKY_TILE_PIPELINE")
+        try:
+            os.environ["GSKY_TILE_PIPELINE"] = "1"
+            for layer in ("mosaic", "mosaic_bi", "rgb"):
+                status, _, body, _ = _get(
+                    env, _getmap(layer, size=128))
+                assert status == 200, body[:300]
+        finally:
+            if old is None:
+                os.environ.pop("GSKY_TILE_PIPELINE", None)
+            else:
+                os.environ["GSKY_TILE_PIPELINE"] = old
+        assert compile_count() - c0 == 0
+
+    def test_prewarm_is_idempotent_in_process(self, env):
+        """A second identical prewarm is pure jit-cache hits."""
+        from gsky_tpu.server.prewarm import prewarm
+        prewarm(env["watcher"].configs, sizes=[128], bucket=512,
+                max_scenes=2)
+        again = prewarm(env["watcher"].configs, sizes=[128],
+                        bucket=512, max_scenes=2)
+        assert again["compiles"] == 0
+        assert again["failures"] == 0

@@ -44,6 +44,18 @@ dropped connection fails the soak.  Also requires /debug's
 retry, injected-fault, breaker-failure and degraded-response counters.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario chaos --seconds 30
+
+``--scenario burst``: the deploy-then-traffic-spike pattern the staged
+GetMap path (pipeline/tile_stages.py) and the shape-bucket prewarm
+(server/prewarm.py) exist for.  Prewarms the layer programs, takes one
+warm lap, then storms the server with concurrent distinct-tile GetMaps
+and requires (a) every response is a clean 200 PNG, (b) ZERO fresh XLA
+compiles during the burst (the `install_compile_probe` counter), and
+(c) /debug's ``tile_stages`` block shows the stage overlap actually
+engaged: gate entries, encode-pool throughput, and a >1 queue
+high-water on at least one stage.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario burst --seconds 30
 """
 
 from __future__ import annotations
@@ -74,7 +86,8 @@ def main(argv=None):
     ap.add_argument("--seconds", type=float, default=120.0)
     ap.add_argument("--conc", type=int, default=8)
     ap.add_argument("--max-rss-growth-mb", type=float, default=256.0)
-    ap.add_argument("--scenario", choices=("churn", "hot", "wcs", "chaos"),
+    ap.add_argument("--scenario",
+                    choices=("churn", "hot", "wcs", "chaos", "burst"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -132,6 +145,17 @@ def main(argv=None):
                 "cache_max_age": 3,
                 "wcs_max_width": 4096, "wcs_max_height": 4096,
                 "wcs_max_tile_width": 256,
+                "wcs_max_tile_height": 256},
+                # burst twin: a SINGLE product, so the storm also
+                # exercises the n_exprs=1 fused composite program, not
+                # just the 3-expr RGB one the other layers dispatch
+                {
+                "name": "landsat_burst", "title": "burst soak",
+                "data_source": root,
+                "rgb_products": ["LC08_20200110_T1"],
+                "time_generator": "mas",
+                "wcs_max_width": 4096, "wcs_max_height": 4096,
+                "wcs_max_tile_width": 256,
                 "wcs_max_tile_height": 256}],
         }, fp)
     watcher = ConfigWatcher(conf_dir, mas_factory=lambda a: mas_client,
@@ -174,6 +198,8 @@ def main(argv=None):
         return run_wcs(args, watcher, mas_client, merc, boot)
     if args.scenario == "chaos":
         return run_chaos(args, watcher, mas_client, merc, boot)
+    if args.scenario == "burst":
+        return run_burst(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -499,6 +525,128 @@ def run_chaos(args, watcher, mas_client, merc, boot) -> int:
           and sum(res.get("faults_injected", {}).values()) > 0
           and res.get("degraded_responses", 0) > 0
           and any(b.get("failures", 0) > 0 for b in breakers.values()))
+    print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+    return 0 if ok else 1
+
+
+def run_burst(args, watcher, mas_client, merc, boot) -> int:
+    """Prewarm, one warm lap, then a concurrent distinct-tile GetMap
+    storm: every response must be a clean 200 PNG, the burst itself
+    must trigger ZERO fresh XLA compiles, and /debug must show the
+    staged tile path's gates and encode pool visibly overlapping."""
+    import threading
+
+    import numpy as np
+
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+    from gsky_tpu.server.prewarm import (compile_count,
+                                         install_compile_probe, prewarm)
+
+    # the scenario *is* the staged path — don't let an inherited
+    # escape-hatch setting silently soak the serial path instead
+    os.environ.pop("GSKY_TILE_PIPELINE", None)
+    install_compile_probe()
+    # gateway off: a response-cache hit would bypass the pipeline and
+    # the zero-compile claim would be about the cache, not the prewarm
+    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                       metrics=MetricsLogger(), gateway=None)
+    host = boot(server)
+
+    warm = prewarm(watcher.configs)
+
+    grid = 6
+    frac = np.linspace(0.0, 0.75, grid)
+    tiles = [(float(fx), float(fy)) for fx in frac for fy in frac]
+    w = merc.width * 0.25
+    # landsat_burst (single product) takes the staged fused path;
+    # landsat's 4 products sit at DISTINCT dates, so at one timestamp
+    # the fused prep declines and it exercises the modular fallback —
+    # the zero-compile requirement below covers BOTH paths
+    layers = ("landsat_burst", "landsat")
+
+    def url_for(layer: str, fx: float, fy: float) -> str:
+        bb = (f"{merc.xmin + fx * merc.width},"
+              f"{merc.ymin + fy * merc.height},"
+              f"{merc.xmin + fx * merc.width + w},"
+              f"{merc.ymin + fy * merc.height + w}")
+        return (f"http://{host}/ows?service=WMS&request=GetMap"
+                f"&version=1.3.0&layers={layer}&crs=EPSG:3857&bbox={bb}"
+                f"&width=256&height=256&format=image/png"
+                f"&time=2020-01-10T00:00:00.000Z")
+
+    def fetch(url: str) -> bool:
+        try:
+            with urllib.request.urlopen(url, timeout=120) as r:
+                return (r.status == 200
+                        and r.read()[:8] == b"\x89PNG\r\n\x1a\n")
+        except Exception:
+            return False
+
+    # warm lap: one serial request per layer pays the host-side caches
+    # (geo transforms, scene decode+upload) and any residual program
+    # prewarm's win=None sweep missed; compiles HERE are reported but
+    # allowed — the burst after this line is what must stay compile-free
+    warm_lap_bad = sum(not fetch(url_for(lay, *tiles[0]))
+                       for lay in layers)
+    warm_lap_compiles = compile_count() - warm["compiles"]
+
+    c0 = compile_count()
+    counter = itertools.count()
+    bad = [0]
+    n_by = {lay: 0 for lay in layers}
+    lock = threading.Lock()
+
+    def one(_):
+        i = next(counter)
+        lay = layers[i % len(layers)]
+        ok = fetch(url_for(lay, *tiles[i % len(tiles)]))
+        with lock:
+            n_by[lay] += 1
+            if not ok:
+                bad[0] += 1
+
+    t_end = time.time() + args.seconds
+    with cf.ThreadPoolExecutor(args.conc) as ex:
+        while time.time() < t_end:
+            list(ex.map(one, range(args.conc * 4)))
+    burst_compiles = compile_count() - c0
+    n_done = sum(n_by.values())
+
+    with urllib.request.urlopen(f"http://{host}/debug",
+                                timeout=30) as r:
+        dbg = json.loads(r.read())
+    ts = dbg.get("tile_stages", {})
+    gates = ts.get("gates", {})
+    pool = ts.get("encode_pool", {})
+    overlap_hw = max([g.get("queue_max", 0) for g in gates.values()]
+                     + [pool.get("queue_max", 0)] or [0])
+
+    out = {
+        "scenario": "burst",
+        "prewarm": warm,
+        "warm_lap": {"failed": warm_lap_bad,
+                     "compiles": warm_lap_compiles},
+        "requests": n_by, "failed": bad[0],
+        "burst_compiles": burst_compiles,
+        "tile_stages": {
+            "tiles": ts.get("tiles", 0),
+            "gates": {n: {k: g.get(k) for k in
+                          ("limit", "entries", "queue_max")}
+                      for n, g in gates.items()},
+            "encode_pool": {k: pool.get(k) for k in
+                            ("workers", "encoded", "queue_max")},
+        },
+    }
+    print(json.dumps(out))
+    ok = (warm["failures"] == 0 and warm_lap_bad == 0
+          and n_done > 0 and bad[0] == 0
+          and burst_compiles == 0
+          and ts.get("tiles", 0) >= n_by["landsat_burst"]
+          and gates.get("decode", {}).get("entries", 0) > 0
+          and gates.get("dispatch", {}).get("entries", 0) > 0
+          and pool.get("encoded", 0) > 0
+          and overlap_hw >= 2)
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
 
