@@ -15,9 +15,10 @@ backend's health.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Callable, Dict
+from typing import Callable, Dict, List
 
 from .registry import registry
 
@@ -62,8 +63,27 @@ class CircuitBreaker:
         self.failures = 0
         self.probes = 0
         self.rejections = 0
+        self._listeners: List[Callable] = []
         if register:
             registry.register_breaker(self)
+
+    def add_listener(self, fn: Callable) -> None:
+        """Subscribe ``fn(breaker, old_state, new_state)`` to state
+        transitions — fired outside the breaker lock.  This is how the
+        fleet router folds breaker trips into node health: an OPEN
+        transition is an immediate dead-node report, not just a skipped
+        dispatch."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def _notify(self, old: str, new: str, listeners) -> None:
+        for fn in listeners:
+            try:
+                fn(self, old, new)
+            except Exception:
+                logging.getLogger("gsky.resilience.breaker").exception(
+                    "breaker %s listener failed", self.name)
 
     @property
     def state(self) -> str:
@@ -101,17 +121,26 @@ class CircuitBreaker:
             self.successes += 1
             self._consecutive = 0
             self._probing = False
+            old = self._state
             self._state = self.CLOSED
+            listeners = list(self._listeners) if old != self.CLOSED else ()
+        if listeners:
+            self._notify(old, self.CLOSED, listeners)
 
     def record_failure(self) -> None:
         with self._lock:
             self.failures += 1
             self._consecutive += 1
+            old = self._state
             if self._state == self.HALF_OPEN:
                 self._trip()
             elif self._state == self.CLOSED and \
                     self._consecutive >= self.failure_threshold:
                 self._trip()
+            new = self._state
+            listeners = list(self._listeners) if new != old else ()
+        if listeners:
+            self._notify(old, new, listeners)
 
     def _trip(self) -> None:
         # caller holds self._lock

@@ -10,13 +10,23 @@ Each comma-separated clause is ``site:kind:arg``:
 ``site``
     the name a call site passes to :func:`inject` — the wired sites are
     ``mas`` (index client transport), ``worker`` (gRPC stub call),
-    ``decode`` (granule window decode + scene-cache load) and ``pool``
-    (decode subprocess dispatch).
+    ``decode`` (granule window decode + scene-cache load), ``pool``
+    (decode subprocess dispatch) and ``node`` (worker-node RPC entry:
+    whole-process faults for fleet chaos).
 ``error:RATE``
     raise :class:`InjectedFault` with probability ``RATE`` (0..1).
 ``latency:DURATION[:RATE]``
     sleep ``DURATION`` (``500ms``, ``2s``, or bare seconds) with
     probability ``RATE`` (default 1.0) before the real call proceeds.
+``slow:DURATION[:RATE]``
+    alias of ``latency`` — reads better in node-level chaos specs
+    (``node:slow:2s:0.5`` = a degraded node, not a degraded call).
+``hang:DURATION[:RATE]``
+    sleep ``DURATION`` *without* raising — simulates a wedged node that
+    holds the RPC open until the caller's deadline (or a hedge) fires.
+``kill:RATE``
+    ``os._exit`` the whole process with probability ``RATE`` — the
+    worker node dies mid-RPC exactly the way SIGKILL would take it.
 
 Outcomes are drawn from a per-site ``random.Random`` seeded from
 ``GSKY_FAULTS_SEED`` (default 0) xor a CRC of the site name, so a given
@@ -95,9 +105,11 @@ def parse_spec(spec: str) -> Dict[str, List[_Rule]]:
         site, kind = parts[0].strip(), parts[1].strip()
         if kind == "error":
             rule = _Rule("error", float(parts[2]))
-        elif kind == "latency":
+        elif kind == "kill":
+            rule = _Rule("kill", float(parts[2]))
+        elif kind in ("latency", "slow", "hang"):
             rate = float(parts[3]) if len(parts) > 3 else 1.0
-            rule = _Rule("latency", rate, _duration(parts[2]))
+            rule = _Rule(kind, rate, _duration(parts[2]))
         else:
             raise ValueError(f"unknown fault kind {kind!r} in {clause!r}")
         if not 0.0 <= rule.rate <= 1.0:
@@ -147,15 +159,25 @@ def inject(site: str) -> None:
     if st is None:
         return
     delay = 0.0
+    die = False
     boom: Optional[InjectedFault] = None
     with st.lock:
         for rule in st.rules:
             if rule.rate >= 1.0 or st.rng.random() < rule.rate:
-                if rule.kind == "latency":
+                if rule.kind in ("latency", "slow", "hang"):
                     delay += rule.latency_s
+                elif rule.kind == "kill":
+                    die = True
+                    break
                 else:
                     boom = InjectedFault(site)
                     break
+    if die:
+        # the node dies the way SIGKILL takes it: no flush, no goodbye —
+        # callers must detect it via transport failure + phi accrual
+        from .registry import registry
+        registry.count_fault(site)
+        os._exit(137)
     if delay > 0.0:
         time.sleep(delay)
     if boom is not None:

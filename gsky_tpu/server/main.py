@@ -95,7 +95,21 @@ def main(argv=None):
     metrics = MetricsLogger(args.log_dir, verbose=args.verbose)
     server = OWSServer(watcher, mas_factory, metrics,
                        static_dir=args.static, temp_dir=args.temp_dir)
-    web.run_app(server.app(), host=args.host, port=args.port,
+    app = server.app()
+
+    # graceful drain on SIGTERM/SIGINT: aiohttp's run_app stops the
+    # listen socket, then fires on_shutdown while in-flight handlers
+    # keep running — server.shutdown() gates new /ows work, waits for
+    # the in-flight count to hit zero, flushes metrics and releases the
+    # worker clients before the loop tears down.
+    async def _drain(app_):
+        ok = await server.shutdown()
+        if not ok:
+            print("gsky-ows drain timed out with requests in flight",
+                  file=sys.stderr)
+
+    app.on_shutdown.append(_drain)
+    web.run_app(app, host=args.host, port=args.port,
                 print=lambda *a: print(
                     f"gsky-ows listening on {args.host}:{args.port}"))
     return 0

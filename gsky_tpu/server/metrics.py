@@ -274,7 +274,33 @@ class MetricsLogger:
             out["kernels"] = kernel_ledger.stats()
         except Exception:   # observability must never fail a request
             pass
+        try:
+            # per-node health states, routed/hedged/re-routed counts,
+            # ring generation — one entry per live fleet router
+            from ..fleet import fleet_stats
+            fs = fleet_stats()
+            if fs:
+                out["fleet"] = fs
+        except Exception:   # observability must never fail a request
+            pass
         return out
+
+    def flush(self) -> None:
+        """Drain-time flush: push buffered metrics records to durable
+        storage before the process exits (the kernel ledger needs no
+        flush — each verdict is an O_APPEND write of its own)."""
+        with self._lock:
+            if self._fp is not None:
+                try:
+                    self._fp.flush()
+                    os.fsync(self._fp.fileno())
+                except OSError:
+                    pass
+            else:
+                try:
+                    sys.stdout.flush()
+                except Exception:
+                    pass
 
     def write(self, info: Dict):
         if not self.log_dir and not self.verbose:

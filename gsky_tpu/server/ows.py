@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from ..geo.crs import EPSG3857, EPSG4326, parse_crs
 from ..geo.transform import (BBox, GeoTransform, pixel_resolution, split_bbox,
                              transform_bbox)
+from ..fleet import DrainController, Draining
 from ..geo import geometry as geom
 from ..index.client import MASClient
 from ..index.store import fmt_time, parse_time
@@ -105,6 +106,9 @@ class OWSServer:
         # calls collide and wedge the profiler (threading.Lock, not
         # asyncio.Lock — handlers may run on different event loops)
         self._profile_mutex = threading.Lock()
+        # graceful drain (SIGTERM): the accept gate for /ows requests —
+        # /debug keeps answering so operators can watch the drain land
+        self.drain = DrainController("ows")
         if self.gateway is not None:
             _register_gateway_invalidation(watcher, self.gateway)
 
@@ -305,6 +309,7 @@ class OWSServer:
             pass
         if self.gateway is not None:
             doc["serving"] = self.gateway.stats()
+        doc["drain"] = self.drain.stats()
         return web.json_response(doc)
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
@@ -351,9 +356,57 @@ class OWSServer:
             return web.FileResponse(index)
         raise web.HTTPNotFound()
 
+    # -- graceful drain (SIGTERM) -------------------------------------------
+
+    async def shutdown(self, timeout_s: Optional[float] = None) -> bool:
+        """Drain protocol: stop accepting /ows requests (new ones get a
+        fast 503 + Retry-After), let every in-flight request run to
+        completion, flush the metrics sink (kernel-ledger verdicts are
+        already per-record durable), then release the worker clients —
+        whose own close() broadcasts nothing new will be dispatched.
+        Returns False when in-flight work outlived the timeout."""
+        if timeout_s is None:
+            try:
+                timeout_s = float(
+                    os.environ.get("GSKY_DRAIN_TIMEOUT_S", "30") or 30)
+            except ValueError:
+                timeout_s = 30.0
+        self.drain.start_drain()
+        ok = await asyncio.to_thread(self.drain.wait_drained, timeout_s)
+        st = self.drain.stats()
+        log.info("ows drain %s: completed=%d refused=%d inflight=%d",
+                 "complete" if ok else "TIMED OUT",
+                 st["completed"], st["refused"], st["inflight"])
+        self.metrics.flush()
+        self.close()
+        return ok
+
+    def close(self) -> None:
+        """Release per-namespace pipelines and their worker clients
+        (idempotent — WorkerClient.close() tolerates repeats)."""
+        for _, pipe in self._pipelines.values():
+            if pipe.remote is not None:
+                try:
+                    pipe.remote.close()
+                except Exception:
+                    pass
+
     # -- dispatch (generalHandler, `ows.go:1444-1530`) ----------------------
 
     async def handle(self, request: web.Request) -> web.Response:
+        try:
+            with self.drain.track():
+                return await self._handle(request)
+        except Draining:
+            # refused at the gate: the balancer should close this
+            # connection and retry against a peer gateway
+            resp = _exception_response(
+                OWSError("server is draining", "ServerBusy", status=503),
+                headers={"Retry-After": "5"})
+            resp.headers["Connection"] = "close"
+            return resp
+
+    async def _handle(self, request: web.Request) -> web.Response:
         collector = self.metrics.collector()
         q = normalise_query(request.query)
         ns = request.match_info.get("namespace", "")
@@ -395,11 +448,17 @@ class OWSServer:
             return resp
         except AdmissionShed as e:
             # shed, don't queue into latency collapse: fast OGC 503 +
-            # Retry-After so well-behaved clients back off
+            # Retry-After so well-behaved clients back off.  When the
+            # fleet knows a shard with spare capacity, name it so a
+            # multi-gateway balancer can steer the retry instead of
+            # re-queueing blind.
             collector.log(503)
+            headers = {"Retry-After": str(e.retry_after)}
+            if getattr(e, "alt_node", None):
+                headers["X-GSKY-Alt-Node"] = e.alt_node
             return _exception_response(
                 OWSError(str(e), "ServerBusy", status=503),
-                headers={"Retry-After": str(e.retry_after)})
+                headers=headers)
         except OWSError as e:
             collector.log(e.status)
             return _exception_response(e)

@@ -22,7 +22,7 @@ import asyncio
 import contextlib
 import os
 import threading
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 
 def _env_int(name: str, default: int) -> int:
@@ -50,14 +50,31 @@ DEFAULT_QUEUE_DEADLINE_S = _env_float("GSKY_ADMIT_QUEUE_S", 5.0)
 
 class AdmissionShed(Exception):
     """Raised when a request waited past the queue deadline; maps to
-    HTTP 503 + Retry-After at the OWS layer."""
+    HTTP 503 + Retry-After at the OWS layer.
 
-    def __init__(self, service_class: str, retry_after: int):
+    ``alt_node``, when set, names the least-loaded healthy worker shard
+    at shed time — surfaced as an ``X-GSKY-Alt-Node`` header so a
+    multi-gateway deployment's balancer can steer the retry toward
+    spare fleet capacity instead of re-queueing blind."""
+
+    def __init__(self, service_class: str, retry_after: int,
+                 alt_node: Optional[str] = None):
         super().__init__(
             f"{service_class} service at capacity; retry after "
             f"{retry_after}s")
         self.service_class = service_class
         self.retry_after = retry_after
+        self.alt_node = alt_node
+
+
+def _fleet_advisor() -> Optional[str]:
+    """Default shed advisor: the least-loaded healthy node across the
+    live fleet routers (None when no fleet is wired)."""
+    try:
+        from ..fleet import least_loaded_node
+        return least_loaded_node()
+    except Exception:
+        return None
 
 
 class _ClassState:
@@ -90,13 +107,16 @@ def _release_orphaned_permit(st: _ClassState):
 
 class AdmissionController:
     def __init__(self, limits: Optional[Dict[str, int]] = None,
-                 queue_deadline_s: float = DEFAULT_QUEUE_DEADLINE_S):
+                 queue_deadline_s: float = DEFAULT_QUEUE_DEADLINE_S,
+                 shed_advisor: Optional[Callable[[], Optional[str]]]
+                 = _fleet_advisor):
         merged = dict(DEFAULT_LIMITS)
         if limits:
             merged.update(limits)
         self._lock = threading.Lock()
         self._classes = {svc: _ClassState(n) for svc, n in merged.items()}
         self.queue_deadline_s = queue_deadline_s
+        self.shed_advisor = shed_advisor
 
     def _state(self, service_class: str) -> _ClassState:
         st = self._classes.get(service_class)
@@ -129,9 +149,16 @@ class AdmissionController:
         if not ok:
             with self._lock:
                 st.shed += 1
+            alt = None
+            if self.shed_advisor is not None:
+                try:
+                    alt = self.shed_advisor()
+                except Exception:
+                    alt = None
             raise AdmissionShed(
                 service_class,
-                retry_after=max(1, int(round(self.queue_deadline_s))))
+                retry_after=max(1, int(round(self.queue_deadline_s))),
+                alt_node=alt)
         with self._lock:
             st.in_use += 1
             st.admitted += 1

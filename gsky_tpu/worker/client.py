@@ -1,28 +1,45 @@
-"""Client-side fan-out to worker nodes.
+"""Client-side fleet dispatch to worker nodes.
 
-Role of the reference's `processor/tile_grpc.go`: a shuffled connection
-pool over ``worker_nodes`` with round-robin dispatch
-(`tile_grpc.go:99-125`), a concurrency limiter of
-``GrpcConcLimit x nodes`` (`tile_grpc.go:222`), per-granule warp RPCs,
-and worker-metrics accumulation (`tile_grpc.go:262-272`).
+Role of the reference's `processor/tile_grpc.go` — a connection pool
+over ``worker_nodes`` with per-granule warp RPCs, a concurrency limiter
+of ``GrpcConcLimit x nodes`` (`tile_grpc.go:222`) and worker-metrics
+accumulation — upgraded from static round-robin to fleet routing
+(see docs/FLEET.md):
+
+- tasks carrying a route key ride the consistent-hash ring, so repeat
+  requests for one tile land on the shard whose scene cache, kernel
+  ledger and XLA cache are already warm for it;
+- node health (phi-accrual over heartbeats fed from real RPC traffic
+  plus active ``worker_info`` probes) gates the candidate order, and a
+  breaker trip reports the node dead immediately;
+- stragglers are hedged onto the next ring node past an adaptive p99
+  delay, inside a token-bucket hedge budget;
+- nodes answering ``backpressure:`` / ``draining:`` are failed over
+  without breaker penalty (they are alive), and an all-busy fleet
+  surfaces as the *retryable* :class:`NodeBusy` so the retry policy's
+  jittered backoff applies instead of an instant hard failure.
 """
 
 from __future__ import annotations
 
 import concurrent.futures as cf
 import itertools
+import json
 import logging
 import random
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..fleet import DRAINING, FleetRouter, hedged_call, tile_route_key
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
 from ..pipeline.types import GeoTileRequest, Granule
-from ..resilience import (BackendUnavailable, BreakerOpen, clamp_timeout,
-                          faults, get_breaker, registry)
+from ..resilience import (BackendUnavailable, BreakerOpen, RetryPolicy,
+                          call_with_retry, clamp_timeout, faults,
+                          get_breaker, registry)
 from . import gskyrpc_pb2 as pb
 from .serialize import granule_to_pb, unpack_raster
 from .server import METHOD
@@ -30,6 +47,18 @@ from .server import METHOD
 log = logging.getLogger("gsky.worker.client")
 
 DEFAULT_CONC_PER_NODE = 16
+
+
+class NodeBusy(BackendUnavailable):
+    """Every candidate node answered "queue full": the fleet is alive
+    but saturated.  Retryable — the queues drain at pool speed, so a
+    jittered backoff usually lands — unlike its parent, which means the
+    fleet could not answer at all."""
+
+    retryable = True
+
+    def __init__(self, message: str, site: str = "worker"):
+        super().__init__(message, site=site, retry_after=1.0)
 
 
 class ConcLimiter:
@@ -46,9 +75,17 @@ class ConcLimiter:
         self._sem.release()
         return False
 
+    def try_acquire(self) -> bool:
+        """Non-blocking acquire — hedges take a *spare* permit or none:
+        a hedge must never queue behind primaries for a slot."""
+        return self._sem.acquire(blocking=False)
+
+    def release(self) -> None:
+        self._sem.release()
+
 
 class WorkerClient:
-    """Round-robin gRPC client over a shuffled node list."""
+    """Fleet-routed gRPC client over a worker node set."""
 
     def __init__(self, nodes: Sequence[str],
                  conc_per_node: int = DEFAULT_CONC_PER_NODE,
@@ -60,7 +97,11 @@ class WorkerClient:
         nodes = list(nodes)
         random.shuffle(nodes)          # `tile_grpc.go:99-104`
         opts = [("grpc.max_receive_message_length", max_msg),
-                ("grpc.max_send_message_length", max_msg)]
+                ("grpc.max_send_message_length", max_msg),
+                # a node that dies and revives must be re-dialled within
+                # a couple of health-probe beats, not after gRPC's
+                # default reconnect backoff (which grows to 2 minutes)
+                ("grpc.max_reconnect_backoff_ms", 3000)]
         self._channels = [grpc.insecure_channel(n, options=opts)
                           for n in nodes]
         self._stubs = [ch.unary_unary(
@@ -74,12 +115,73 @@ class WorkerClient:
         self.limiter = ConcLimiter(conc_per_node * len(nodes))
         self.timeout = timeout
         self.nodes = nodes
+        self._index = {n: i for i, n in enumerate(nodes)}
         self._max_msg = max_msg
+        self._closed = False
+        self._close_lock = threading.Lock()
+        # jittered backoff for an all-nodes-busy fleet (NodeBusy): the
+        # work queues drain in tens of ms, so short delays suffice
+        self._busy_policy = RetryPolicy(max_attempts=3, base_delay=0.05,
+                                        max_delay=0.5)
+        # fleet routing state: ring + health + hedge over this node set
+        self.fleet = FleetRouter(nodes, name="worker", probe=self._probe)
+        for i, br in enumerate(self._breakers):
+            br.add_listener(self._make_breaker_listener(nodes[i]))
+        if len(nodes) > 1 and self.fleet.monitor.interval_s > 0:
+            self.fleet.monitor.start()
         # persistent fan-out pool: sized to the RPC concurrency cap so
         # per-request thread churn stays off the GetMap hot path
         self._fanout = cf.ThreadPoolExecutor(
             max_workers=conc_per_node * len(nodes),
             thread_name_prefix="gsky-warp-rpc")
+
+    # -- fleet plumbing ------------------------------------------------------
+
+    def _make_breaker_listener(self, node: str):
+        def on_change(br, old, new):
+            # an OPEN breaker is an immediate dead-node report for the
+            # router; a close (successful probe) is a heartbeat
+            if new == br.OPEN:
+                self.fleet.monitor.record_failure(node, fatal=True)
+            elif new == br.CLOSED and old != br.CLOSED:
+                self.fleet.monitor.record_heartbeat(node)
+        return on_change
+
+    def _probe(self, node: str):
+        """Active health probe: one worker_info RPC.  Returns the
+        DRAINING sentinel when the node answered only to say goodbye."""
+        if self._closed:
+            return False
+        i = self._index[node]
+        try:
+            res = self._stubs[i](pb.Task(operation="worker_info"),
+                                 timeout=5.0)
+        except Exception:
+            return False
+        return DRAINING if self._draining(res) else True
+
+    @staticmethod
+    def _draining(res: pb.Result) -> bool:
+        if not res.info_json:
+            return False
+        try:
+            return bool(json.loads(res.info_json).get("draining"))
+        except (ValueError, AttributeError):
+            return False
+
+    @staticmethod
+    def _is_fatal(e: Exception) -> bool:
+        """Does this transport error mean the *node* is gone (connection
+        refused / unreachable), not just this call?"""
+        if isinstance(e, faults.InjectedFault):
+            return False
+        try:
+            import grpc
+            if isinstance(e, grpc.RpcError):
+                return e.code() == grpc.StatusCode.UNAVAILABLE
+        except Exception:
+            pass
+        return isinstance(e, (ConnectionError, OSError))
 
     def autosize(self) -> int:
         """Size the RPC concurrency cap from the workers' actual pool
@@ -99,37 +201,105 @@ class WorkerClient:
                 max_workers=total, thread_name_prefix="gsky-warp-rpc")
         return total
 
-    def process(self, task: pb.Task) -> pb.Result:
-        """Dispatch with per-node health tracking and failover.
+    # -- dispatch ------------------------------------------------------------
 
-        Starts at the round-robin position, skips nodes whose breaker is
-        open, and on transport failure records it against that node and
-        fails over to the next stub — ejecting a sick node costs one
-        failed RPC, not a request.  Only when every node has failed (or
-        is circuit-open) does the error surface, as
+    def process(self, task: pb.Task,
+                route_key: Optional[str] = None) -> pb.Result:
+        """Dispatch with fleet routing, health tracking and failover.
+
+        With a ``route_key``, candidates come from the hash ring
+        (healthy-first, bounded-load, deterministic spill order) and the
+        first attempt may hedge onto the second candidate; without one,
+        the legacy round-robin order applies.  On transport failure the
+        node's breaker and health record it and the task fails over to
+        the next candidate — ejecting a sick node costs one failed RPC,
+        not a request.  Nodes answering ``backpressure:`` / ``draining:``
+        are alive: they fail over without breaker penalty.  Exhaustion
+        surfaces as :class:`NodeBusy` (every node busy — retryable),
+        :class:`BreakerOpen` (every node circuit-open) or
         :class:`BackendUnavailable`.
         """
+        if self._closed:
+            raise BackendUnavailable("worker client is closed",
+                                     site="worker")
         with self.limiter:
-            n = len(self._stubs)
+            return self._dispatch(task, route_key)
+
+    def _dispatch(self, task: pb.Task, route_key: Optional[str]) -> pb.Result:
+        n = len(self._stubs)
+        keyed = (route_key is not None and self.fleet.enabled and n > 1)
+        if keyed:
+            order = [self._index[m]
+                     for m in self.fleet.candidates(route_key)
+                     if m in self._index]
+        else:
             start = next(self._rr)
-            last: Optional[Exception] = None
-            for k in range(n):
-                i = (start + k) % n
-                br = self._breakers[i]
-                if not br.allow():
-                    continue
-                try:
-                    faults.inject("worker")
-                    res = self._stubs[i](task,
-                                         timeout=clamp_timeout(self.timeout))
-                except Exception as e:
-                    br.record_failure()
-                    last = e
-                    if k + 1 < n:
-                        registry.count_retry("worker")
-                    continue
+            order = [(start + k) % n for k in range(n)]
+        timeout = clamp_timeout(self.timeout)
+        busy = 0
+        last: Optional[Exception] = None
+        last_busy = ""
+        for pos, i in enumerate(order):
+            br = self._breakers[i]
+            if not br.allow():
+                continue
+            node = self.nodes[i]
+            started = node        # in-flight load is per dispatch target
+            self.fleet.task_started(started)
+            try:
+                faults.inject("worker")
+                t0 = time.monotonic()
+                if (pos == 0 and keyed and self.fleet.hedge_enabled
+                        and len(order) > 1):
+                    res, hedge_won = self._call_hedged(
+                        task, i, order[1], timeout)
+                    if hedge_won:
+                        i = order[1]
+                        br = self._breakers[i]
+                        node = self.nodes[i]
+                else:
+                    res = self._stubs[i](task, timeout=timeout)
+                dt = time.monotonic() - t0
+            except Exception as e:
+                br.record_failure()
+                self.fleet.node_result(node, ok=False,
+                                       fatal=self._is_fatal(e))
+                last = e
+                if pos + 1 < len(order):
+                    registry.count_retry("worker")
+                    if keyed:
+                        self.fleet.record_reroute()
+                continue
+            finally:
+                self.fleet.task_finished(started)
+            err = res.error or ""
+            if err.startswith("backpressure:"):
+                # alive, just saturated: no breaker penalty, fail over
                 br.record_success()
-                return res
+                self.fleet.node_result(node, ok=True)
+                busy += 1
+                last_busy = err
+                if keyed:
+                    self.fleet.record_reroute()
+                continue
+            if err.startswith("draining:"):
+                # alive, leaving: deregister from routing, fail over
+                br.record_success()
+                self.fleet.node_result(node, ok=True, draining=True)
+                if keyed:
+                    self.fleet.record_reroute()
+                continue
+            # a real answer (success or semantic error): the node lives
+            br.record_success()
+            self.fleet.node_result(node, ok=True, latency_s=dt)
+            if keyed:
+                self.fleet.record_locality(route_key, node)
+            else:
+                self.fleet.record_rr()
+            return res
+        if busy:
+            raise NodeBusy(
+                f"all worker nodes at capacity ({last_busy or 'busy'})")
         if last is None:
             raise BreakerOpen("all worker nodes circuit-open",
                               site="worker")
@@ -138,6 +308,56 @@ class WorkerClient:
             f"all {n} worker node(s) failed (last: {last})",
             site="worker") from last
 
+    def _call_hedged(self, task: pb.Task, i: int, j: int,
+                     timeout: float) -> Tuple[pb.Result, bool]:
+        """First-candidate dispatch with a straggler hedge onto node
+        ``j``.  The hedge consumes a *spare* limiter permit (or does not
+        fire), spends one hedge-budget token, and whichever copy loses
+        is cancelled — its permit freed immediately."""
+        fl = self.fleet
+        permit = [False]
+
+        def primary():
+            fl.hedge.on_primary()
+            return self._stubs[i].future(task, timeout=timeout)
+
+        def hedge():
+            # raising here just means "no hedge" to hedged_call
+            if self._closed:
+                raise RuntimeError("client closed")
+            if not self._breakers[j].allow():
+                raise RuntimeError("hedge target circuit-open")
+            if not fl.hedge.try_hedge():
+                raise RuntimeError("hedge budget exhausted")
+            if not self.limiter.try_acquire():
+                raise RuntimeError("no spare permit for hedge")
+            permit[0] = True
+            try:
+                return self._stubs[j].future(task, timeout=timeout)
+            except Exception:
+                permit[0] = False
+                self.limiter.release()
+                raise
+
+        def on_hedge_cancelled():
+            if permit[0]:
+                permit[0] = False
+                self.limiter.release()
+
+        try:
+            res, hedge_won = hedged_call(
+                primary, hedge, fl.hedge.delay_s(), timeout,
+                on_hedge_cancelled=on_hedge_cancelled)
+            if hedge_won:
+                fl.hedge.record_win()
+            return res, hedge_won
+        finally:
+            # hedge won (or both settled without a fut2 cancel): the
+            # extra permit still held covers a future that has finished
+            if permit[0]:
+                permit[0] = False
+                self.limiter.release()
+
     # -- high-level ops ------------------------------------------------------
 
     def worker_info(self, timeout: float = 10.0) -> List[pb.WorkerInfo]:
@@ -145,24 +365,30 @@ class WorkerClient:
         `utils/config.go:1124-1187`).  Nodes are queried concurrently
         and unreachable ones are logged + flagged on their breaker and
         skipped — a dead node costs one timeout in parallel with the
-        live queries, not a serial 10s stall each at startup."""
+        live queries, not a serial 10s stall each at startup.  Every
+        answer doubles as a fleet heartbeat (and drain handshake)."""
         def one(arg):
             node, stub, br = arg
             try:
                 r = stub(pb.Task(operation="worker_info"), timeout=timeout)
             except Exception as e:
                 br.record_failure()
+                self.fleet.node_result(node, ok=False,
+                                       fatal=self._is_fatal(e))
                 log.warning("worker_info: node %s unreachable: %s", node, e)
                 return None
             br.record_success()
+            self.fleet.node_result(node, ok=True,
+                                   draining=self._draining(r))
             return r.worker
         infos = list(self._fanout.map(
             one, zip(self.nodes, self._stubs, self._breakers)))
         return [i for i in infos if i is not None]
 
     def warp(self, granule: Granule, dst_gt: GeoTransform, dst_crs: CRS,
-             width: int, height: int,
-             resample: str = "near") -> Optional[Tuple[np.ndarray, np.ndarray]]:
+             width: int, height: int, resample: str = "near",
+             route_key: Optional[str] = None,
+             ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         task = pb.Task(operation="warp")
         task.granule.CopyFrom(granule_to_pb(granule))
         task.dst.srs = dst_crs.name()
@@ -170,7 +396,13 @@ class WorkerClient:
         task.dst.width = width
         task.dst.height = height
         task.dst.resample = resample
-        res = self.process(task)
+        # NodeBusy (all queues full) gets jittered backoff — the fleet
+        # is alive, its queues drain in tens of ms; everything else
+        # (semantic errors, dead fleet) re-raises unchanged
+        res = call_with_retry(
+            lambda: self.process(task, route_key=route_key),
+            self._busy_policy, site="worker-busy",
+            retryable=lambda e: isinstance(e, NodeBusy))
         if res.error:
             raise RuntimeError(res.error)
         return unpack_raster(res)
@@ -211,12 +443,22 @@ class WorkerClient:
         """Concurrent per-granule warps, order-preserving; failures become
         empty granules (EmptyTile sentinel semantics).  Large dst tiles
         shard into sub-tile RPCs per granule (P2(c),
-        `tile_grpc.go:143-198`) and reassemble here."""
+        `tile_grpc.go:143-198`) and reassemble here.  Each sub-tile is
+        routed by its canonical tile key, so a repeat of the same
+        request re-lands every sub-tile on the shard that warped it
+        before (warm scene + kernel caches), while the sub-tiles of one
+        large request still spread across the ring."""
         if not granules:
             return []
         dst_gt = req.dst_gt()
         failures: List[Exception] = []
         mx, my = self._sub_tile_grid(req)
+
+        def route_key(ox: int, oy: int, tw: int, th: int) -> str:
+            b = dst_gt.window(ox, oy).bbox(tw, th)
+            return tile_route_key(req.collection, req.crs.name(),
+                                  (b.xmin, b.ymin, b.xmax, b.ymax),
+                                  tw, th)
 
         # granule footprint in dst pixel space, for sub-tile pruning:
         # a granule touching one sub-tile must not cost an RPC per
@@ -264,7 +506,8 @@ class WorkerClient:
             i, ox, oy, tw, th = job
             try:
                 return self.warp(granules[i], dst_gt.window(ox, oy),
-                                 req.crs, tw, th, resample)
+                                 req.crs, tw, th, resample,
+                                 route_key=route_key(ox, oy, tw, th))
             except Exception as e:
                 failures.append(e)
                 return None
@@ -321,6 +564,18 @@ class WorkerClient:
         return res.info_json
 
     def close(self):
+        """Idempotent shutdown.  The closed flag flips *first*, so any
+        dispatch racing the teardown is rejected up front with
+        :class:`BackendUnavailable` instead of hitting a half-closed
+        channel mid-RPC."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.fleet.close()
         self._fanout.shutdown(wait=False)
         for ch in self._channels:
-            ch.close()
+            try:
+                ch.close()
+            except Exception:
+                pass

@@ -72,7 +72,13 @@ class _Task:
 
 
 class PoolFullError(RuntimeError):
-    pass
+    """Task queue full: pure backpressure, not a broken pool.
+
+    ``retryable`` so :func:`resilience.retry.call_with_retry` backs off
+    and re-enqueues instead of failing the request outright — the queue
+    drains at pool speed, so a jittered retry usually lands."""
+
+    retryable = True
 
 
 class Process:

@@ -23,10 +23,15 @@ import concurrent.futures as cf
 import json
 import logging
 import math
+import os
+import signal
+import threading
 from typing import Optional
 
 import numpy as np
 
+from ..fleet import DrainController, Draining
+from ..resilience import faults
 from . import gskyrpc_pb2 as pb
 from .oom import OOMMonitor
 from .pool import PoolFullError, ProcessPool
@@ -46,6 +51,7 @@ class WorkerService:
                  task_timeout: float = 120.0):
         self.pool = pool or ProcessPool(size=pool_size,
                                         task_timeout=task_timeout)
+        self.drain = DrainController("worker-node")
         from ..pipeline.executor import WarpExecutor
         self.executor = WarpExecutor()
 
@@ -54,15 +60,23 @@ class WorkerService:
     def process(self, task: pb.Task) -> pb.Result:
         op = task.operation
         try:
+            # node-level chaos (GSKY_FAULTS="node:kill:..." etc.) hits
+            # every RPC including health probes — a killed node just dies
+            faults.inject("node")
             if op == "worker_info":
+                # answered even while draining: this IS the drain
+                # handshake the fleet health monitor reads
                 return self._worker_info()
-            if op == "warp":
-                return self._warp(task)
-            if op == "drill":
-                return self._drill(task)
-            if op in ("extent", "info", "decode"):
-                return self.pool.submit(task)
-            return pb.Result(error=f"unknown operation {op!r}")
+            with self.drain.track():
+                if op == "warp":
+                    return self._warp(task)
+                if op == "drill":
+                    return self._drill(task)
+                if op in ("extent", "info", "decode"):
+                    return self.pool.submit(task)
+                return pb.Result(error=f"unknown operation {op!r}")
+        except Draining as e:
+            return pb.Result(error=f"draining: {e}")
         except PoolFullError as e:
             return pb.Result(error=f"backpressure: {e}")
         except Exception as e:
@@ -75,6 +89,9 @@ class WorkerService:
         r.worker.pool_size = self.pool.size
         r.worker.queue_cap = self.pool.queue.maxsize
         r.worker.platform = jax.default_backend()
+        # WorkerInfo has no spare proto field; the drain handshake rides
+        # the free-form info_json channel instead
+        r.info_json = json.dumps(self.drain.stats())
         return r
 
     def _warp(self, task: pb.Task) -> pb.Result:
@@ -209,6 +226,9 @@ def make_grpc_server(service: WorkerService, address: str = "[::]:11429",
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="gsky-rpc")
     ap.add_argument("-p", "--port", type=int, default=11429)
+    ap.add_argument("-host", default="[::]",
+                    help="listen address ([::] needs a dual-stack host; "
+                         "use 127.0.0.1 on IPv4-only ones)")
     ap.add_argument("-n", "--pool", type=int, default=0,
                     help="decode pool size (default: cpu count)")
     ap.add_argument("-max_tasks", type=int, default=20000)
@@ -230,12 +250,42 @@ def main(argv=None):
         monitor = OOMMonitor(svc.pool.child_pids,
                              threshold_bytes=a.oom_threshold << 20)
         monitor.start()
-    server = make_grpc_server(svc, f"[::]:{a.port}")
+    server = make_grpc_server(svc, f"{a.host}:{a.port}")
     server.start()
-    log.info("gsky-rpc listening on :%d (pool=%d)", a.port, svc.pool.size)
+    log.info("gsky-rpc listening on %s:%d (pool=%d)",
+             a.host, a.port, svc.pool.size)
+
+    # graceful drain: SIGTERM/SIGINT closes the accept gate (new ops
+    # answer "draining:", worker_info keeps answering with the draining
+    # flag so the fleet deregisters us), in-flight ops run to completion,
+    # then the server exits.  A supervisor that can't wait will SIGKILL
+    # after its own grace period; GSKY_DRAIN_TIMEOUT_S bounds ours.
+    stop = threading.Event()
+
+    def _drain():
+        svc.drain.start_drain()
+        timeout = float(os.environ.get("GSKY_DRAIN_TIMEOUT_S", "30") or 30)
+        ok = svc.drain.wait_drained(timeout)
+        st = svc.drain.stats()
+        log.info("drain %s: completed=%d refused=%d inflight=%d",
+                 "complete" if ok else "TIMED OUT",
+                 st["completed"], st["refused"], st["inflight"])
+        stop.set()
+
+    def _on_term(signum, frame):
+        log.info("signal %d: draining worker node", signum)
+        threading.Thread(target=_drain, daemon=True,
+                         name="gsky-drain").start()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
     try:
-        server.wait_for_termination()
+        # park until a signal-triggered drain completes; the gRPC
+        # server keeps serving from its own threads meanwhile
+        while not stop.wait(0.5):
+            pass
     finally:
+        server.stop(grace=5).wait()
         if monitor:
             monitor.stop()
         svc.close()

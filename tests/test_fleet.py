@@ -1,0 +1,454 @@
+"""Fleet fault tolerance: consistent-hash ring rebalance bounds,
+bounded-load spill determinism, phi-accrual state transitions, hedge
+delay/budget/cancellation mechanics, and graceful drain (worker node
+and OWS) with zero in-flight loss."""
+
+import concurrent.futures as cf
+import threading
+import time
+
+import pytest
+
+from gsky_tpu.fleet import (DEAD, DRAINING, HEALTHY, SUSPECT,
+                            DrainController, Draining, FleetRouter,
+                            HashRing, HealthMonitor, HedgePolicy,
+                            fleet_stats, hedged_call, tile_route_key)
+
+# ---------------------------------------------------------------------------
+# hash ring
+# ---------------------------------------------------------------------------
+
+NODES = [f"10.0.0.{i}:11429" for i in range(1, 6)]
+KEYS = [f"layer|EPSG:3857|{i}|256x256" for i in range(2000)]
+
+
+def test_ring_stable_assignment():
+    ring = HashRing(NODES)
+    a = {k: ring.owner(k) for k in KEYS}
+    b = {k: HashRing(list(reversed(NODES))).owner(k) for k in KEYS}
+    assert a == b          # membership order is irrelevant
+    # every node owns a non-trivial share (vnodes even out arcs)
+    counts = {n: 0 for n in NODES}
+    for n in a.values():
+        counts[n] += 1
+    assert min(counts.values()) > len(KEYS) / len(NODES) / 3
+
+
+def test_ring_rebalance_moves_only_dead_nodes_arc():
+    """Killing one of n nodes moves ~K/n keys: exactly the dead node's
+    keys move, every other key keeps its owner."""
+    ring = HashRing(NODES)
+    before = {k: ring.owner(k) for k in KEYS}
+    dead = NODES[2]
+    gen0 = ring.generation
+    ring.set_nodes([n for n in NODES if n != dead])
+    assert ring.generation == gen0 + 1
+    after = {k: ring.owner(k) for k in KEYS}
+    moved = [k for k in KEYS if before[k] != after[k]]
+    assert all(before[k] == dead for k in moved)
+    # the dead arc is ~K/n, give it 2x slack for hash variance
+    assert len(moved) <= 2 * len(KEYS) / len(NODES)
+    assert len(moved) > 0
+    # the moved keys land on their ring successor, deterministically
+    ring2 = HashRing([n for n in NODES if n != dead])
+    assert all(after[k] == ring2.owner(k) for k in moved)
+
+
+def test_ring_set_nodes_noop_keeps_generation():
+    ring = HashRing(NODES)
+    g = ring.generation
+    ring.set_nodes(list(reversed(NODES)))     # same set, shuffled
+    assert ring.generation == g
+
+
+def test_ring_preference_walk_distinct_and_deterministic():
+    ring = HashRing(NODES, vnodes=32)
+    for k in KEYS[:50]:
+        pref = ring.preference(k)
+        assert len(pref) == len(NODES)
+        assert len(set(pref)) == len(NODES)
+        assert pref == ring.preference(k)
+        assert pref[0] == ring.owner(k)
+
+
+def test_ring_bounded_load_spills_deterministically():
+    ring = HashRing(NODES)
+    key = KEYS[0]
+    pref = ring.preference(key)
+    home = pref[0]
+    # home node hogging the whole observed load: it must be demoted
+    # behind the rest, in the SAME walk order
+    load = {n: 0 for n in NODES}
+    load[home] = 10
+    routed = ring.route(key, load=load, bound=1.25)
+    assert routed[-1] == home
+    assert routed[:-1] == [n for n in pref if n != home]
+    assert routed == ring.route(key, load=dict(load), bound=1.25)
+    # balanced load (or bound off): no demotion
+    assert ring.route(key, load={n: 2 for n in NODES},
+                      bound=1.25) == pref
+    assert ring.route(key, load=load, bound=0.0) == pref
+
+
+def test_ring_route_eligible_filter_falls_back_when_empty():
+    ring = HashRing(NODES)
+    key = KEYS[1]
+    assert ring.route(key, eligible=lambda n: False) == \
+        ring.preference(key)
+    only = ring.preference(key)[3]
+    assert ring.route(key, eligible=lambda n: n == only) == [only]
+
+
+def test_tile_route_key_canonical():
+    a = tile_route_key("landsat", "EPSG:3857",
+                       (1.0000001, 2.0, 3.0, 4.0), 256, 256)
+    b = tile_route_key("landsat", "EPSG:3857",
+                       (1.0000002, 2.0, 3.0, 4.0), 256, 256)
+    assert a == b           # sub-micro bbox jitter canonicalises away
+    assert a != tile_route_key("landsat", "EPSG:3857",
+                               (1.1, 2.0, 3.0, 4.0), 256, 256)
+
+
+# ---------------------------------------------------------------------------
+# phi-accrual health
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_phi_accrual_state_transitions():
+    clk = FakeClock()
+    mon = HealthMonitor(["a", "b"], interval_s=0, suspect_phi=3.0,
+                        dead_phi=8.0, clock=clk)
+    # never heartbeated: optimistic (routable) so a cold fleet boots
+    assert mon.state("a") == HEALTHY
+    # a steady 1s heartbeat cadence
+    for _ in range(5):
+        mon.record_heartbeat("a")
+        clk.t += 1.0
+    assert mon.state("a") == HEALTHY
+    # silence grows phi through suspect into dead
+    clk.t += 6.0
+    assert mon.state("a") == SUSPECT
+    clk.t += 60.0
+    assert mon.state("a") == DEAD
+    # one heartbeat resurrects it
+    mon.record_heartbeat("a")
+    assert mon.state("a") == HEALTHY
+
+
+def test_health_fatal_report_and_draining():
+    clk = FakeClock()
+    mon = HealthMonitor(["a"], interval_s=0, clock=clk)
+    mon.record_heartbeat("a")
+    mon.record_failure("a", fatal=True)
+    assert mon.state("a") == DEAD
+    assert not mon.routable("a")
+    mon.record_heartbeat("a")
+    assert mon.state("a") == HEALTHY
+    mon.record_draining("a")
+    assert mon.state("a") == DRAINING
+    assert not mon.routable("a")
+    snap = mon.snapshot()
+    assert snap["a"]["beats"] == 2 and snap["a"]["failures"] == 1
+
+
+def test_health_active_probe_thread_feeds_states():
+    calls = []
+
+    def probe(n):
+        calls.append(n)
+        return {"a": True, "b": False, "c": DRAINING}[n]
+
+    mon = HealthMonitor(["a", "b", "c"], probe=probe, interval_s=0.01)
+    mon.start()
+    t_end = time.time() + 5.0
+    while time.time() < t_end and len(calls) < 9:
+        time.sleep(0.01)
+    mon.stop()
+    assert mon.state("a") == HEALTHY
+    assert mon.snapshot()["b"]["failures"] > 0
+    assert mon.state("c") == DRAINING
+
+
+# ---------------------------------------------------------------------------
+# hedged dispatch
+# ---------------------------------------------------------------------------
+
+
+def _future_returning(value, after_s=0.0):
+    ex = cf.ThreadPoolExecutor(1)
+
+    def work():
+        if after_s:
+            time.sleep(after_s)
+        return value
+
+    return lambda: ex.submit(work)
+
+
+def test_hedge_not_fired_before_delay():
+    hedged = []
+
+    def hedge():
+        hedged.append(1)
+        return _future_returning("hedge")()
+
+    res, won = hedged_call(_future_returning("fast", 0.0), hedge,
+                           delay_s=0.5, timeout_s=5.0)
+    assert res == "fast" and not won and not hedged
+
+
+def test_hedge_fires_past_delay_and_wins():
+    res, won = hedged_call(_future_returning("slow", 2.0),
+                           _future_returning("hedge", 0.05),
+                           delay_s=0.05, timeout_s=10.0)
+    assert res == "hedge" and won
+
+
+def test_hedge_loser_cancellation_frees_permit():
+    """The losing hedge future is cancelled and its permit released
+    via on_hedge_cancelled — exactly once."""
+    released = []
+    ex = cf.ThreadPoolExecutor(1)
+    gate = threading.Event()
+
+    def primary():
+        return _future_returning("primary", 0.3)()
+
+    def hedge():
+        # a queued future that never starts: cancellable
+        ex.submit(gate.wait, 5.0)
+        return ex.submit(lambda: "hedge")
+
+    res, won = hedged_call(primary, hedge, delay_s=0.05, timeout_s=10.0,
+                           on_hedge_cancelled=lambda: released.append(1))
+    gate.set()
+    assert res == "primary" and not won
+    assert released == [1]         # fired exactly once
+
+
+def test_hedge_errored_winner_forfeits_to_loser():
+    def primary():
+        ex = cf.ThreadPoolExecutor(1)
+
+        def die():
+            time.sleep(0.2)
+            raise RuntimeError("primary died")
+
+        return ex.submit(die)
+
+    # the primary straggles then DIES after the hedge launched: its
+    # error must forfeit to the hedge's good answer, not surface
+    res, won = hedged_call(primary, _future_returning("hedge", 0.3),
+                           delay_s=0.05, timeout_s=10.0)
+    assert res == "hedge" and won
+
+
+def test_hedge_policy_adaptive_delay_and_budget():
+    pol = HedgePolicy(percentile=0.99, min_delay_s=0.01,
+                      initial_delay_s=1.0, budget=0.5, min_samples=10)
+    assert pol.delay_s() == 1.0          # no samples yet
+    for _ in range(99):
+        pol.observe(0.01)
+    pol.observe(2.0)                     # one straggler
+    assert pol.delay_s() == pytest.approx(2.0)
+    # token bucket: 1 initial + 0.5/primary, spent 1/hedge
+    assert pol.try_hedge()
+    assert not pol.try_hedge()
+    pol.on_primary()
+    pol.on_primary()
+    assert pol.try_hedge()
+    s = pol.stats()
+    assert s["hedges"] == 2 and s["hedges_denied"] == 1
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+
+def test_drain_completes_inflight_and_refuses_new():
+    dc = DrainController("test")
+    started = threading.Event()
+    release = threading.Event()
+    done = []
+
+    def worker():
+        with dc.track():
+            started.set()
+            release.wait(5.0)
+            done.append(1)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    started.wait(5.0)
+    dc.start_drain()
+    # new work refused while the in-flight one is still running
+    with pytest.raises(Draining):
+        with dc.track():
+            pass
+    assert not dc.wait_drained(timeout_s=0.05)   # still in flight
+    release.set()
+    assert dc.wait_drained(timeout_s=5.0)
+    t.join(5.0)
+    assert done == [1]                           # zero in-flight loss
+    st = dc.stats()
+    assert st == {"draining": True, "inflight": 0,
+                  "refused": 1, "completed": 1}
+
+
+def test_worker_service_drain_zero_loss():
+    """WorkerService under drain: the in-flight op completes and is
+    delivered, new ops answer 'draining:', worker_info still answers
+    (it IS the drain handshake) and advertises the draining state."""
+    import json as _json
+    import types
+
+    from gsky_tpu.worker import gskyrpc_pb2 as pb
+    from gsky_tpu.worker.server import WorkerService
+
+    # stub pool: the drain contract is about the gate, not the decode
+    # children — no point paying a child process spawn here
+    pool = types.SimpleNamespace(size=1,
+                                 queue=types.SimpleNamespace(maxsize=8),
+                                 submit=lambda task: pb.Result(),
+                                 close=lambda: None)
+    svc = WorkerService(pool=pool)
+    try:
+        gate = threading.Event()
+        orig = svc._worker_info
+
+        def tracked():
+            with svc.drain.track():
+                gate.wait(5.0)
+                return orig()
+
+        # run one op through the drain gate, park it, drain mid-flight
+        with cf.ThreadPoolExecutor(1) as ex:
+            fut = ex.submit(tracked)
+            while svc.drain.inflight == 0:
+                time.sleep(0.005)
+            svc.drain.start_drain()
+            # new non-info op: refused with the draining error string
+            r = svc.process(pb.Task(operation="extent"))
+            assert r.error.startswith("draining:")
+            # worker_info keeps answering, flagged draining
+            info = svc.process(pb.Task(operation="worker_info"))
+            assert not info.error
+            assert _json.loads(info.info_json)["draining"] is True
+            gate.set()
+            assert not fut.result(timeout=5.0).error
+        assert svc.drain.wait_drained(timeout_s=5.0)
+    finally:
+        svc.close()
+
+
+def test_ows_drain_zero_inflight_loss(tmp_path):
+    """OWSServer.shutdown(): the in-flight request finishes and is
+    delivered, new requests get a clean draining 503 + Retry-After."""
+    import asyncio
+    import json as _json
+
+    from aiohttp import web
+
+    from gsky_tpu.server.config import ConfigWatcher
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    (tmp_path / "config.json").write_text(_json.dumps({
+        "service_config": {"ows_hostname": "", "mas_address": ""},
+        "layers": []}))
+    watcher = ConfigWatcher(str(tmp_path), install_signal=False)
+    server = OWSServer(watcher, mas_factory=lambda a: None,
+                       metrics=MetricsLogger(), gateway=None)
+
+    async def go():
+        entered = asyncio.Event()
+        release = asyncio.Event()
+
+        async def slow_handle(request):
+            entered.set()
+            await release.wait()
+            return web.Response(status=200, body=b"ok")
+
+        server._handle = slow_handle
+        inflight = asyncio.ensure_future(server.handle(None))
+        await entered.wait()
+        shut = asyncio.ensure_future(server.shutdown(timeout_s=10.0))
+        while not server.drain.draining:
+            await asyncio.sleep(0.01)
+        # the gate is closed: a NEW request gets the draining 503
+        resp = await server.handle(None)
+        release.set()
+        return (await shut), (await inflight), resp
+
+    ok, done, refused = asyncio.new_event_loop().run_until_complete(go())
+    assert ok                      # drain finished inside the timeout
+    assert done.status == 200      # the in-flight request was delivered
+    assert refused.status == 503
+    assert refused.headers.get("Retry-After")
+    assert refused.headers.get("Connection") == "close"
+    st = server.drain.stats()
+    assert st["refused"] == 1 and st["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# router integration
+# ---------------------------------------------------------------------------
+
+
+def test_router_candidates_health_gated(monkeypatch):
+    monkeypatch.setenv("GSKY_FLEET", "1")
+    r = FleetRouter(NODES, name="t1")
+    try:
+        key = KEYS[0]
+        pref = r.ring.preference(key)
+        assert r.candidates(key) == pref
+        # dead home node: demoted to the very back, order else intact
+        r.monitor.record_failure(pref[0], fatal=True)
+        cand = r.candidates(key)
+        assert cand[-1] == pref[0]
+        assert cand[:-1] == pref[1:]
+        assert len(cand) == len(NODES)   # dead is still attemptable
+        # draining node: behind healthy, ahead of nothing special
+        r.node_result(pref[1], ok=True, draining=True)
+        assert r.candidates(key)[0] == pref[2]
+    finally:
+        r.close()
+
+
+def test_router_locality_ledger_and_stats():
+    r = FleetRouter(NODES[:3], name="t2")
+    try:
+        r.record_locality("k1", "a")
+        r.record_locality("k1", "a")
+        r.record_locality("k1", "b")
+        r.record_locality("k2", "a")
+        assert r.locality_hits == 1 and r.locality_misses == 1
+        assert r.locality_rate() == 0.5
+        st = r.stats()
+        assert st["routed"] == 4
+        assert st["ring"]["generation"] == 1
+        assert st["locality"]["rate"] == 0.5
+        assert st["hedge"]["enabled"] in (True, False)
+        # the process-wide registry surfaces this router by name
+        assert "t2" in fleet_stats()
+    finally:
+        r.close()
+
+
+def test_router_disabled_falls_back_to_plain_nodes(monkeypatch):
+    monkeypatch.setenv("GSKY_FLEET", "0")
+    r = FleetRouter(NODES, name="t3")
+    try:
+        assert not r.enabled
+        assert r.candidates(KEYS[0]) == r.ring.nodes
+    finally:
+        r.close()

@@ -94,6 +94,51 @@ class TestSummary:
         assert "cache" in doc
 
 
+class TestFleetDebugBlock:
+    def test_summary_surfaces_live_router_counters(self):
+        """/debug carries one ``fleet`` entry per live router: ring
+        membership/generation, in-flight load, locality ledger, health
+        states and hedge counters."""
+        from gsky_tpu.fleet import FleetRouter
+
+        r = FleetRouter(["n1:11429", "n2:11429", "n3:11429"],
+                        name="dbg-fleet")          # strong ref: WeakSet
+        node = None
+        try:
+            key = "layer|EPSG:3857|0,0,1,1|256x256"
+            node = r.candidates(key)[0]
+            r.task_started(node)
+            r.record_locality(key, node)
+            r.record_locality(key, node)           # repeat -> hit
+            r.node_result(node, ok=True, latency_s=0.01)
+
+            fs = MetricsLogger().summary()["fleet"]["dbg-fleet"]
+            assert set(fs["ring"]["nodes"]) == {"n1:11429", "n2:11429",
+                                                "n3:11429"}
+            assert fs["ring"]["generation"] >= 1
+            assert fs["routed"] == 2
+            assert fs["locality"] == {"hits": 1, "misses": 0,
+                                      "rate": 1.0}
+            assert fs["load"][node] == 1
+            assert fs["health"][node]["state"] == "healthy"
+            assert fs["hedge"]["primaries"] == 0
+            assert "delay_s" in fs["hedge"] and "tokens" in fs["hedge"]
+        finally:
+            if node is not None:
+                r.task_finished(node)
+            r.close()
+
+    def test_summary_fleet_block_absent_without_routers(self):
+        # fleet_stats() only reports routers this process actually
+        # created; a plain logger must not invent the block (other
+        # tests' routers may linger in the WeakSet, so assert shape
+        # rather than absence when any survive)
+        doc = MetricsLogger().summary()
+        if "fleet" in doc:
+            assert all(isinstance(v, dict) and "ring" in v
+                       for v in doc["fleet"].values())
+
+
 class TestSinks:
     def test_no_sink_is_noop(self):
         MetricsLogger().write({"a": 1})     # must not raise or print

@@ -518,6 +518,62 @@ class TestVRT:
             svc.close()
 
 
+# ---------------------------------------------------------------------------
+# client lifecycle + backpressure typing
+# ---------------------------------------------------------------------------
+
+
+def test_client_close_idempotent_then_dispatch_raises(grpc_worker):
+    """close() twice is a no-op; dispatch after close fails fast with
+    BackendUnavailable instead of hitting half-torn-down channels."""
+    from gsky_tpu.resilience import BackendUnavailable
+    from gsky_tpu.worker import WorkerClient
+    c = WorkerClient([grpc_worker])
+    assert c.worker_info()
+    c.close()
+    c.close()                        # second close must not raise
+    with pytest.raises(BackendUnavailable):
+        c.process(pb.Task(operation="worker_info"))
+
+
+def test_pool_full_is_retryable_resilience_error():
+    """Queue-full backpressure is *retryable*: the retry policy backs
+    off and re-submits instead of failing the request outright."""
+    from gsky_tpu.resilience.retry import RetryPolicy, call_with_retry
+    assert PoolFullError("queue full").retryable is True
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise PoolFullError("queue full")
+        return "ok"
+
+    out = call_with_retry(
+        flaky, RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0),
+        sleep=lambda s: None)
+    assert out == "ok" and len(attempts) == 3
+
+
+def test_service_maps_pool_full_to_backpressure_error():
+    """The RPC boundary translates PoolFullError into the
+    ``backpressure:`` error prefix the client's failover keys on."""
+    import types
+
+    from gsky_tpu.fleet import DrainController
+
+    def full(task):
+        raise PoolFullError("task queue full (cap 8)")
+
+    svc = WorkerService.__new__(WorkerService)
+    svc.pool = types.SimpleNamespace(
+        size=1, queue=types.SimpleNamespace(maxsize=8), submit=full,
+        close=lambda: None)
+    svc.drain = DrainController("t")
+    res = svc.process(pb.Task(operation="extent"))
+    assert res.error.startswith("backpressure:")
+
+
 def test_grpc_sub_tiled_warp_matches_whole(grpc_worker, archive):
     """P2(c): per-granule dst sub-tiling (`tile_grpc.go:143-198`) must
     reassemble to the same raster as one whole-tile RPC, including when
@@ -676,7 +732,8 @@ def test_sub_tiled_assembly_when_one_job_per_granule():
 
     calls = []
 
-    def fake_warp(granule, dst_gt, crs, width, height, resample):
+    def fake_warp(granule, dst_gt, crs, width, height, resample,
+                  route_key=None):
         calls.append((dst_gt.x0, dst_gt.y0, width, height))
         d = np.full((height, width), float(granule.band), np.float32)
         return d, np.ones((height, width), bool)

@@ -56,6 +56,22 @@ engaged: gate entries, encode-pool throughput, and a >1 queue
 high-water on at least one stage.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario burst --seconds 30
+
+``--scenario fleet``: multi-process fleet fault tolerance (the
+gsky_tpu/fleet subsystem, see docs/FLEET.md).  Boots three REAL
+``gsky_tpu.worker.server`` subprocesses, points a layer's
+``worker_nodes`` at them, and drives a fixed tile grid through the
+consistent-hash router in three phases: baseline (per-tile-key
+locality under a healthy fleet), kill (SIGKILL one node mid-load —
+every response must stay a clean 2xx / labelled-degraded / OGC error,
+never a bare 5xx or dropped connection), and revive (restart the node,
+wait for the phi-accrual detector to re-admit it, and require the
+locality rate to recover to >= 90% of the pre-kill baseline).  A coda
+spawns one deliberately slow node (``GSKY_FAULTS=node:slow``) and
+shows hedged keyed dispatch beating unhedged p99 within the hedge
+budget.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario fleet --seconds 25
 """
 
 from __future__ import annotations
@@ -87,7 +103,8 @@ def main(argv=None):
     ap.add_argument("--conc", type=int, default=8)
     ap.add_argument("--max-rss-growth-mb", type=float, default=256.0)
     ap.add_argument("--scenario",
-                    choices=("churn", "hot", "wcs", "chaos", "burst"),
+                    choices=("churn", "hot", "wcs", "chaos", "burst",
+                             "fleet"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -200,6 +217,8 @@ def main(argv=None):
         return run_chaos(args, watcher, mas_client, merc, boot)
     if args.scenario == "burst":
         return run_burst(args, watcher, mas_client, merc, boot)
+    if args.scenario == "fleet":
+        return run_fleet(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -649,6 +668,335 @@ def run_burst(args, watcher, mas_client, merc, boot) -> int:
           and overlap_hw >= 2)
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
+
+
+def run_fleet(args, watcher, mas_client, merc, boot) -> int:
+    """Multi-process fleet fault tolerance: three real worker-node
+    subprocesses behind the consistent-hash router; kill one mid-soak,
+    revive it, require zero bare 5xx and >= 90% locality recovery;
+    then a direct-dispatch hedge phase against a deliberately slow
+    node (see module docstring)."""
+    import socket
+    import subprocess
+    import threading
+
+    import numpy as np
+
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+    from gsky_tpu.worker import gskyrpc_pb2 as pb
+    from gsky_tpu.worker.server import METHOD
+
+    import grpc
+
+    # routing knobs for a fast-converging soak: 1s active probes so a
+    # revived node is re-admitted within a couple of beats, and a
+    # looser load bound — at soak concurrency (4) over 3 nodes the
+    # default c=1.25 caps the home node at 2 in-flight and constantly
+    # spills repeat keys, drowning the locality signal being measured
+    os.environ.setdefault("GSKY_FLEET_PROBE_S", "1.0")
+    os.environ.setdefault("GSKY_FLEET_BOUND", "2.5")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    conf_dir = watcher.root
+    data_root = os.path.dirname(conf_dir)
+    base_env = dict(os.environ, PYTHONPATH=repo)
+    base_env.setdefault("JAX_PLATFORMS", "cpu")
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    procs: dict = {}
+
+    def spawn(port: int, extra_env=None):
+        e = dict(base_env)
+        if extra_env:
+            e.update(extra_env)
+        logf = open(os.path.join(data_root, f"node-{port}.log"), "ab")
+        procs[port] = subprocess.Popen(
+            [sys.executable, "-m", "gsky_tpu.worker.server",
+             "-p", str(port), "-host", "127.0.0.1",
+             "-n", "1", "-oom_threshold", "0"],
+            env=e, cwd=repo, stdout=logf, stderr=subprocess.STDOUT)
+        logf.close()                     # child holds its own fd
+
+    def wait_ready(port: int, deadline_s: float) -> bool:
+        """Poll worker_info until the node answers (the node imports
+        jax before it listens, which is slow on a starved host).  A
+        FRESH channel per attempt: a channel dialled before the node
+        listens parks its subchannel in TRANSIENT_FAILURE under gRPC's
+        reconnect backoff (minutes at the cap) and every RPC on it
+        fails instantly without re-dialling."""
+        t_end = time.time() + deadline_s
+        while time.time() < t_end:
+            if procs[port].poll() is not None:
+                return False             # node died during boot
+            ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+            stub = ch.unary_unary(
+                METHOD, request_serializer=pb.Task.SerializeToString,
+                response_deserializer=pb.Result.FromString)
+            try:
+                stub(pb.Task(operation="worker_info"), timeout=2.0)
+                return True
+            except Exception:
+                time.sleep(0.5)
+            finally:
+                ch.close()
+        return False
+
+    ports = [free_port() for _ in range(3)]
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+    try:
+        for p in ports:
+            spawn(p)
+        boot_deadline = time.time() + 600
+        for p in ports:
+            if not wait_ready(p, max(boot_deadline - time.time(), 1.0)):
+                print(json.dumps({"scenario": "fleet",
+                                  "error": f"node :{p} never came up"}))
+                print("SOAK FAILED", flush=True)
+                return 1
+
+        # the fleet layer lives in its own namespace so its
+        # worker_nodes don't leak into the other scenarios' layers
+        import bench as B
+        ns_dir = os.path.join(conf_dir, "fleet")
+        os.makedirs(ns_dir, exist_ok=True)
+        with open(os.path.join(ns_dir, "config.json"), "w") as fp:
+            json.dump({
+                "service_config": {"ows_hostname": "", "mas_address": "",
+                                   "worker_nodes": nodes},
+                "layers": [{
+                    "name": "landsat_fleet", "title": "fleet soak",
+                    "data_source": data_root,
+                    "rgb_products": [f"LC08_20200{110 + k}_T1"
+                                     for k in range(B.N_SCENES)],
+                    "time_generator": "mas",
+                    "wms_timeout": 120,
+                    "wcs_max_width": 4096, "wcs_max_height": 4096,
+                    "wcs_max_tile_width": 256,
+                    "wcs_max_tile_height": 256}],
+            }, fp)
+        watcher.reload()
+
+        # gateway off: a response-cache hit would short-circuit the
+        # worker RPCs and the locality ledger would measure nothing
+        server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                           metrics=MetricsLogger(), gateway=None)
+        host = boot(server)
+
+        grid = 3
+        frac = np.linspace(0.0, 0.75, grid)
+        tiles = [(float(fx), float(fy)) for fx in frac for fy in frac]
+        w = merc.width * 0.25
+
+        def url_for(fx: float, fy: float) -> str:
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + w},"
+                  f"{merc.ymin + fy * merc.height + w}")
+            return (f"http://{host}/ows/fleet?service=WMS&request=GetMap"
+                    f"&version=1.3.0&layers=landsat_fleet&crs=EPSG:3857"
+                    f"&bbox={bb}&width=256&height=256&format=image/png"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        def classify(url: str) -> str:
+            try:
+                with urllib.request.urlopen(url, timeout=180) as r:
+                    degraded = r.headers.get("X-GSKY-Degraded")
+                    r.read()
+                    return "degraded" if degraded else "ok"
+            except urllib.error.HTTPError as e:
+                ctype = e.headers.get("Content-Type", "")
+                e.read()
+                if e.code == 500 or "vnd.ogc.se_xml" not in ctype:
+                    return "hard_5xx"
+                return "ogc_error"
+            except Exception:
+                return "transport"
+
+        def fleet_block() -> dict:
+            with urllib.request.urlopen(f"http://{host}/debug",
+                                        timeout=30) as r:
+                return json.loads(r.read()).get(
+                    "fleet", {}).get("worker", {})
+
+        def loc(fb: dict):
+            l = fb.get("locality", {})
+            return l.get("hits", 0), l.get("misses", 0)
+
+        def rate(h0, m0, h1, m1) -> float:
+            return (h1 - h0) / max((h1 - h0) + (m1 - m0), 1)
+
+        def drive(seconds: float, counts: dict):
+            counter = itertools.count()
+            lock = threading.Lock()
+
+            def one(_):
+                i = next(counter)
+                c = classify(url_for(*tiles[i % len(tiles)]))
+                with lock:
+                    counts[c] = counts.get(c, 0) + 1
+
+            conc = min(args.conc, 4)
+            t_end = time.time() + seconds
+            with cf.ThreadPoolExecutor(conc) as ex:
+                while time.time() < t_end:
+                    list(ex.map(one, range(conc * 2)))
+
+        def lap(retries: int = 3) -> int:
+            bad = 0
+            for fx, fy in tiles:
+                for _ in range(retries):
+                    if classify(url_for(fx, fy)) in ("ok", "degraded"):
+                        break
+                else:
+                    bad += 1
+            return bad
+
+        # warm: the first warp on each node pays its decode child's jax
+        # import + the first XLA compiles; retry until the fleet answers
+        warm_end = time.time() + 420
+        while time.time() < warm_end:
+            if classify(url_for(*tiles[0])) == "ok":
+                break
+            time.sleep(2.0)
+        warm_bad = lap()
+
+        # phase A: locality baseline under a healthy fleet
+        counts: dict = {}
+        h0, m0 = loc(fleet_block())
+        drive(max(args.seconds * 0.35, 6.0), counts)
+        h1, m1 = loc(fleet_block())
+        baseline = rate(h0, m0, h1, m1)
+
+        # phase B: SIGKILL one node mid-load.  Every response must stay
+        # clean — the router eats the failure, not the client.
+        kill_port = ports[1]
+        killed = f"127.0.0.1:{kill_port}"
+        procs[kill_port].kill()
+        procs[kill_port].wait()
+        kill_counts: dict = {}
+        drive(max(args.seconds * 0.3, 6.0), kill_counts)
+
+        # revive on the SAME port (the router's channels reconnect),
+        # then wait for the phi detector to re-admit it
+        spawn(kill_port)
+        revived = wait_ready(kill_port, 300)
+        state = None
+        if revived:
+            t_end = time.time() + 120
+            while time.time() < t_end:
+                state = fleet_block().get("health", {}).get(
+                    killed, {}).get("state")
+                if state == "healthy":
+                    break
+                time.sleep(1.0)
+
+        # one uncounted re-home lap flips each key's last-node entry
+        # back to its ring home; the measured phase then shows whether
+        # locality actually RECOVERED, not the one-off re-home misses
+        lap(retries=2)
+        h2, m2 = loc(fleet_block())
+        drive(max(args.seconds * 0.35, 6.0), counts)
+        h3, m3 = loc(fleet_block())
+        recovery = rate(h2, m2, h3, m3)
+        fb = fleet_block()
+
+        # free the fleet before the hedge coda (1-core host): keep one
+        # fast node, add one deliberately slow one
+        for p in (ports[1], ports[2]):
+            procs[p].kill()
+            procs[p].wait()
+
+        slow_port = free_port()
+        spawn(slow_port,
+              extra_env={"GSKY_FAULTS": "node:slow:250ms:1.0"})
+        hedge_out = {"ready": wait_ready(slow_port, 300)}
+        if hedge_out["ready"]:
+            from gsky_tpu.fleet import HedgePolicy
+            from gsky_tpu.worker.client import WorkerClient
+            pair = [f"127.0.0.1:{ports[0]}", f"127.0.0.1:{slow_port}"]
+            keys = [f"soak-hedge-{k}" for k in range(64)]
+
+            def p99_ms(client, n=72) -> float:
+                lats = []
+                for k in range(n):
+                    t0 = time.time()
+                    client.process(pb.Task(operation="worker_info"),
+                                   route_key=keys[k % len(keys)])
+                    lats.append(time.time() - t0)
+                return round(float(np.percentile(lats, 99)) * 1e3, 1)
+
+            uh = WorkerClient(pair)
+            uh.fleet.hedge_enabled = False
+            try:
+                hedge_out["unhedged_p99_ms"] = p99_ms(uh)
+            finally:
+                uh.close()
+
+            hc = WorkerClient(pair)
+            # fixed 30ms hedge delay + a budget that cannot run dry
+            # mid-phase: the soak shows the mechanism, the unit tests
+            # pin the adaptive-delay and token-bucket math
+            hc.fleet.hedge = HedgePolicy(min_delay_s=0.03,
+                                         initial_delay_s=0.03,
+                                         budget=1.0,
+                                         min_samples=10 ** 6)
+            try:
+                hedge_out["hedged_p99_ms"] = p99_ms(hc)
+                hedge_out.update({k: hc.fleet.hedge.stats()[k] for k in
+                                  ("primaries", "hedges", "hedge_wins")})
+            finally:
+                hc.close()
+
+        out = {
+            "scenario": "fleet", "nodes": nodes, "killed": killed,
+            "warm_failures": warm_bad,
+            "responses": counts, "kill_phase": kill_counts,
+            "locality": {"baseline": round(baseline, 3),
+                         "recovery": round(recovery, 3)},
+            "rerouted": fb.get("rerouted", 0),
+            "routed": fb.get("routed", 0),
+            "revived_state": state,
+            "hedge": hedge_out,
+        }
+        print(json.dumps(out))
+        all_counts: dict = {}
+        for d in (counts, kill_counts):
+            for k, v in d.items():
+                all_counts[k] = all_counts.get(k, 0) + v
+        ok = (warm_bad == 0
+              and all_counts.get("hard_5xx", 0) == 0
+              and all_counts.get("transport", 0) == 0
+              and all_counts.get("ok", 0) > 0
+              and kill_counts.get("ok", 0) > 0
+              and fb.get("rerouted", 0) > 0
+              and revived and state == "healthy"
+              # keyed routing must beat the random-assignment null
+              # (1/3 over 3 nodes); it won't reach 1.0 here — bounded
+              # load demotes the home node whenever concurrent dispatch
+              # piles onto it, and a winning hedge credits the runner-up
+              and baseline > 1.0 / 3.0
+              and recovery >= 0.9 * baseline
+              and hedge_out.get("ready") is True
+              and hedge_out.get("hedge_wins", 0) > 0
+              and hedge_out.get("hedges", 0)
+              <= hedge_out.get("primaries", 0) + 10
+              and hedge_out.get("hedged_p99_ms", 1e9)
+              < hedge_out.get("unhedged_p99_ms", 0))
+        print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+        return 0 if ok else 1
+    finally:
+        for p, proc in procs.items():
+            try:
+                proc.kill()
+            except Exception:
+                pass
 
 
 def run_wcs(args, watcher, mas_client, merc, boot) -> int:
