@@ -9,6 +9,7 @@ the (C-accelerated) codec.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import io
 import os
 import threading
@@ -18,6 +19,9 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 from PIL import Image
+
+from ..obs import span as obs_span
+from ..obs.metrics import ENCODE_SECONDS
 
 NODATA_BYTE = 255
 
@@ -93,18 +97,31 @@ async def encode_async(fn, *args, spans: Optional[Dict] = None, **kw):
         spans["encode_queue_max"] = max(
             spans.get("encode_queue_max", 0), occupancy)
     t0 = time.perf_counter()
+    # pool threads start from an empty contextvars.Context; carry the
+    # caller's (trace context included) across the hop explicitly
+    ctx = contextvars.copy_context()
+    cpu = [0.0]
 
     def run():
         t1 = time.perf_counter()
         try:
-            return fn(*args, **kw)
+            return ctx.run(fn, *args, **kw)
         finally:
+            cpu[0] = time.perf_counter() - t1
             with _pool_lock:
-                _pool_stats["busy_s"] += time.perf_counter() - t1
+                _pool_stats["busy_s"] += cpu[0]
 
     ok = False
     try:
-        out = await loop.run_in_executor(pool, run)
+        with obs_span("encode") as esp:
+            out = await loop.run_in_executor(pool, run)
+            wait_s = max(0.0, time.perf_counter() - t0 - cpu[0])
+            esp.set(cpu_s=round(cpu[0], 6), wait_s=round(wait_s, 6))
+            try:
+                ENCODE_SECONDS.labels(phase="cpu").observe(cpu[0])
+                ENCODE_SECONDS.labels(phase="wait").observe(wait_s)
+            except Exception:
+                pass
         ok = True
         return out
     finally:

@@ -1,0 +1,225 @@
+"""Default metric families and scrape-time collectors.
+
+Two sourcing rules keep ``/metrics`` honest:
+
+* Distributions (latency, stage durations, RPC times) are observed at
+  the exact measurement points that already feed ``/debug`` — in
+  ``server/metrics.py`` fold-in, the worker client, the batcher, and
+  the encode pool — never from a second clock.
+* Monotonic counters and level gauges that already exist as live stats
+  objects (caches, fleet router, resilience registry, encode pool,
+  compile probe, flight recorder) are *collected at scrape time* from
+  those objects, so there is one counter, not two copies to drift.
+
+Everything registers against ``prom.default_registry()``; the OWS
+``/metrics`` route just calls ``render_metrics()``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+from .prom import default_registry, log_buckets
+
+_REG = default_registry()
+
+REQUESTS = _REG.counter(
+    "gsky_requests_total", "OWS requests by service class and status.",
+    ["service", "status"])
+REQUEST_SECONDS = _REG.histogram(
+    "gsky_request_seconds", "End-to-end OWS request latency.",
+    ["service"], buckets=log_buckets(0.002, 120.0))
+STAGE_SECONDS = _REG.histogram(
+    "gsky_stage_seconds",
+    "Per-stage durations (tile pipeline, export pipeline, worker side).",
+    ["stage"], buckets=log_buckets(0.0005, 60.0))
+RPC_SECONDS = _REG.histogram(
+    "gsky_worker_rpc_seconds", "Worker RPC round-trip by op and outcome.",
+    ["op", "outcome"], buckets=log_buckets(0.001, 60.0))
+ENCODE_SECONDS = _REG.histogram(
+    "gsky_encode_seconds", "Encode-pool time by phase (wait vs cpu).",
+    ["phase"], buckets=log_buckets(0.0005, 10.0))
+BATCH_FLUSHES = _REG.counter(
+    "gsky_batch_flushes_total", "Render-batcher flushes by trigger.",
+    ["kind"])
+TRACE_EVENTS = _REG.counter(
+    "gsky_trace_events_total",
+    "Cross-cutting events (retry, breaker_open, hedge, reroute, shed).",
+    ["kind"])
+
+Rows = Iterable[Tuple[Dict[str, str], float]]
+
+
+def _g(name: str, help_: str, rows: Rows):
+    return (name, "gauge", help_, list(rows))
+
+
+def _c(name: str, help_: str, rows: Rows):
+    return (name, "counter", help_, list(rows))
+
+
+def _collect_caches():
+    """Hit/miss counters for every process-wide cache tier, lifted from
+    the same ``cache_stats()`` block `/debug` folds into its records."""
+    out: List = []
+    try:
+        from ..server.metrics import cache_stats
+        hits, misses = [], []
+        for cache, st in (cache_stats() or {}).items():
+            hits.append(({"cache": cache}, float(st.get("hits", 0))))
+            misses.append(({"cache": cache}, float(st.get("misses", 0))))
+        if hits:
+            out.append(_c("gsky_cache_hits_total",
+                          "Cache hits by cache tier.", hits))
+            out.append(_c("gsky_cache_misses_total",
+                          "Cache misses by cache tier.", misses))
+    except Exception:
+        pass
+    try:
+        from ..serving import default_gateway
+        st = default_gateway.stats()
+        fl = st.get("singleflight") or {}
+        out.append(_c("gsky_singleflight_total",
+                      "Single-flight render outcomes.",
+                      [({"outcome": "leader"}, float(fl.get("leaders", 0))),
+                       ({"outcome": "joined"}, float(fl.get("joined", 0)))]))
+        adm = (st.get("admission") or {}).get("classes") or {}
+        if adm:
+            out.append(_g("gsky_admission_in_use",
+                          "In-flight admitted requests.",
+                          [({"service": s}, float(c.get("in_use", 0)))
+                           for s, c in adm.items()]))
+            out.append(_g("gsky_admission_queued",
+                          "Requests queued at admission.",
+                          [({"service": s}, float(c.get("queued", 0)))
+                           for s, c in adm.items()]))
+            out.append(_c("gsky_admission_shed_total",
+                          "Requests shed at admission.",
+                          [({"service": s}, float(c.get("shed", 0)))
+                           for s, c in adm.items()]))
+    except Exception:
+        pass
+    return out
+
+
+def _collect_fleet():
+    out: List = []
+    try:
+        from ..fleet import fleet_stats
+        stats = fleet_stats() or {}
+        nodes_rows, routed, rerouted, hedge_rows = [], [], [], []
+        for name, st in stats.items():
+            health = st.get("health") or {}
+            states: Dict[str, int] = {}
+            for _, h in health.items():
+                s = (h or {}).get("state", "unknown")
+                states[s] = states.get(s, 0) + 1
+            for s, n in states.items():
+                nodes_rows.append(({"router": name, "state": s}, float(n)))
+            routed.append(({"router": name}, float(st.get("routed", 0))))
+            rerouted.append(({"router": name},
+                             float(st.get("rerouted", 0))))
+            hg = st.get("hedge") or {}
+            for outcome, key in (("fired", "hedges"), ("won", "hedge_wins"),
+                                 ("denied", "hedges_denied")):
+                hedge_rows.append(({"router": name, "outcome": outcome},
+                                   float(hg.get(key, 0))))
+        if stats:
+            out.append(_g("gsky_fleet_nodes",
+                          "Fleet nodes by router and health state.",
+                          nodes_rows))
+            out.append(_c("gsky_fleet_routed_total",
+                          "Tasks routed by the fleet router.", routed))
+            out.append(_c("gsky_fleet_rerouted_total",
+                          "Tasks rerouted off their preferred node.",
+                          rerouted))
+            out.append(_c("gsky_fleet_hedges_total",
+                          "Hedged RPCs by outcome.", hedge_rows))
+    except Exception:
+        pass
+    return out
+
+
+def _collect_resilience():
+    out: List = []
+    try:
+        from ..resilience import registry as _rr
+        st = _rr.stats()
+        out.append(_c("gsky_retries_total", "Retries by site.",
+                      [({"site": s}, float(n))
+                       for s, n in (st.get("retries") or {}).items()]))
+        out.append(_c("gsky_retry_exhausted_total",
+                      "Retry budgets exhausted by site.",
+                      [({"site": s}, float(n))
+                       for s, n in (st.get("retry_exhausted") or {})
+                       .items()]))
+        out.append(_c("gsky_degraded_responses_total",
+                      "Responses served degraded.",
+                      [({}, float(st.get("degraded_responses", 0)))]))
+        out.append(_c("gsky_deadline_exhausted_total",
+                      "Requests that ran out of deadline budget.",
+                      [({}, float(st.get("deadline_exhausted", 0)))]))
+        breakers = st.get("breakers") or {}
+        if breakers:
+            out.append(_g("gsky_breaker_open",
+                          "Circuit breaker state (1 = open/half-open).",
+                          [({"site": s},
+                            0.0 if (b or {}).get("state") == "closed"
+                            else 1.0)
+                           for s, b in breakers.items()]))
+            out.append(_c("gsky_breaker_opens_total",
+                          "Circuit breaker trips by site.",
+                          [({"site": s}, float((b or {}).get("opens", 0)))
+                           for s, b in breakers.items()]))
+    except Exception:
+        pass
+    return out
+
+
+def _collect_runtime():
+    out: List = []
+    try:
+        from ..server.prewarm import compile_count
+        out.append(_c("gsky_compiles_total",
+                      "Backend compiles observed by the jax.monitoring "
+                      "probe.", [({}, float(compile_count()))]))
+    except Exception:
+        pass
+    try:
+        from ..io.png import encode_pool_stats
+        st = encode_pool_stats() or {}
+        out.append(_g("gsky_encode_pool_pending",
+                      "Encode jobs queued or running on the pool.",
+                      [({}, float(st.get("pending", 0)))]))
+        out.append(_g("gsky_encode_pool_workers",
+                      "Encode-pool worker threads.",
+                      [({}, float(st.get("workers", 0)))]))
+        out.append(_c("gsky_encode_pool_encoded_total",
+                      "Encode jobs completed.",
+                      [({}, float(st.get("encoded", 0)))]))
+        out.append(_c("gsky_encode_pool_errors_total",
+                      "Encode jobs that raised.",
+                      [({}, float(st.get("errors", 0)))]))
+    except Exception:
+        pass
+    try:
+        from .recorder import default_recorder
+        st = default_recorder().stats()
+        out.append(_c("gsky_traces_recorded_total",
+                      "Traces captured by the flight recorder.",
+                      [({}, float(st.get("recorded", 0)))]))
+        out.append(_c("gsky_traces_slo_violations_total",
+                      "Traces past the SLO threshold.",
+                      [({}, float(st.get("slo_violations", 0)))]))
+    except Exception:
+        pass
+    return out
+
+
+for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
+            _collect_runtime):
+    _REG.register_collector(_fn)
+
+
+def render_metrics() -> str:
+    return default_registry().render()

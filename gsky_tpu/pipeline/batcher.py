@@ -34,6 +34,8 @@ from typing import Dict, List, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import span as obs_span
+from ..obs.metrics import BATCH_FLUSHES
 from ..ops.warp import render_scenes_ctrl_many
 
 _MAX_BATCH = 16
@@ -144,7 +146,7 @@ class RenderBatcher:
             # the pending wait timer would still fire, take the lock and
             # pop nothing — cancel it with the batch already claimed
             flush_now[2].cancel()
-            self._execute(flush_now, statics)
+            self._execute(flush_now, statics, trigger="size")
         return fut.result()
 
     def _union_window(self, items, stack):
@@ -168,9 +170,9 @@ class RenderBatcher:
         with self._lock:
             entry = self._groups.pop(key, None)
         if entry is not None:
-            self._execute(entry, statics)
+            self._execute(entry, statics, trigger="timer")
 
-    def _execute(self, entry, statics: tuple):
+    def _execute(self, entry, statics: tuple, trigger: str = "size"):
         stack, items = entry[0], entry[1]
         method, n_ns, out_hw, step, auto, colour_scale = statics
         try:
@@ -196,12 +198,21 @@ class RenderBatcher:
                     self.win_batches += 1
                 else:
                     self.full_batches += 1
+            try:
+                BATCH_FLUSHES.labels(
+                    kind="windowed" if win is not None else "full").inc()
+            except Exception:
+                pass
             t0 = time.perf_counter()
-            out = np.asarray(render_scenes_ctrl_many(
-                stack, jnp.asarray(ctrls), jnp.asarray(params),
-                jnp.asarray(sps), method, n_ns, out_hw, step, auto,
-                colour_scale, win=win,
-                win0=None if win is None else jnp.asarray(win0)))
+            # traced only when flushed from a request thread (the timer
+            # thread carries no request context — counters still count)
+            with obs_span("batch.flush", trigger=trigger) as bsp:
+                out = np.asarray(render_scenes_ctrl_many(
+                    stack, jnp.asarray(ctrls), jnp.asarray(params),
+                    jnp.asarray(sps), method, n_ns, out_hw, step, auto,
+                    colour_scale, win=win,
+                    win0=None if win is None else jnp.asarray(win0)))
+                bsp.set(tiles=N, padded=Np, windowed=win is not None)
             self._observe(Np, N, (time.perf_counter() - t0) * 1e3)
             for i, it in enumerate(items):
                 it[4].set_result(out[i])

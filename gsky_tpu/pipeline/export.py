@@ -48,6 +48,7 @@ Knobs: ``GSKY_EXPORT_DECODE_WORKERS`` (default 4),
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextvars
 import dataclasses
 import logging
 import os
@@ -61,6 +62,7 @@ import numpy as np
 
 from ..geo.crs import parse_crs
 from ..geo.transform import BBox, transform_bbox
+from ..obs import span as obs_span
 from ..resilience import check_partial
 from .decode import decode_window
 from .executor import _prefetch
@@ -420,22 +422,43 @@ class ExportPipeline:
                       "decode_workers": self.decode_workers,
                       "encode_workers": self.encode_workers,
                       "queue_depth": self.queue_depth}
-        plan = self._plan()
+        with obs_span("export.plan") as psp:
+            plan = self._plan()
+            psp.set(tiles=len(self.tiles),
+                    granules=self.stats.get("granules", 0))
         q_warp: queue.Queue = queue.Queue(self.queue_depth)
         q_encode: queue.Queue = queue.Queue(self.queue_depth)
+
+        def _traced(span_name, fn, *args):
+            # stage threads start from an empty contextvars.Context;
+            # re-bind this request's context (trace included) and wrap
+            # the stage's lifetime in one span.  One Context copy per
+            # thread — a Context cannot be entered concurrently.
+            ctx = contextvars.copy_context()
+
+            def tgt():
+                def body():
+                    with obs_span(span_name):
+                        fn(*args)
+                ctx.run(body)
+            return tgt
+
         decode_t = threading.Thread(
-            target=self._decode_stage, args=(plan, q_warp),
+            target=_traced("export.decode_stage",
+                           self._decode_stage, plan, q_warp),
             name="gsky-export-plan", daemon=True)
         enc_busy = [[0.0] for _ in range(self.encode_workers)]
         encoders = [threading.Thread(
-            target=self._encode_stage, args=(q_encode, enc_busy[i]),
+            target=_traced("export.encode_stage",
+                           self._encode_stage, q_encode, enc_busy[i]),
             name=f"gsky-export-encode-{i}", daemon=True)
             for i in range(self.encode_workers)]
         decode_t.start()
         for t in encoders:
             t.start()
         try:
-            self._warp_stage(q_warp, q_encode)
+            with obs_span("export.warp_stage"):
+                self._warp_stage(q_warp, q_encode)
         finally:
             # wake every stage: workers blocked on a bounded queue must
             # observe either a sentinel or the stop flag

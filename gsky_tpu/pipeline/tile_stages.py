@@ -40,6 +40,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs import record_span, span as obs_span
+
 
 def tile_pipeline_enabled() -> bool:
     """GSKY_TILE_PIPELINE=0 escape hatch — read per request so an
@@ -175,13 +177,26 @@ def _dispatch_stage(dispatch, spans: Dict):
     from .batcher import batching_enabled
     t0 = time.perf_counter()
     try:
-        if batching_enabled():
-            # the batcher NEEDS concurrent arrivals to coalesce into one
-            # vmapped dispatch; a narrow gate here would serialize them
-            # and defeat it, so batching mode keeps its own admission
-            return dispatch()
-        with _gate("dispatch").enter(spans, "dispatch_queue_max"):
-            return dispatch()
+        with obs_span("tile.dispatch") as sp:
+            try:
+                from ..server.prewarm import compile_count
+                c0 = compile_count()
+            except Exception:
+                compile_count, c0 = None, 0
+            try:
+                if batching_enabled():
+                    # the batcher NEEDS concurrent arrivals to coalesce
+                    # into one vmapped dispatch; a narrow gate here would
+                    # serialize them and defeat it, so batching mode
+                    # keeps its own admission
+                    sp.set(batched=True)
+                    return dispatch()
+                with _gate("dispatch").enter(spans, "dispatch_queue_max"):
+                    return dispatch()
+            finally:
+                if compile_count is not None:
+                    sp.set(fresh_compile=compile_count() > c0)
+                sp.set(queue_max=spans.get("dispatch_queue_max", 0))
     finally:
         spans["dispatch_s"] = spans.get("dispatch_s", 0.0) \
             + time.perf_counter() - t0
@@ -192,7 +207,9 @@ def _readback(dev, spans: Dict) -> np.ndarray:
     was started under the dispatch gate; this just blocks until the
     bytes land, which is exactly the overlap window other requests use."""
     t0 = time.perf_counter()
-    arr = np.asarray(dev)
+    with obs_span("tile.readback") as sp:
+        arr = np.asarray(dev)
+        sp.set(bytes=int(arr.nbytes))
     spans["readback_s"] = spans.get("readback_s", 0.0) \
         + time.perf_counter() - t0
     return arr
@@ -219,20 +236,30 @@ def render_staged(pipe, req, n_exprs: int,
     """
     spans = spans if spans is not None else {}
     t0 = time.perf_counter()
-    if n_exprs == 1:
-        made = pipe.composite_prep(req, stats, spans)
-    elif n_exprs == 3:
-        made = pipe._bands_prep(req, n_bands=3, stats=stats, spans=spans)
-    else:
-        made = pipe._bands_prep(req, stats=stats, spans=spans)
+    with obs_span("tile.plan") as psp:
+        if n_exprs == 1:
+            made = pipe.composite_prep(req, stats, spans)
+        elif n_exprs == 3:
+            made = pipe._bands_prep(req, n_bands=3, stats=stats,
+                                    spans=spans)
+        else:
+            made = pipe._bands_prep(req, stats=stats, spans=spans)
+        psp.set(qualified=made is not None)
     # "plan" is the prep minus the index query it contains
     spans["plan_s"] = spans.get("plan_s", 0.0) \
         + max(0.0, time.perf_counter() - t0 - spans.get("index_s", 0.0))
+    if spans.get("index_s"):
+        # the MAS query ran inside the prep (see _timed_index); surface
+        # it as its own span, anchored to where the prep ended
+        record_span("tile.index", spans["index_s"])
     if made is None:
         return None
 
     granules = made[0]
-    _decode_stage(pipe, req, granules, spans)
+    with obs_span("tile.decode") as dsp:
+        _decode_stage(pipe, req, granules, spans)
+        dsp.set(granules=len(granules),
+                queue_max=spans.get("decode_queue_max", 0))
 
     if n_exprs == 1:
         dev = _dispatch_stage(

@@ -20,7 +20,7 @@ import threading
 import time
 from typing import Callable, Dict, List
 
-from .registry import registry
+from .registry import note_event, registry
 
 
 class BackendUnavailable(RuntimeError):
@@ -139,6 +139,8 @@ class CircuitBreaker:
                 self._trip()
             new = self._state
             listeners = list(self._listeners) if new != old else ()
+        if new == self.OPEN and old != self.OPEN:
+            note_event("breaker_open", site=self.name)
         if listeners:
             self._notify(old, new, listeners)
 

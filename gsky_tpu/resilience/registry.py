@@ -16,6 +16,21 @@ import threading
 from typing import Dict
 
 
+def note_event(kind: str, **attrs) -> None:
+    """Cross-cutting observability hook: a point event on the current
+    trace root plus a prometheus counter tick.  Lazy imports (obs pulls
+    in no resilience code, but keep the coupling one-way at import
+    time) and never raises — resilience accounting must not fail a
+    request over a telemetry sink."""
+    try:
+        from ..obs import event
+        from ..obs.metrics import TRACE_EVENTS
+        TRACE_EVENTS.labels(kind=kind).inc()
+        event(kind, **attrs)
+    except Exception:
+        pass
+
+
 class ResilienceRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -30,10 +45,12 @@ class ResilienceRegistry:
     def count_retry(self, site: str) -> None:
         with self._lock:
             self._retries[site] = self._retries.get(site, 0) + 1
+        note_event("retry", site=site)
 
     def count_exhausted(self, site: str) -> None:
         with self._lock:
             self._exhausted[site] = self._exhausted.get(site, 0) + 1
+        note_event("retry_exhausted", site=site)
 
     def count_fault(self, site: str) -> None:
         with self._lock:
@@ -42,10 +59,12 @@ class ResilienceRegistry:
     def count_degraded(self) -> None:
         with self._lock:
             self.degraded_responses += 1
+        note_event("degraded")
 
     def count_deadline(self) -> None:
         with self._lock:
             self.deadline_exhausted += 1
+        note_event("deadline_exceeded")
 
     # ---- breakers ----------------------------------------------------
     def register_breaker(self, breaker) -> None:

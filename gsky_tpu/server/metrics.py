@@ -32,38 +32,73 @@ RESERVED_QUERY_PARAMS = {
 }
 
 
-def _cache_stats() -> Dict:
-    """Cumulative hit/miss counters of the process-wide caches — the
-    observability the reference gets from memcached stats in front of
-    MAS (`mas/api/api.go:43-52`), extended to the device-resident
-    tiers.  Lazy + guarded: metrics must never fail a request."""
-    out: Dict = {}
+# Cache counter sources, resolved once per process.  Every /debug
+# scrape and every request record folds these in; re-running the import
+# machinery four times per scrape was pure overhead.  The getters read
+# through the owning module so tests that swap a singleton still see
+# the live object.
+_CACHE_HANDLES = None
+_CACHE_HANDLES_LOCK = threading.Lock()
+
+
+def _resolve_cache_handles():
+    handles = []
     try:
-        from ..pipeline.scene_cache import default_scene_cache
-        out["scene"] = {"hits": default_scene_cache.hits,
-                        "misses": default_scene_cache.misses}
+        from ..pipeline import scene_cache as m
+        handles.append(("scene", lambda m=m: {
+            "hits": m.default_scene_cache.hits,
+            "misses": m.default_scene_cache.misses}))
     except Exception:
         pass
     try:
-        from ..pipeline.drill_cache import default_drill_cache
-        out["drill_stack"] = {"hits": default_drill_cache.hits,
-                              "misses": default_drill_cache.misses}
+        from ..pipeline import drill_cache as m
+        handles.append(("drill_stack", lambda m=m: {
+            "hits": m.default_drill_cache.hits,
+            "misses": m.default_drill_cache.misses}))
     except Exception:
         pass
     try:
-        from ..index.store import MASStore
-        out["mas_query"] = {"hits": MASStore.total_query_hits,
-                            "misses": MASStore.total_query_misses}
+        from ..index.store import MASStore as cls
+        handles.append(("mas_query", lambda cls=cls: {
+            "hits": cls.total_query_hits,
+            "misses": cls.total_query_misses}))
     except Exception:
         pass
     try:
         # the serving gateway in front of the pipelines: rendered-
         # response LRU hits, singleflight joins, admission sheds
-        from ..serving import default_gateway
-        out["response"] = default_gateway.cache_counters()
+        from .. import serving as m
+        handles.append(("response",
+                        lambda m=m: m.default_gateway.cache_counters()))
     except Exception:
         pass
+    return tuple(handles)
+
+
+def cache_stats() -> Dict:
+    """Cumulative hit/miss counters of the process-wide caches — the
+    observability the reference gets from memcached stats in front of
+    MAS (`mas/api/api.go:43-52`), extended to the device-resident
+    tiers.  Guarded: metrics must never fail a request.  Also the
+    source for the `/metrics` cache families (obs/metrics.py) so the
+    two endpoints cannot drift."""
+    global _CACHE_HANDLES
+    handles = _CACHE_HANDLES
+    if handles is None:
+        with _CACHE_HANDLES_LOCK:
+            if _CACHE_HANDLES is None:
+                _CACHE_HANDLES = _resolve_cache_handles()
+            handles = _CACHE_HANDLES
+    out: Dict = {}
+    for key, fn in handles:
+        try:
+            out[key] = fn()
+        except Exception:
+            pass
     return out
+
+
+_cache_stats = cache_stats          # historical internal name
 
 
 class MetricsCollector:
@@ -89,6 +124,9 @@ class MetricsCollector:
             # beyond the reference schema (SURVEY §5.1): time spent
             # blocked on the accelerator result, and the jax platform
             "device": {"duration": 0, "platform": ""},
+            # correlation id: joins this record to the flight-recorder
+            # trace and to worker-side log lines
+            "trace_id": "",
         }
 
     def set_url(self, raw_url: str, path: str, query: Dict[str, str]):
@@ -114,7 +152,13 @@ class MetricsCollector:
     def log(self, status: int = 200):
         self.info["http_status"] = status
         self.info["req_duration"] = int((time.time() - self._t0) * 1e9)
-        self.info["cache"] = _cache_stats()
+        self.info["cache"] = cache_stats()
+        if not self.info.get("trace_id"):
+            try:
+                from ..obs import current_trace_id
+                self.info["trace_id"] = current_trace_id() or ""
+            except Exception:
+                pass
         self._logger.record_summary(self.info)
         self._logger.write(self.info)
 
@@ -179,6 +223,12 @@ class MetricsLogger:
                     "duration", 0) / 1e6
                 s["rpc_ms"] += info.get("rpc", {}).get(
                     "duration", 0) / 1e6
+            # same fold point feeds /metrics: one clock, no drift
+            from ..obs.metrics import REQUESTS, REQUEST_SECONDS
+            svc = "DAP4" if "dap4.ce" in q else \
+                str(q.get("service", "?")).upper()
+            REQUESTS.labels(service=svc, status=str(status)).inc()
+            REQUEST_SECONDS.labels(service=svc).observe(dur_s)
         except Exception:   # observability must never fail a request
             pass
 
@@ -204,6 +254,11 @@ class MetricsLogger:
                     if k in stats:
                         e[k] = max(e.get(k, 0), stats[k])
                 e["last"] = dict(stats)
+            from ..obs.metrics import STAGE_SECONDS
+            for k in ("decode_s", "warp_s", "encode_s", "wall_s"):
+                if k in stats:
+                    STAGE_SECONDS.labels(
+                        stage="export_" + k[:-2]).observe(stats[k])
         except Exception:   # observability must never fail a request
             pass
 
@@ -229,6 +284,10 @@ class MetricsLogger:
                     if k in spans:
                         e[k] = max(e.get(k, 0), spans[k])
                 e["last"] = dict(spans)
+            from ..obs.metrics import STAGE_SECONDS
+            for k in self._TILE_SUMS:
+                if k.endswith("_s") and k in spans:
+                    STAGE_SECONDS.labels(stage=k[:-2]).observe(spans[k])
         except Exception:   # observability must never fail a request
             pass
 
@@ -281,6 +340,12 @@ class MetricsLogger:
             fs = fleet_stats()
             if fs:
                 out["fleet"] = fs
+        except Exception:   # observability must never fail a request
+            pass
+        try:
+            # flight-recorder occupancy (full traces via /debug/trace)
+            from ..obs import default_recorder
+            out["trace"] = default_recorder().stats()
         except Exception:   # observability must never fail a request
             pass
         return out

@@ -57,6 +57,7 @@ from ..pipeline.extent import compute_reprojection_extent
 from ..pipeline.feature_info import get_feature_info
 from ..pipeline.tile_stages import render_staged, tile_pipeline_enabled
 from ..pipeline.types import AxisSelector, MaskSpec
+from .. import obs
 from ..resilience import (BackendUnavailable, Deadline, DeadlineExceeded,
                           TooManyFailures, deadline_scope, degraded_reasons,
                           mark_degraded, request_scope)
@@ -219,17 +220,26 @@ class OWSServer:
         if gw is None or key is None:
             async with self._admit(svc):
                 return await render_inner()
-        ent = gw.cache.get(key)
+        with obs.span("gateway.lookup") as lsp:
+            ent = gw.cache.get(key)
+            lsp.set(hit=ent is not None)
         if ent is not None:
             collector.info["response_cache"] = "hit"
             return self._replay(request, ent, "hit")
 
         async def flight_fn():
+            t0, pc0 = time.time(), time.perf_counter()
             async with gw.admission.admit(svc):
-                return _freeze_response(await render_inner())
+                obs.record_span("gateway.admission",
+                                time.perf_counter() - pc0, t0=t0,
+                                service=svc)
+                with obs.span("render", service=svc):
+                    return _freeze_response(await render_inner())
 
         try:
-            frozen, joined = await gw.flight.do(key, flight_fn)
+            with obs.span("gateway.singleflight") as fsp:
+                frozen, joined = await gw.flight.do(key, flight_fn)
+                fsp.set(joined=joined)
         except (BackendUnavailable, TooManyFailures):
             # backend-open breaker / dead dependency: a stale cached
             # tile beats an error page.  Served degraded + labelled.
@@ -264,6 +274,12 @@ class OWSServer:
         # and executor state, optional jax-profiler trace capture
         app.router.add_get("/debug", self._debug)
         app.router.add_get("/debug/profile", self._debug_profile)
+        # flight recorder: recent + slowest/degraded traces (JSON or
+        # JSONL), one full span tree per id; Prometheus exposition
+        app.router.add_get("/debug/trace", self._debug_trace)
+        app.router.add_get("/debug/trace/{trace_id}",
+                           self._debug_trace_one)
+        app.router.add_get("/metrics", self._metrics)
         app.router.add_route("*", "/ows/{namespace:.*}", self.handle)
         if self.static_dir and os.path.isdir(self.static_dir):
             app.router.add_get("/", self._index)
@@ -311,6 +327,34 @@ class OWSServer:
             doc["serving"] = self.gateway.stats()
         doc["drain"] = self.drain.stats()
         return web.json_response(doc)
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        text = await asyncio.to_thread(obs.render_metrics)
+        return web.Response(
+            text=text,
+            content_type="text/plain",
+            charset="utf-8",
+            headers={"X-Prometheus-Exposition": "0.0.4"})
+
+    async def _debug_trace(self, request: web.Request) -> web.Response:
+        rec = obs.default_recorder()
+        if request.query.get("format") == "jsonl":
+            return web.Response(text=rec.dump_jsonl(),
+                                content_type="application/x-ndjson")
+        if request.query.get("slowest"):
+            slow = rec.slowest()
+            if slow is None:
+                raise web.HTTPNotFound(text="no traces recorded")
+            return web.json_response(slow)
+        return web.json_response({"stats": rec.stats(),
+                                  "traces": rec.summary()})
+
+    async def _debug_trace_one(self, request: web.Request) -> web.Response:
+        tid = request.match_info["trace_id"]
+        trace = obs.default_recorder().lookup(tid)
+        if trace is None:
+            raise web.HTTPNotFound(text=f"trace {tid!r} not retained")
+        return web.json_response(trace)
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """Capture a jax profiler trace for ?seconds=N (default 3, max
@@ -396,7 +440,20 @@ class OWSServer:
     async def handle(self, request: web.Request) -> web.Response:
         try:
             with self.drain.track():
-                return await self._handle(request)
+                # the trace context is born here, travels the whole
+                # request (ContextVar), crosses the worker RPC hop via
+                # gRPC metadata, and lands in the flight recorder on
+                # exit (GSKY_TRACE=0 short-circuits all of it)
+                with obs.start_trace(
+                        "ows.request",
+                        path=getattr(request, "path", "")) as otrace:
+                    resp = await self._handle(request)
+                    if otrace is not None:
+                        otrace.status = resp.status
+                        deg = resp.headers.get("X-GSKY-Degraded")
+                        if deg:
+                            otrace.degraded = deg.split(",")
+                    return resp
         except Draining:
             # refused at the gate: the balancer should close this
             # connection and retry against a peer gateway
@@ -416,6 +473,10 @@ class OWSServer:
             "X-Forwarded-For", peer).split(",")[0].strip())
         try:
             with request_scope() as rstate:
+                obs.set_attr(
+                    verb="DAP4.ce" if "dap4.ce" in q else
+                    f"{q.get('service', '?')}.{q.get('request', '?')}",
+                    ns=ns)
                 cfg = self.watcher.get(ns)
                 if cfg is None:
                     raise OWSError(
@@ -813,7 +874,8 @@ class OWSServer:
         record rides along and is folded into the /debug `tile_stages`
         aggregates once the encode lands."""
         if not tile_pipeline_enabled():
-            return fn(*args, **kw)
+            with obs.span("encode", inline=True):
+                return fn(*args, **kw)
         try:
             return await encode_async(fn, *args, spans=spans, **kw)
         finally:

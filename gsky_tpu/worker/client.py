@@ -23,6 +23,7 @@ accumulation — upgraded from static round-robin to fleet routing
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextvars
 import itertools
 import json
 import logging
@@ -34,6 +35,9 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..fleet import DRAINING, FleetRouter, hedged_call, tile_route_key
+from ..obs import (adopt_spans, current_trace_id, event as obs_event,
+                   span as obs_span, traceparent)
+from ..obs.metrics import RPC_SECONDS, TRACE_EVENTS
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
 from ..pipeline.types import GeoTileRequest, Granule
@@ -47,6 +51,26 @@ from .server import METHOD
 log = logging.getLogger("gsky.worker.client")
 
 DEFAULT_CONC_PER_NODE = 16
+
+# ops whose Result.info_json is free for the span-backhaul envelope
+# ("info" / "worker_info" already carry their payloads there)
+_SPAN_OPS = ("warp", "drill", "extent")
+
+
+def _note(kind: str, **attrs) -> None:
+    """Cross-cutting trace event + prom counter; never raises."""
+    try:
+        TRACE_EVENTS.labels(kind=kind).inc()
+        obs_event(kind, **attrs)
+    except Exception:
+        pass
+
+
+def _rpc_observe(op: str, outcome: str, dur_s: float) -> None:
+    try:
+        RPC_SECONDS.labels(op=op, outcome=outcome).observe(dur_s)
+    except Exception:
+        pass
 
 
 class NodeBusy(BackendUnavailable):
@@ -236,6 +260,11 @@ class WorkerClient:
             start = next(self._rr)
             order = [(start + k) % n for k in range(n)]
         timeout = clamp_timeout(self.timeout)
+        # one metadata tuple per dispatch: the trace context crosses the
+        # process boundary as gRPC metadata (x-gsky-trace: "tid-sid")
+        tp = traceparent()
+        md = (("x-gsky-trace", tp),) if tp else None
+        op = task.operation
         busy = 0
         last: Optional[Exception] = None
         last_busy = ""
@@ -249,26 +278,33 @@ class WorkerClient:
             try:
                 faults.inject("worker")
                 t0 = time.monotonic()
-                if (pos == 0 and keyed and self.fleet.hedge_enabled
-                        and len(order) > 1):
-                    res, hedge_won = self._call_hedged(
-                        task, i, order[1], timeout)
-                    if hedge_won:
-                        i = order[1]
-                        br = self._breakers[i]
-                        node = self.nodes[i]
-                else:
-                    res = self._stubs[i](task, timeout=timeout)
+                with obs_span("rpc.worker", node=node, op=op,
+                              attempt=pos) as rsp:
+                    if (pos == 0 and keyed and self.fleet.hedge_enabled
+                            and len(order) > 1):
+                        res, hedge_won = self._call_hedged(
+                            task, i, order[1], timeout, md)
+                        if hedge_won:
+                            i = order[1]
+                            br = self._breakers[i]
+                            node = self.nodes[i]
+                            rsp.set(node=node, hedge_won=True)
+                            _note("hedge_won", node=node)
+                    else:
+                        res = self._stubs[i](task, timeout=timeout,
+                                             metadata=md)
                 dt = time.monotonic() - t0
             except Exception as e:
                 br.record_failure()
                 self.fleet.node_result(node, ok=False,
                                        fatal=self._is_fatal(e))
+                _rpc_observe(op, "transport", time.monotonic() - t0)
                 last = e
                 if pos + 1 < len(order):
                     registry.count_retry("worker")
                     if keyed:
                         self.fleet.record_reroute()
+                        _note("reroute", node=node, reason="failure")
                 continue
             finally:
                 self.fleet.task_finished(started)
@@ -277,25 +313,43 @@ class WorkerClient:
                 # alive, just saturated: no breaker penalty, fail over
                 br.record_success()
                 self.fleet.node_result(node, ok=True)
+                _rpc_observe(op, "busy", dt)
+                rsp.set(outcome="busy")
                 busy += 1
                 last_busy = err
                 if keyed:
                     self.fleet.record_reroute()
+                    _note("reroute", node=node, reason="busy")
                 continue
             if err.startswith("draining:"):
                 # alive, leaving: deregister from routing, fail over
                 br.record_success()
                 self.fleet.node_result(node, ok=True, draining=True)
+                _rpc_observe(op, "draining", dt)
+                rsp.set(outcome="draining")
                 if keyed:
                     self.fleet.record_reroute()
+                    _note("reroute", node=node, reason="draining")
                 continue
             # a real answer (success or semantic error): the node lives
             br.record_success()
             self.fleet.node_result(node, ok=True, latency_s=dt)
+            outcome = "error" if err else "ok"
+            _rpc_observe(op, outcome, dt)
+            rsp.set(outcome=outcome)
             if keyed:
                 self.fleet.record_locality(route_key, node)
             else:
                 self.fleet.record_rr()
+            if md is not None and op in _SPAN_OPS and res.info_json:
+                # the worker's child spans ride back on the free-form
+                # info_json channel; stitch them into the live trace
+                try:
+                    env = json.loads(res.info_json)
+                    if isinstance(env, dict):
+                        adopt_spans(env.get("spans"))
+                except ValueError:
+                    pass
             return res
         if busy:
             raise NodeBusy(
@@ -309,7 +363,7 @@ class WorkerClient:
             site="worker") from last
 
     def _call_hedged(self, task: pb.Task, i: int, j: int,
-                     timeout: float) -> Tuple[pb.Result, bool]:
+                     timeout: float, md=None) -> Tuple[pb.Result, bool]:
         """First-candidate dispatch with a straggler hedge onto node
         ``j``.  The hedge consumes a *spare* limiter permit (or does not
         fire), spends one hedge-budget token, and whichever copy loses
@@ -319,7 +373,8 @@ class WorkerClient:
 
         def primary():
             fl.hedge.on_primary()
-            return self._stubs[i].future(task, timeout=timeout)
+            return self._stubs[i].future(task, timeout=timeout,
+                                         metadata=md)
 
         def hedge():
             # raising here just means "no hedge" to hedged_call
@@ -332,8 +387,10 @@ class WorkerClient:
             if not self.limiter.try_acquire():
                 raise RuntimeError("no spare permit for hedge")
             permit[0] = True
+            _note("hedge", node=self.nodes[j])
             try:
-                return self._stubs[j].future(task, timeout=timeout)
+                return self._stubs[j].future(task, timeout=timeout,
+                                             metadata=md)
             except Exception:
                 permit[0] = False
                 self.limiter.release()
@@ -512,7 +569,16 @@ class WorkerClient:
                 failures.append(e)
                 return None
 
-        parts = list(self._fanout.map(one, jobs))
+        def one_bound(arg):
+            # the fan-out pool's threads start from an empty Context;
+            # each job gets its own copy of the caller's (a single
+            # Context cannot be entered from two threads at once)
+            ctx, job = arg
+            return ctx.run(one, job)
+
+        parts = list(self._fanout.map(
+            one_bound,
+            [(contextvars.copy_context(), j) for j in jobs]))
         # an explicit flag, NOT a job-count comparison: footprint
         # pruning can leave exactly one sub-tile per granule, and those
         # sub-rasters must still assemble into full-tile canvases
@@ -532,8 +598,9 @@ class WorkerClient:
                 out[i][0][oy:oy + th, ox:ox + tw] = np.asarray(d)
                 out[i][1][oy:oy + th, ox:ox + tw] = np.asarray(v)
         if failures:
-            log.warning("%d/%d warp RPCs failed (first: %s)",
-                        len(failures), len(jobs), failures[0])
+            log.warning("%d/%d warp RPCs failed (first: %s) trace=%s",
+                        len(failures), len(jobs), failures[0],
+                        current_trace_id() or "-")
             if len(failures) < len(jobs):
                 from ..resilience import mark_degraded
                 mark_degraded("worker")

@@ -62,13 +62,20 @@ def _set_pdeathsig():
 
 
 class _Task:
-    __slots__ = ("task", "event", "result", "attempts")
+    __slots__ = ("task", "event", "result", "attempts", "trace_id")
 
     def __init__(self, task: pb.Task):
         self.task = task
         self.event = threading.Event()
         self.result: Optional[pb.Result] = None
         self.attempts = 0
+        # captured at submit: the feeder thread that logs a crash has no
+        # request context of its own
+        try:
+            from ..obs import current_trace_id
+            self.trace_id = current_trace_id() or "-"
+        except Exception:
+            self.trace_id = "-"
 
 
 class PoolFullError(RuntimeError):
@@ -184,8 +191,8 @@ class Process:
                     self._respawn()
             except (ConnectionError, OSError) as e:
                 # crash/wedge: kill + replace + retry (`process.go:189-198`)
-                log.warning("subprocess %d task failed (%s); restarting",
-                            self.idx, e)
+                log.warning("subprocess %d task failed (%s); restarting "
+                            "trace=%s", self.idx, e, item.trace_id)
                 self._kill()
                 self._respawn()
                 item.attempts += 1

@@ -189,3 +189,46 @@ class TestSinks:
         with gzip.open(tmp_path / gz[-1], "rt") as fp:
             rec = json.loads(fp.readline())
         assert rec == {"i": 4}           # newest archived record intact
+
+
+class TestTraceCorrelation:
+    """The structured request log carries the flight-recorder trace_id
+    so a slow log line can be joined to its span waterfall."""
+
+    def test_log_fills_trace_id_from_context(self):
+        from gsky_tpu import obs
+        obs.reset_recorder()
+        try:
+            c = MetricsLogger().collector()
+            with obs.start_trace("req") as tr:
+                c.log(200)
+            assert c.info["trace_id"] == tr.trace_id
+        finally:
+            obs.reset_recorder()
+
+    def test_log_untraced_leaves_trace_id_blank(self):
+        c = MetricsLogger().collector()
+        c.log(200)
+        assert c.info["trace_id"] == ""
+
+
+class TestCacheHandles:
+    """cache_stats resolves its import handles once per process, then
+    reads through the owning modules so swapped singletons stay live."""
+
+    def test_handles_resolved_once(self, monkeypatch):
+        monkeypatch.setattr(M, "_CACHE_HANDLES", None)
+        M.cache_stats()
+        handles = M._CACHE_HANDLES
+        assert handles                       # resolved and cached
+        M.cache_stats()
+        assert M._CACHE_HANDLES is handles   # no per-scrape re-resolve
+
+    def test_handles_read_live_singletons(self, monkeypatch):
+        import gsky_tpu.pipeline.scene_cache as sc
+        monkeypatch.setattr(M, "_CACHE_HANDLES", None)
+        M.cache_stats()                      # resolve against the real module
+        monkeypatch.setattr(sc, "default_scene_cache",
+                            types.SimpleNamespace(hits=41, misses=1))
+        out = M.cache_stats()
+        assert out["scene"] == {"hits": 41, "misses": 1}

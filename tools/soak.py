@@ -21,7 +21,11 @@ sizes stay at or below their configured LRU bounds.
 tile grid with Zipf-distributed popularity — driven against a baseline
 server (gateway=None) and then a gateway-fronted one, reporting
 client-side p50/p99 per phase plus the gateway's response-cache hit
-rate, singleflight joins and admission sheds from /debug.
+rate, singleflight joins and admission sheds from /debug.  Also runs
+the tracing overhead guard — hot-cache p50 with tracing on (default
+sampling) must stay within --max-trace-overhead percent of a
+GSKY_TRACE=0 phase — asserts /metrics passes the strict exposition
+parser, and prints the slowest request's critical path.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario hot --seconds 60
 
@@ -69,7 +73,10 @@ wait for the phi-accrual detector to re-admit it, and require the
 locality rate to recover to >= 90% of the pre-kill baseline).  A coda
 spawns one deliberately slow node (``GSKY_FAULTS=node:slow``) and
 shows hedged keyed dispatch beating unhedged p99 within the hedge
-budget.
+budget.  Also requires at least one recorded trace STITCHED across the
+process boundary (worker-process spans under the gateway's trace id),
+a strict /metrics parse including the worker-RPC histogram, and prints
+the slowest request's critical-path waterfall (tools/trace_view.py).
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario fleet --seconds 25
 """
@@ -97,6 +104,40 @@ def rss_mb() -> float:
     return 0.0
 
 
+def check_metrics(host: str,
+                  require=("gsky_requests_total", "gsky_request_seconds",
+                           "gsky_stage_seconds")) -> dict:
+    """Scrape /metrics and run it through the STRICT exposition parser
+    (shared with the unit tests): a malformed line or a broken
+    histogram invariant raises, a missing family fails the soak."""
+    from gsky_tpu.obs.prom import parse_exposition
+    with urllib.request.urlopen(f"http://{host}/metrics",
+                                timeout=30) as r:
+        fams = parse_exposition(r.read().decode())
+    return {"families": len(fams),
+            "missing": [f for f in require if f not in fams]}
+
+
+def slowest_trace_report(host: str):
+    """Waterfall + critical-path breakdown of the slowest recorded
+    request (the flight recorder's reservoir), printed to stdout before
+    the JSON result line.  Returns a JSON-able summary (None when the
+    recorder has nothing — tracing off or no traffic)."""
+    import trace_view as tv
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}/debug/trace?slowest=1", timeout=30) as r:
+            trace = json.loads(r.read())
+    except Exception:
+        return None
+    print(tv.render(trace), flush=True)
+    return {"trace_id": trace.get("trace_id"),
+            "dur_ms": round((trace.get("dur_s") or 0.0) * 1e3, 1),
+            "processes": sorted({s.get("process") or "?"
+                                 for s in trace.get("spans", [])}),
+            "critical_path": tv.critical_breakdown(trace)}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=120.0)
@@ -108,6 +149,9 @@ def main(argv=None):
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
+    ap.add_argument("--max-trace-overhead", type=float, default=2.0,
+                    help="hot scenario: max hot-cache p50 regression "
+                         "(percent) with tracing on vs GSKY_TRACE=0")
     ap.add_argument("--faults",
                     default="mas:error:0.2,worker:error:0.2,"
                             "decode:error:0.2",
@@ -358,6 +402,25 @@ def run_hot(args, watcher, mas_client, merc, boot) -> int:
     gate_host = boot(gate_srv)
     gate = phase(gate_host, half)
 
+    # tracing overhead guard: with the response cache warm, replay the
+    # same Zipf load untraced (GSKY_TRACE=0, read per request) and then
+    # traced (default: ring recording on, file sampling off) — the
+    # hot-cache p50 must not regress by more than --max-trace-overhead
+    # percent (plus a timer-quantisation epsilon; hit-path p50 is ~ms)
+    ov_s = max(6.0, args.seconds * 0.25)
+    os.environ["GSKY_TRACE"] = "0"
+    try:
+        untraced = phase(gate_host, ov_s)
+    finally:
+        os.environ.pop("GSKY_TRACE", None)
+    traced = phase(gate_host, ov_s)
+    overhead_pct = round(
+        (traced["p50_ms"] - untraced["p50_ms"])
+        / max(untraced["p50_ms"], 1e-9) * 100.0, 2)
+    overhead_ok = traced["p50_ms"] <= (
+        untraced["p50_ms"] * (1.0 + args.max_trace_overhead / 100.0)
+        + 0.1)
+
     with urllib.request.urlopen(f"http://{gate_host}/debug",
                                 timeout=30) as r:
         serving = json.loads(r.read()).get("serving", {})
@@ -370,11 +433,21 @@ def run_hot(args, watcher, mas_client, merc, boot) -> int:
         c.get("shed", 0) for c in
         serving.get("admission", {}).get("classes", {}).values())
 
+    metrics = check_metrics(gate_host)
+    trace_rep = slowest_trace_report(gate_host)
+
     out = {"scenario": "hot", "tiles": len(tiles),
-           "zipf": args.zipf, "baseline": base, "gateway": gate}
+           "zipf": args.zipf, "baseline": base, "gateway": gate,
+           "trace_overhead": {"untraced": untraced, "traced": traced,
+                              "p50_overhead_pct": overhead_pct,
+                              "ok": overhead_ok},
+           "metrics": metrics, "slowest_trace": trace_rep}
     print(json.dumps(out))
     ok = (base["failed"] == 0 and gate["failed"] == 0
-          and gate["hit_rate"] > 0.3)
+          and untraced["failed"] == 0 and traced["failed"] == 0
+          and gate["hit_rate"] > 0.3
+          and overhead_ok
+          and not metrics["missing"])
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
 
@@ -520,8 +593,11 @@ def run_chaos(args, watcher, mas_client, merc, boot) -> int:
                                 timeout=30) as r:
         res = json.loads(r.read()).get("resilience", {})
     breakers = res.get("breakers", {})
+    metrics = check_metrics(host)
+    trace_rep = slowest_trace_report(host)
     out = {
         "scenario": "chaos", "faults": args.faults,
+        "metrics": metrics, "slowest_trace": trace_rep,
         "warm_failures": warm_bad, "responses": counts,
         "stale_on_error": {"refresh": refresh_cls, "replay": stale_cls},
         "resilience": {
@@ -543,6 +619,7 @@ def run_chaos(args, watcher, mas_client, merc, boot) -> int:
           and sum(res.get("retries", {}).values()) > 0
           and sum(res.get("faults_injected", {}).values()) > 0
           and res.get("degraded_responses", 0) > 0
+          and not metrics["missing"]
           and any(b.get("failures", 0) > 0 for b in breakers.values()))
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
@@ -907,6 +984,23 @@ def run_fleet(args, watcher, mas_client, merc, boot) -> int:
         recovery = rate(h2, m2, h3, m3)
         fb = fleet_block()
 
+        # observability: the fleet path is the one place every process
+        # boundary is crossed, so require (a) /metrics to satisfy the
+        # strict exposition parser with the worker-RPC family present,
+        # and (b) at least one recorded trace to be STITCHED — gateway
+        # spans plus worker-process child spans carried back over the
+        # RPC's info_json under one trace id
+        metrics = check_metrics(
+            host, require=("gsky_requests_total", "gsky_request_seconds",
+                           "gsky_stage_seconds",
+                           "gsky_worker_rpc_seconds"))
+        with urllib.request.urlopen(f"http://{host}/debug/trace",
+                                    timeout=30) as r:
+            listing = json.loads(r.read())
+        stitched = [t for t in listing.get("traces", [])
+                    if "worker" in (t.get("processes") or [])]
+        trace_rep = slowest_trace_report(host)
+
         # free the fleet before the hedge coda (1-core host): keep one
         # fast node, add one deliberately slow one
         for p in (ports[1], ports[2]):
@@ -964,6 +1058,9 @@ def run_fleet(args, watcher, mas_client, merc, boot) -> int:
             "routed": fb.get("routed", 0),
             "revived_state": state,
             "hedge": hedge_out,
+            "metrics": metrics,
+            "stitched_traces": len(stitched),
+            "slowest_trace": trace_rep,
         }
         print(json.dumps(out))
         all_counts: dict = {}
@@ -983,6 +1080,8 @@ def run_fleet(args, watcher, mas_client, merc, boot) -> int:
               # piles onto it, and a winning hedge credits the runner-up
               and baseline > 1.0 / 3.0
               and recovery >= 0.9 * baseline
+              and not metrics["missing"]
+              and len(stitched) > 0
               and hedge_out.get("ready") is True
               and hedge_out.get("hedge_wins", 0) > 0
               and hedge_out.get("hedges", 0)

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Text waterfall for gsky traces, with critical-path annotation.
+
+Reads one trace (the JSON shape `/debug/trace/<id>` serves — see
+gsky_tpu/obs/trace.py::Trace.to_dict) and prints an indented waterfall:
+one line per span with its process, duration, a time-proportional bar,
+and a ``*`` marker on the critical path — the root-to-leaf chain that
+ended last at every level, i.e. the spans that actually bounded the
+request's wall time.  A breakdown of that chain's *exclusive* time
+(each span minus its on-path child) follows, which is the "where did
+the latency go" answer in three lines.
+
+Sources:
+
+    python tools/trace_view.py --host 127.0.0.1:8080            # slowest
+    python tools/trace_view.py --host 127.0.0.1:8080 --id <tid>
+    python tools/trace_view.py trace.json                       # file
+    curl -s host/debug/trace/<id> | python tools/trace_view.py  # stdin
+
+Also imported by tools/soak.py to print the slowest request's critical
+path at the end of a soak — keep it dependency-free (stdlib only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+
+def load_trace(source: Optional[str] = None,
+               host: Optional[str] = None,
+               trace_id: Optional[str] = None) -> Dict:
+    """One trace dict from a host's debug endpoint, a file, or stdin."""
+    if host:
+        path = f"/debug/trace/{trace_id}" if trace_id \
+            else "/debug/trace?slowest=1"
+        with urllib.request.urlopen(f"http://{host}{path}",
+                                    timeout=30) as r:
+            return json.loads(r.read())
+    text = sys.stdin.read() if source in (None, "-") \
+        else open(source).read()
+    doc = json.loads(text.splitlines()[0] if "\n" in text.strip()
+                     and text.lstrip().startswith("{") and
+                     '"trace_id"' in text.splitlines()[0] else text)
+    if isinstance(doc, dict) and "traces" in doc:   # /debug/trace listing
+        raise SystemExit("got a trace LISTING; pass --id to pick one")
+    return doc
+
+
+def _children(trace: Dict) -> Tuple[List[Dict], Dict[str, List[Dict]]]:
+    """(start-ordered spans, parent_id -> children).  Spans whose parent
+    is unknown (dropped, or a remote parent that stayed remote) hang off
+    the root so nothing silently disappears from the view."""
+    spans = [dict(s) for s in trace.get("spans", [])]
+    spans.sort(key=lambda s: s.get("t0") or 0.0)
+    ids = {s.get("span_id") for s in spans}
+    root_id = spans[0].get("span_id") if spans else None
+    kids: Dict[str, List[Dict]] = {}
+    for s in spans:
+        pid = s.get("parent_id")
+        if s.get("span_id") == root_id:
+            continue
+        if pid not in ids or pid == s.get("span_id"):
+            pid = root_id
+        kids.setdefault(pid, []).append(s)
+    return spans, kids
+
+
+def _end(s: Dict) -> float:
+    return (s.get("t0") or 0.0) + (s.get("dur_s") or 0.0)
+
+
+def critical_path(trace: Dict) -> List[Dict]:
+    """Root-to-leaf chain picked by latest END time at each level: the
+    spans whose completion gated the request finishing when it did."""
+    spans, kids = _children(trace)
+    if not spans:
+        return []
+    path = [spans[0]]
+    while True:
+        cs = kids.get(path[-1].get("span_id"))
+        if not cs:
+            return path
+        path.append(max(cs, key=_end))
+
+
+def critical_breakdown(trace: Dict) -> List[Dict]:
+    """Exclusive milliseconds per critical-path span (its duration minus
+    the on-path child's), largest first — the latency budget."""
+    path = critical_path(trace)
+    out = []
+    for i, s in enumerate(path):
+        dur = (s.get("dur_s") or 0.0) * 1e3
+        child = (path[i + 1].get("dur_s") or 0.0) * 1e3 \
+            if i + 1 < len(path) else 0.0
+        out.append({"name": s.get("name"), "process": s.get("process"),
+                    "exclusive_ms": round(max(dur - child, 0.0), 2)})
+    out.sort(key=lambda d: -d["exclusive_ms"])
+    return out
+
+
+def render(trace: Dict, width: int = 40) -> str:
+    """The waterfall text.  Bars are positioned on the root's timeline;
+    sub-resolution spans still get one tick so they stay visible."""
+    spans, kids = _children(trace)
+    if not spans:
+        return "(empty trace)"
+    root = spans[0]
+    t0 = root.get("t0") or 0.0
+    total = max(root.get("dur_s") or 0.0, 1e-9)
+    crit = {s.get("span_id") for s in critical_path(trace)}
+
+    lines = [
+        "trace %s  %s  %.1fms  status=%s%s" % (
+            trace.get("trace_id", "?"), root.get("name", "?"),
+            total * 1e3, trace.get("status"),
+            " DEGRADED" if trace.get("degraded") else ""),
+        "%-8s %1s %-34s %9s  timeline" % ("process", "", "span", "ms"),
+    ]
+
+    def emit(s: Dict, depth: int) -> None:
+        off = max(0.0, (s.get("t0") or 0.0) - t0)
+        dur = s.get("dur_s") or 0.0
+        a = min(int(off / total * width), width - 1)
+        b = min(max(a + 1, int((off + dur) / total * width)), width)
+        bar = " " * a + "#" * (b - a)
+        name = ("  " * depth + str(s.get("name", "?")))[:34]
+        attrs = s.get("attrs") or {}
+        extra = ""
+        if "error" in attrs:
+            extra = "  !%s" % attrs["error"]
+        lines.append("%-8s %1s %-34s %9.2f  |%-*s|%s" % (
+            (s.get("process") or "?")[:8],
+            "*" if s.get("span_id") in crit else "",
+            name, dur * 1e3, width, bar, extra))
+        for c in kids.get(s.get("span_id"), ()):
+            emit(c, depth + 1)
+
+    emit(root, 0)
+    ev = root.get("events") or []
+    if ev:
+        lines.append("events: " + ", ".join(
+            e.get("name", "?") + (
+                "(%s)" % e["site"] if e.get("site") else "")
+            for e in ev))
+    lines.append("critical path (exclusive ms): " + " -> ".join(
+        "%s/%s %.2f" % (d["process"], d["name"], d["exclusive_ms"])
+        for d in critical_breakdown(trace)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="trace_view")
+    ap.add_argument("source", nargs="?",
+                    help="trace JSON file, or - for stdin")
+    ap.add_argument("--host", help="fetch from host:port/debug/trace")
+    ap.add_argument("--id", dest="trace_id",
+                    help="trace id (with --host; default: slowest)")
+    ap.add_argument("--width", type=int, default=40)
+    a = ap.parse_args(argv)
+    trace = load_trace(a.source, host=a.host, trace_id=a.trace_id)
+    print(render(trace, width=max(a.width, 10)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
