@@ -216,8 +216,65 @@ def _collect_runtime():
     return out
 
 
+def _collect_batcher():
+    """RenderBatcher engagement + padding bill and the page-pool
+    residency stats (the ragged paged rendering telemetry,
+    docs/KERNELS.md)."""
+    out: List = []
+    try:
+        from ..pipeline.executor import default_executor
+        b = default_executor._batcher
+        st = b.stats()
+        out.append(_g("gsky_batch_knee",
+                      "Adaptive coalesce cap (tiles per flush).",
+                      [({}, float(st.get("batch_knee", 0)))]))
+        out.append(_c("gsky_batches_total",
+                      "Batch flushes by dispatch kind.",
+                      [({"kind": "windowed"},
+                        float(st.get("win_batches", 0))),
+                       ({"kind": "full"},
+                        float(st.get("full_batches", 0))),
+                       ({"kind": "paged"},
+                        float(st.get("paged_batches", 0)))]))
+        out.append(_c("gsky_pad_waste_bytes_total",
+                      "Bytes moved for pow2/bucket padding instead of "
+                      "payload across batch flushes.",
+                      [({}, float(st.get("pad_waste_bytes", 0)))]))
+        out.append(_c("gsky_paged_dispatches_total",
+                      "Executor dispatches served by the paged path vs "
+                      "declined to buckets.",
+                      [({"outcome": "engaged"},
+                        float(default_executor.paged_engaged)),
+                       ({"outcome": "declined"},
+                        float(default_executor.paged_declined))]))
+    except Exception:
+        pass
+    try:
+        from ..pipeline import pages
+        if pages._default is not None:   # don't allocate just to report
+            st = pages._default.stats()
+            out.append(_g("gsky_page_pool_resident",
+                          "Pages resident in the pool.",
+                          [({}, float(st.get("resident", 0)))]))
+            out.append(_g("gsky_page_pool_capacity",
+                          "Page pool capacity (pages).",
+                          [({}, float(st.get("capacity", 0)))]))
+            out.append(_c("gsky_page_pool_staged_total",
+                          "Pages staged into the pool.",
+                          [({}, float(st.get("staged", 0)))]))
+            out.append(_c("gsky_page_pool_hits_total",
+                          "Page-table hits on already-staged pages.",
+                          [({}, float(st.get("hits", 0)))]))
+            out.append(_c("gsky_page_pool_evictions_total",
+                          "LRU page evictions.",
+                          [({}, float(st.get("evictions", 0)))]))
+    except Exception:
+        pass
+    return out
+
+
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
-            _collect_runtime):
+            _collect_runtime, _collect_batcher):
     _REG.register_collector(_fn)
 
 

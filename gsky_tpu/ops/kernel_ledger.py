@@ -41,6 +41,35 @@ _DEFAULT_NAME = "gsky_kernel_ledger.jsonl"
 
 VERDICTS = ("promoted", "demoted", "failed")
 
+# record-schema version this process writes; loaders skip lines with a
+# version they don't understand (never crash on a newer worker's file)
+SCHEMA_VERSION = 1
+
+# kernels whose tokens are VERSIONED: the token's first element must be
+# this prefix for a ledger verdict to replay onto the kernel.  The paged
+# kernels (ops/paged.py) introduced the scheme — their token meaning
+# (page geometry + ragged pads) is disjoint from the bucketed-era
+# (stack-shape, window-bucket) tokens, and a stale bucketed verdict
+# replayed onto them would demote/promote the wrong program.  Bump the
+# prefix (pg1 -> pg2) when a kernel's token meaning changes.
+TOKEN_VERSIONS = {
+    "warp_scored_paged": "pg1",
+    "warp_render_paged": "pg1",
+}
+
+
+def token_version_ok(kernel: str, token) -> bool:
+    """True when a decoded ledger token belongs to `kernel`'s CURRENT
+    token scheme: versioned kernels require the matching prefix;
+    unversioned kernels reject tokens that carry any known version
+    prefix (a paged verdict must not replay onto the bucketed race)."""
+    want = TOKEN_VERSIONS.get(kernel)
+    lead = token[0] if isinstance(token, tuple) and token else None
+    if want is not None:
+        return lead == want
+    return not (isinstance(lead, str)
+                and lead in set(TOKEN_VERSIONS.values()))
+
 _lock = threading.Lock()
 # set by the server from its metrics -log_dir; env always wins
 _default_dir: Optional[str] = None
@@ -70,9 +99,9 @@ def record(kernel: str, token, verdict: str,
     if verdict not in VERDICTS:
         return
     try:
-        doc = {"kernel": str(kernel), "token": repr(token),
-               "verdict": verdict, "ts": round(time.time(), 3),
-               "pid": os.getpid()}
+        doc = {"v": SCHEMA_VERSION, "kernel": str(kernel),
+               "token": repr(token), "verdict": verdict,
+               "ts": round(time.time(), 3), "pid": os.getpid()}
         if t_pallas_ms is not None:
             doc["t_pallas_ms"] = round(float(t_pallas_ms), 3)
         if t_xla_ms is not None:
@@ -113,6 +142,9 @@ def entries() -> Dict[Tuple[str, str], Dict]:
                     continue
                 if not isinstance(doc, dict):
                     continue
+                v = doc.get("v", 1)     # pre-versioning lines are v1
+                if not isinstance(v, int) or v > SCHEMA_VERSION:
+                    continue            # newer worker's schema: skip
                 k = doc.get("kernel")
                 t = doc.get("token")
                 if not isinstance(k, str) or not isinstance(t, str) \

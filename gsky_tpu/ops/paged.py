@@ -1,0 +1,369 @@
+"""Ragged paged rendering: one fused warp-render program for every
+tile shape.
+
+The bucketed dispatch (`ops.pallas_tpu` + `pipeline.executor`) bounds
+recompilation by padding every gather window up to `_WIN_BUCKETS` and
+every batch to a power of two — each (window-bucket x batch-pow2)
+combination is its own XLA program, pad waste inflates the expensive
+host<->device pull, and `RenderBatcher` can only coalesce tiles whose
+shapes already match.  Following Ragged Paged Attention (PAPERS.md),
+which serves arbitrary ragged KV lengths from paged HBM pools with ONE
+compiled kernel, this module replaces the shape axes with a page
+indirection:
+
+- gather windows live in fixed-size HBM pages (`GSKY_PAGE_SIZE`,
+  default 128x512 f32; validity is NaN-encoded exactly like the scene
+  cache) allocated from a shared pool (`pipeline.pages.PagePool`) —
+  pages are content-keyed on (scene, page row, page col), so
+  overlapping tiles share them;
+- a per-tile page table (page slots + per-granule window origin/extent,
+  rows of the same (B, 16) params block the bucketed kernel uses)
+  drives the kernel: grid (tile, block_y, block_x, granule) with the
+  granule axis innermost, so the pallas pipeline DMAs granule t+1's
+  page list HBM->VMEM while granule t computes — the same
+  double-buffered page walk paged attention does over ragged KV;
+- the kernel body is the bucketed fused kernel's body op for op
+  (affine -> true-extent oob NaN-poisoning -> page-table gather ->
+  tap-side validity -> strictly-greater priority mosaic -> optional
+  byte-scale epilogue), so parity transfers: nearest is bit-exact and
+  interpolated methods are <= 2 ulp vs the XLA reference
+  (tests/test_paged.py).
+
+Shape axes that remain static are RAGGED-PADDED, not shape-bucketed:
+the granule axis pads to the pow2 of the LARGEST tile in the dispatch
+(padding rows carry ns_id -1 and a null page table) and the page-table
+width to the pow2 of the largest page count — so one program per
+(method, n_ns, out_hw, granule-pow2, slot-pow2) serves arbitrary
+window shapes, and the program count is independent of traffic shape
+diversity.  `GSKY_PAGED=0` restores the bucketed path byte-identically
+(the paged branch sits strictly above the existing entry points).
+
+Race verdicts for the paged kernels use a versioned token prefix
+(`PAGED_TOKEN_VERSION`) so stale bucketed-era ledger lines never
+replay onto them; see `ops.kernel_ledger.token_version_ok`.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_tpu import (_HAVE_PLTPU, _WARP_BLK, _WARP_VMEM_BUDGET,
+                         pallas_interpret, pltpu, run_with_fallback,
+                         use_pallas)
+
+# token scheme version for paged-kernel ledger verdicts: bump when the
+# paged program's meaning changes (page walk, params layout) so old
+# verdicts are skipped instead of replayed onto a different kernel
+PAGED_TOKEN_VERSION = "pg1"
+
+# params row width: slots 0..10 are the bucketed kernel's contract
+# (affine, true extent, nodata, priority, ns id), 11/12 the page-grid
+# window origin, 13/14 the page-aligned window extent, 15 the page
+# columns per page row (the table's row stride)
+PARAMS_W = 16
+
+
+def page_shape():
+    """(page_rows, page_cols) from GSKY_PAGE_SIZE ("RxC", default
+    128x512) — clamped to the f32 tile grid (rows multiple of 8, cols
+    multiple of 128) so pages are always lane-aligned VMEM blocks."""
+    v = os.environ.get("GSKY_PAGE_SIZE", "128x512").lower()
+    try:
+        r, c = v.split("x")
+        pr, pc = int(r), int(c)
+    except (ValueError, AttributeError):
+        pr, pc = 128, 512
+    pr = max(8, (pr // 8) * 8)
+    pc = max(128, (pc // 128) * 128)
+    return pr, pc
+
+
+def page_slots() -> int:
+    """Max page-table slots per granule (GSKY_PAGE_SLOTS, default 8):
+    windows needing more pages than this fall back to the bucketed
+    path — the knob bounds the kernel's per-granule VMEM residency."""
+    try:
+        s = int(os.environ.get("GSKY_PAGE_SLOTS", "8"))
+    except ValueError:
+        s = 8
+    return max(1, min(64, s))
+
+
+def paged_enabled() -> bool:
+    """Paged dispatch gate: on by default wherever the pallas kernels
+    run (real TPU or GSKY_PALLAS=interpret); GSKY_PAGED=0 restores the
+    bucketed path byte-identically.  XLA-only serving (plain CPU)
+    keeps buckets — the paged walk is a pallas formulation."""
+    return os.environ.get("GSKY_PAGED", "1") != "0" and use_pallas()
+
+
+def paged_vmem_ok(slots: int, n_ns: int, pr: int, pc: int) -> bool:
+    """Eligibility gate, checked BEFORE the race: a page list too big
+    for VMEM must go to the bucketed path, not burn the kernel-name
+    blacklist on a predictable OOM."""
+    pages = slots * pr * pc * 4 * 2          # page block, x2 DMA
+    acc = n_ns * _WARP_BLK * _WARP_BLK * 4 * 2 * 2   # canv+best
+    grids = _WARP_BLK * _WARP_BLK * 4 * 2 * 2        # sx+sy, x2
+    return pages + acc + grids <= _WARP_VMEM_BUDGET
+
+
+def _paged_render_kernel(method: str, n_ns: int, T: int, S: int,
+                         pr: int, pc: int):
+    """Kernel-body closure.  Grid (n, by, bx, t), granule axis t
+    INNERMOST: the pages BlockSpec indexes by (n, t), so the pallas
+    pipeline stages tile n granule t+1's page list into VMEM while
+    granule t computes — double-buffered ragged page walking.  The
+    per-namespace accumulators stay VMEM-resident across the t sweep
+    (initialised at t == 0).
+
+    Per granule the body mirrors `pallas_tpu._warp_render_kernel` op
+    for op; the only new arithmetic is the page indirection in `tap`:
+    window-relative (ri, ci) -> (page row, page col) -> table slot ->
+    flat offset into this granule's staged page block.  Window origins
+    are page-aligned, so the rebase subtraction stays exact (integer
+    <= 4096 off an f32 coordinate < 2^12) and tap values match the
+    bucketed gather bit for bit."""
+    page = pr * pc
+
+    def kernel(params_ref, sx_ref, sy_ref, pages_ref, canv_ref,
+               best_ref):
+        n = pl.program_id(0)
+        t = pl.program_id(3)
+
+        @pl.when(t == 0)
+        def _init():
+            canv_ref[:] = jnp.zeros(canv_ref.shape, canv_ref.dtype)
+            best_ref[:] = jnp.full(best_ref.shape, -jnp.inf,
+                                   best_ref.dtype)
+
+        def p(k):
+            return params_ref[n * T + t, k]
+
+        sx = sx_ref[0]
+        sy = sy_ref[0]
+        cols = (p(0) + p(1) * sx + p(2) * sy) - 0.5
+        rows = (p(3) + p(4) * sx + p(5) * sy) - 0.5
+        oob = (rows < -0.5) | (rows > p(6) - 0.5) \
+            | (cols < -0.5) | (cols > p(7) - 0.5)
+        rows = jnp.where(oob, jnp.nan, rows)
+        rows = rows - p(11)     # page-aligned window-origin rebase
+        cols = cols - p(12)     # (exact: int <= 4096 off f32 < 2^12)
+        wri = p(13).astype(jnp.int32)   # page-aligned window extent
+        wci = p(14).astype(jnp.int32)
+        ppc = p(15).astype(jnp.int32)   # page cols per page row
+        flat = pages_ref[0, 0].reshape(S * page)
+        nd = p(8)
+
+        def tap(ri, ci, inb):
+            # page walk: window-relative index -> table slot -> flat
+            # offset in this granule's staged pages.  Padding granules
+            # have wri == wci == 0, so inb is False and the clipped
+            # offset only needs to stay addressable.
+            lp = (ri // pr) * ppc + (ci // pc)
+            idx = lp * page + (ri % pr) * pc + (ci % pc)
+            idx = jnp.clip(idx, 0, S * page - 1)
+            v = flat[idx]
+            ok = inb & jnp.isfinite(v) & (v != nd)
+            return jnp.where(ok, v, 0.0), ok
+
+        if method in ("near", "nearest"):
+            ri = jnp.floor(rows + (0.5 + 1e-10)).astype(jnp.int32)
+            ci = jnp.floor(cols + (0.5 + 1e-10)).astype(jnp.int32)
+            inb = (ri >= 0) & (ri < wri) & (ci >= 0) & (ci < wci) \
+                & jnp.isfinite(rows) & jnp.isfinite(cols)
+            val, ok = tap(jnp.clip(ri, 0, wri - 1),
+                          jnp.clip(ci, 0, wci - 1), inb)
+        else:
+            finite = jnp.isfinite(rows) & jnp.isfinite(cols)
+            rows = jnp.where(finite, rows, -10.0)
+            cols = jnp.where(finite, cols, -10.0)
+            r0 = jnp.floor(rows)
+            c0 = jnp.floor(cols)
+            fr = rows - r0
+            fc = cols - c0
+            r0 = r0.astype(jnp.int32)
+            c0 = c0.astype(jnp.int32)
+            if method == "bilinear":
+                taps = [(dr, dc,
+                         (fr if dr else 1 - fr) * (fc if dc else 1 - fc))
+                        for dr in (0, 1) for dc in (0, 1)]
+                thresh = 1e-6
+            else:               # cubic (Catmull-Rom)
+                from .warp import _cubic_weights
+                wr_ = _cubic_weights(fr)
+                wc_ = _cubic_weights(fc)
+                taps = [(dr - 1, dc - 1, wr_[dr] * wc_[dc])
+                        for dr in range(4) for dc in range(4)]
+                thresh = 0.05
+            acc = jnp.zeros(rows.shape, jnp.float32)
+            wacc = jnp.zeros(rows.shape, jnp.float32)
+            for dr, dc, wt in taps:
+                ri = r0 + dr
+                ci = c0 + dc
+                inb = (ri >= 0) & (ri < wri) & (ci >= 0) & (ci < wci)
+                v, okt = tap(jnp.clip(ri, 0, wri - 1),
+                             jnp.clip(ci, 0, wci - 1), inb)
+                okf = okt.astype(jnp.float32)
+                acc = acc + wt * okf * v
+                wacc = wacc + wt * okf
+            ok = finite & (wacc > thresh)
+            val = acc / jnp.where(wacc > thresh, wacc, 1.0)
+
+        prio = p(9)
+        ns = p(10)
+        for m in range(n_ns):   # static unroll (n_ns is pow2-bounded)
+            member = ns == jnp.float32(m)
+            s_m = jnp.where(member & ok, prio, -jnp.inf)
+            b = best_ref[0, m, :, :]
+            take = s_m > b      # strict: first-seen wins ties
+            canv_ref[0, m, :, :] = jnp.where(take, val,
+                                             canv_ref[0, m, :, :])
+            best_ref[0, m, :, :] = jnp.where(take, s_m, b)
+
+    return kernel
+
+
+def _paged_scored(pool, tables, params, ctrls, method, n_ns, out_hw,
+                  step, interpret):
+    """Shared core: XLA prologue (page-table gather out of the pool +
+    per-tile ctrl-grid upsample) feeding one fused pallas_call over
+    every tile in the dispatch.  Returns (canv (N, n_ns, h, w) f32,
+    best (N, n_ns, h, w) f32, -inf = invalid).
+
+    The gather `pool[tables]` is the whole HBM data movement of the
+    dispatch: exactly the staged pages, no pow2 window pad — the XLA
+    gather is page-granular (contiguous (pr, pc) blocks), which is the
+    coalesced access pattern the pool layout exists for."""
+    from .warp import _bilerp_grid
+    h, w = out_hw
+    N, T, S = (int(tables.shape[0]), int(tables.shape[1]),
+               int(tables.shape[2]))
+    pr, pc = int(pool.shape[1]), int(pool.shape[2])
+    pages = pool[tables.reshape(-1)].reshape(N, T, S * pr, pc)
+    sx = jax.vmap(lambda c: _bilerp_grid(c[0], h, w, step))(ctrls)
+    sy = jax.vmap(lambda c: _bilerp_grid(c[1], h, w, step))(ctrls)
+    hp = -(-h // _WARP_BLK) * _WARP_BLK
+    wp = -(-w // _WARP_BLK) * _WARP_BLK
+    if (hp, wp) != (h, w):
+        sx = jnp.pad(sx, ((0, 0), (0, hp - h), (0, wp - w)))
+        sy = jnp.pad(sy, ((0, 0), (0, hp - h), (0, wp - w)))
+    kernel = _paged_render_kernel(method, n_ns, T, S, pr, pc)
+    if _HAVE_PLTPU and not interpret:
+        params_spec = pl.BlockSpec(
+            memory_space=getattr(pltpu, "SMEM", None))
+    else:
+        params_spec = pl.BlockSpec((N * T, PARAMS_W),
+                                   lambda n, i, j, t: (0, 0))
+    canv, best = pl.pallas_call(
+        kernel,
+        grid=(N, hp // _WARP_BLK, wp // _WARP_BLK, T),
+        in_specs=[
+            params_spec,
+            pl.BlockSpec((1, _WARP_BLK, _WARP_BLK),
+                         lambda n, i, j, t: (n, i, j)),
+            pl.BlockSpec((1, _WARP_BLK, _WARP_BLK),
+                         lambda n, i, j, t: (n, i, j)),
+            pl.BlockSpec((1, 1, S * pr, pc),
+                         lambda n, i, j, t: (n, t, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_ns, _WARP_BLK, _WARP_BLK),
+                         lambda n, i, j, t: (n, 0, i, j)),
+            pl.BlockSpec((1, n_ns, _WARP_BLK, _WARP_BLK),
+                         lambda n, i, j, t: (n, 0, i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, n_ns, hp, wp), jnp.float32),
+            jax.ShapeDtypeStruct((N, n_ns, hp, wp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(params, sx, sy, pages)
+    return canv[:, :, :h, :w], best[:, :, :h, :w]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "interpret"))
+def warp_scored_paged(pool, tables, params, ctrls, method: str = "near",
+                      n_ns: int = 1, out_hw=(256, 256), step: int = 16,
+                      interpret: bool = False):
+    """Paged counterpart of `ops.warp.warp_scenes_ctrl_scored`, over N
+    tiles at once: pool (cap, pr, pc) f32, tables (N, T, S) int32 page
+    slots (null slot 0 pads), params (N*T, 16) f32, ctrls (N, 2, gh,
+    gw) f32.  Returns (canvases (N, n_ns, h, w), best (N, n_ns, h, w),
+    -inf = invalid).  The jit key holds NO window shape: one program
+    per (method, n_ns, out_hw, step, T, S) serves every tile shape."""
+    return _paged_scored(pool, tables, params, ctrls, method, n_ns,
+                         tuple(out_hw), step, interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "auto", "colour_scale", "interpret"))
+def render_byte_paged(pool, tables, params, ctrls, sps,
+                      method: str = "near", n_ns: int = 1,
+                      out_hw=(256, 256), step: int = 16,
+                      auto: bool = True, colour_scale: int = 0,
+                      interpret: bool = False):
+    """Paged counterpart of `ops.warp.render_scenes_ctrl` (and of the
+    batcher's `render_scenes_ctrl_many`): fused paged warp + mosaic,
+    then the SAME composite/byte-scale epilogue per tile.  sps (N, 3)
+    f32.  Returns PNG-ready uint8 (N, h, w) tiles."""
+    from .warp import composite_scale
+    canv, best = _paged_scored(pool, tables, params, ctrls, method,
+                               n_ns, tuple(out_hw), step, interpret)
+    return jax.vmap(
+        lambda c, b, sp: composite_scale(c, b > -jnp.inf, sp, auto,
+                                         colour_scale))(canv, best, sps)
+
+
+def _paged_token(pool, tables, method, n_ns, out_hw, step, extra=()):
+    """Versioned race token: leads with PAGED_TOKEN_VERSION so ledger
+    replay can skip verdicts from other token schemes
+    (`kernel_ledger.token_version_ok`).  Shape axes are the ragged
+    pads (T, S) and the page geometry — NOT window shapes — so the
+    token set stays a handful per method."""
+    return (PAGED_TOKEN_VERSION, int(tables.shape[0]),
+            int(tables.shape[1]), int(tables.shape[2]),
+            int(pool.shape[1]), int(pool.shape[2]), str(method),
+            int(n_ns), (int(out_hw[0]), int(out_hw[1])),
+            int(step)) + tuple(extra)
+
+
+def warp_scored_paged_raced(pool, tables, params, ctrls, method, n_ns,
+                            out_hw, step, xla_thunk):
+    """(canvases (N, n_ns, h, w), best) — the paged kernel raced (via
+    `run_with_fallback` + the durable ledger) against the caller's
+    bucketed XLA closure, which must return the same (N, ...) shape."""
+    def _pallas():
+        return warp_scored_paged(pool, tables, params, ctrls, method,
+                                 n_ns, out_hw, step,
+                                 interpret=pallas_interpret())
+
+    return run_with_fallback(
+        "warp_scored_paged", _pallas, xla_thunk,
+        sync_token=_paged_token(pool, tables, method, n_ns, out_hw,
+                                step))
+
+
+def render_byte_paged_raced(pool, tables, params, ctrls, sps, method,
+                            n_ns, out_hw, step, auto, colour_scale,
+                            xla_thunk):
+    """uint8 (N, h, w) tiles — the fully fused paged warp+mosaic+scale
+    raced against the caller's bucketed XLA closure (the GetMap hot
+    path under GSKY_PAGED)."""
+    def _pallas():
+        return render_byte_paged(pool, tables, params, ctrls, sps,
+                                 method, n_ns, out_hw, step, auto,
+                                 colour_scale,
+                                 interpret=pallas_interpret())
+
+    token = _paged_token(pool, tables, method, n_ns, out_hw, step,
+                         extra=(bool(auto), int(colour_scale)))
+    return run_with_fallback("warp_render_paged", _pallas, xla_thunk,
+                             sync_token=token)

@@ -159,6 +159,11 @@ def reload_ledger() -> int:
             token = kernel_ledger.decode_token(tok)
             if token is None:
                 continue
+            if not kernel_ledger.token_version_ok(name, token):
+                # stale token scheme (e.g. a bucketed-era verdict in a
+                # file now shared with the paged kernels): skip, the
+                # kernel re-races under its current scheme
+                continue
             if verdict == "demoted":
                 while len(_SLOW) >= 4096:
                     _SLOW.pop()

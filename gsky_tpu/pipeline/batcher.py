@@ -76,6 +76,13 @@ class RenderBatcher:
         # (engagement telemetry, mirroring WarpExecutor.win_engaged)
         self.win_batches = 0
         self.full_batches = 0
+        # ragged paged flushes (GSKY_PAGED batching path) and the
+        # running padding bill: bytes moved (uploads + pull + staged
+        # gather source) that served pow2/bucket padding instead of
+        # payload.  The paged path exists to shrink this figure; the
+        # split is surfaced in /debug and as Prometheus gauges
+        self.paged_batches = 0
+        self.pad_waste_bytes = 0
         # adaptive throughput knee: coalescing amortises device round
         # trips, but past some batch size the padded pull's BYTES cost
         # more than the round trips saved (render_mosaic_256_x8
@@ -110,12 +117,17 @@ class RenderBatcher:
                 self.knee = min(self.knee, max(1, np_size // 2))
 
     def stats(self) -> Dict:
-        """/debug `gather_window` payload: where the knee sits and the
-        evidence (per padded-size per-tile EMA ms) behind it."""
+        """/debug `gather_window` payload: where the knee sits, the
+        evidence (per padded-size per-tile EMA ms) behind it, batch
+        engagement counters, and the cumulative padding bill."""
         with self._lock:
             return {"batch_knee": self.knee,
                     "tile_ms": {k: round(v, 3)
-                                for k, v in sorted(self._tile_ms.items())}}
+                                for k, v in sorted(self._tile_ms.items())},
+                    "win_batches": self.win_batches,
+                    "full_batches": self.full_batches,
+                    "paged_batches": self.paged_batches,
+                    "pad_waste_bytes": self.pad_waste_bytes}
 
     def render(self, key: tuple, stack, ctrl, params, sp,
                statics: tuple, win_raw=None) -> np.ndarray:
@@ -193,11 +205,26 @@ class RenderBatcher:
             sps = np.stack([it[2] for it in items]
                            + [items[0][2]] * (Np - N))
             win, win0 = self._union_window(items, stack)
+            # padding bill (approximate, documented in docs/KERNELS.md):
+            # pow2 batch-pad replicas of the uploads + the padded uint8
+            # pull, plus the window-bucket overshoot of the gathered
+            # source over the raw union footprint
+            h, w = out_hw
+            waste = (Np - N) * (h * w + ctrls[0].nbytes
+                                + params[0].nbytes + sps[0].nbytes)
+            if win is not None:
+                raw = (max(it[3][1] for it in items)
+                       - min(it[3][0] for it in items)) * \
+                      (max(it[3][3] for it in items)
+                       - min(it[3][2] for it in items))
+                waste += max(0, win[0] * win[1] - raw) * 4 \
+                    * int(stack.shape[0])
             with self._lock:
                 if win is not None:
                     self.win_batches += 1
                 else:
                     self.full_batches += 1
+                self.pad_waste_bytes += int(waste)
             try:
                 BATCH_FLUSHES.labels(
                     kind="windowed" if win is not None else "full").inc()
@@ -220,3 +247,131 @@ class RenderBatcher:
             for it in items:
                 if not it[4].done():
                     it[4].set_exception(e)
+
+    # -- ragged paged batching (GSKY_PAGED, ops/paged.py) -------------
+
+    def render_paged(self, key: tuple, pool, tables, params16, ctrl,
+                     sp, statics: tuple, real_pages: int,
+                     fallback) -> np.ndarray:
+        """Submit one tile whose gather windows are already staged in
+        the page pool; blocks until its batch executes.  Unlike
+        `render`, ``key`` carries NO scene-stack or window-shape
+        identity — only the statics — so HETEROGENEOUS concurrent
+        tiles (different scene sets, scene counts and window sizes)
+        coalesce into one ragged dispatch; the flush pads the granule
+        and page-slot axes to the batch maxima instead of shape
+        buckets.  ``tables`` arrives PINNED (executor's
+        `_paged_from_group`); the flush unpins after enqueue.
+        ``fallback`` is (stack, params11, win, win0) for the race's
+        per-tile bucketed XLA leg."""
+        fut: Future = Future()
+        item = (pool, tables, params16, ctrl, sp, int(real_pages),
+                fallback, fut)
+        flush_now = None
+        with self._lock:
+            entry = self._groups.get(key)
+            if entry is None:
+                timer = threading.Timer(self.max_wait_s,
+                                        self._flush_key_paged,
+                                        (key, statics))
+                timer.daemon = True
+                self._groups[key] = (None, [item], timer)
+                timer.start()
+            else:
+                entry[1].append(item)
+                if len(entry[1]) >= min(self.max_batch, self.knee):
+                    flush_now = self._groups.pop(key)
+        if flush_now is not None:
+            flush_now[2].cancel()
+            self._execute_paged(flush_now[1], statics, trigger="size")
+        return fut.result()
+
+    def _flush_key_paged(self, key: tuple, statics: tuple):
+        with self._lock:
+            entry = self._groups.pop(key, None)
+        if entry is not None:
+            self._execute_paged(entry[1], statics, trigger="timer")
+
+    def _execute_paged(self, items, statics: tuple,
+                       trigger: str = "size"):
+        method, n_ns, out_hw, step, auto, colour_scale = statics
+        h, w = out_hw
+        pool = items[0][0]
+        try:
+            from ..ops.paged import PARAMS_W, render_byte_paged_raced
+            N = len(items)
+            Np = 1
+            while Np < N:
+                Np *= 2
+            Np = min(Np, self.max_batch)
+            # ragged pad: granule axis to the batch's LARGEST tile
+            # (per-item T is already pow2, so the max is too), page
+            # slots likewise — no shape buckets, one compiled program
+            # per (statics, T, S) point regardless of window shapes
+            T = max(it[1].shape[0] for it in items)
+            S = max(it[1].shape[1] for it in items)
+            tables = np.zeros((Np, T, S), np.int32)
+            params = np.zeros((Np, T, PARAMS_W), np.float32)
+            params[:, :, 10] = -1.0     # ns_id: padding rows
+            for i, it in enumerate(items):
+                ti, si = it[1].shape
+                tables[i, :ti, :si] = it[1]
+                params[i, :ti] = it[2]
+            ctrls = np.stack([it[3] for it in items]
+                             + [items[0][3]] * (Np - N))
+            sps = np.stack([it[4] for it in items]
+                           + [items[0][4]] * (Np - N))
+            real_pages = sum(it[5] for it in items)
+            page_bytes = pool.page_rows * pool.page_cols * 4
+            waste = (Np - N) * (h * w + ctrls[0].nbytes
+                                + T * PARAMS_W * 4 + sps[0].nbytes) \
+                + (Np * T * S - real_pages) * page_bytes
+            with self._lock:
+                self.paged_batches += 1
+                self.pad_waste_bytes += int(waste)
+            try:
+                BATCH_FLUSHES.labels(kind="paged").inc()
+            except Exception:
+                pass
+
+            def _xla():
+                # per-tile bucketed XLA legs, stacked to the paged
+                # output contract (runs only when racing or demoted)
+                from ..ops.warp import render_scenes_ctrl
+                from .executor import _dev_win0    # lazy: avoids cycle
+                outs = []
+                for it in items:
+                    stack, bparams, bwin, bwin0 = it[6]
+                    outs.append(render_scenes_ctrl(
+                        stack, jnp.asarray(it[3]), jnp.asarray(bparams),
+                        jnp.asarray(it[4]), method, n_ns, out_hw, step,
+                        auto, colour_scale, win=bwin,
+                        win0=_dev_win0(bwin0)))
+                outs += [outs[0]] * (Np - N)
+                return jnp.stack(outs)
+
+            t0 = time.perf_counter()
+            with obs_span("batch.flush", trigger=trigger) as bsp:
+                with pool.locked_pool() as parr:
+                    dev = render_byte_paged_raced(
+                        parr, jnp.asarray(tables),
+                        jnp.asarray(params.reshape(Np * T, PARAMS_W)),
+                        jnp.asarray(ctrls), jnp.asarray(sps), method,
+                        n_ns, out_hw, step, auto, colour_scale, _xla)
+                # slice off the batch pad BEFORE the pull: the padded
+                # tiles never cross the link
+                out = np.asarray(dev[:N])
+                bsp.set(tiles=N, padded=Np, paged=True)
+            self._observe(Np, N, (time.perf_counter() - t0) * 1e3)
+            for i, it in enumerate(items):
+                it[7].set_result(out[i])
+        except Exception as e:  # pragma: no cover - propagate to callers
+            for it in items:
+                if not it[7].done():
+                    it[7].set_exception(e)
+        finally:
+            for it in items:
+                try:
+                    pool.unpin(it[1])
+                except Exception:   # pragma: no cover
+                    pass

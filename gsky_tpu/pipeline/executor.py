@@ -22,6 +22,8 @@ import numpy as np
 
 from ..geo.crs import CRS
 from ..geo.transform import GeoTransform
+from ..ops.paged import PARAMS_W as PAGED_PARAMS_W
+from ..ops.paged import paged_enabled
 from ..ops.pallas_tpu import render_byte_raced, warp_scored_raced
 from ..ops.warp import (combine_scored, render_scenes_bands_ctrl,
                         warp_gather_batch)
@@ -96,38 +98,29 @@ def _win_bucket(n: int) -> int:
     return _bucket_in(n, _WIN_BUCKETS)
 
 
-def _gather_window(params64: np.ndarray, cx: np.ndarray, cy: np.ndarray,
-                   bucket_h: int, bucket_w: int):
-    """(win, win0) covering every granule's finite gather footprint, or
-    None when windowing can't help (footprint ~ scene, or no finite
-    coords).  Exactness: the dense device coords are the bilinear
-    interpolation of the ctrl-point coords with the per-granule affine
+def _granule_bounds(p: np.ndarray, cx: np.ndarray, cy: np.ndarray):
+    """Raw gather-footprint bounds (r_lo, r_hi, c_lo, c_hi) of ONE
+    granule's param row, or None when the granule has no finite coords
+    (nothing to gather).  Exactness: the dense device coords are the
+    bilinear interpolation of the ctrl-point coords with the affine
     applied — affine commutes with interpolation, so the dense extremes
     are bounded by the affine evaluated at the ctrl points, computed
-    here in f64.
-
-    params64: (B, 11) f64 granule params (ns_id < 0 rows are padding);
-    cx/cy: host ctrl coords (gh, gw), possibly NaN."""
-    rmin = cmin = np.inf
-    rmax = cmax = -np.inf
-    for p in params64:
-        if p[10] < 0:
-            continue
-        # clamp to the kernel's oob thresholds (coords past the true
-        # extent are NaN-poisoned on device and never gathered): a tile
-        # straddling a scene edge must not inflate the footprint to its
-        # off-scene extent and lose the window
-        cols = np.clip(p[0] + p[1] * cx + p[2] * cy - 0.5, -1.0, p[7])
-        rows = np.clip(p[3] + p[4] * cx + p[5] * cy - 0.5, -1.0, p[6])
-        ok = np.isfinite(rows) & np.isfinite(cols)
-        if not ok.any():
-            continue
-        rmin = min(rmin, float(rows[ok].min()))
-        rmax = max(rmax, float(rows[ok].max()))
-        cmin = min(cmin, float(cols[ok].min()))
-        cmax = max(cmax, float(cols[ok].max()))
-    if not np.isfinite(rmin) or not np.isfinite(cmin):
+    here in f64.  The same margin rules serve `_gather_window` (bucketed
+    windows) and `_paged_from_group` (page-grid coverage), so the two
+    paths gather the same taps."""
+    # clamp to the kernel's oob thresholds (coords past the true
+    # extent are NaN-poisoned on device and never gathered): a tile
+    # straddling a scene edge must not inflate the footprint to its
+    # off-scene extent and lose the window
+    cols = np.clip(p[0] + p[1] * cx + p[2] * cy - 0.5, -1.0, p[7])
+    rows = np.clip(p[3] + p[4] * cx + p[5] * cy - 0.5, -1.0, p[6])
+    ok = np.isfinite(rows) & np.isfinite(cols)
+    if not ok.any():
         return None
+    rmin = float(rows[ok].min())
+    rmax = float(rows[ok].max())
+    cmin = float(cols[ok].min())
+    cmax = float(cols[ok].max())
     r_lo = math.floor(rmin) - _WIN_MARGIN
     c_lo = math.floor(cmin) - _WIN_MARGIN
     # high edge gets one extra pixel: the device recomputes coords in
@@ -135,6 +128,34 @@ def _gather_window(params64: np.ndarray, cx: np.ndarray, cy: np.ndarray,
     # one, pushing cubic's +2 tap one past _WIN_MARGIN
     r_hi = math.floor(rmax) + _WIN_MARGIN + 2
     c_hi = math.floor(cmax) + _WIN_MARGIN + 2
+    return r_lo, r_hi, c_lo, c_hi
+
+
+def _gather_window(params64: np.ndarray, cx: np.ndarray, cy: np.ndarray,
+                   bucket_h: int, bucket_w: int):
+    """(win, win0) covering every granule's finite gather footprint, or
+    None when windowing can't help (footprint ~ scene, or no finite
+    coords).
+
+    params64: (B, 11) f64 granule params (ns_id < 0 rows are padding);
+    cx/cy: host ctrl coords (gh, gw), possibly NaN."""
+    r_lo = c_lo = None
+    r_hi = c_hi = None
+    for p in params64:
+        if p[10] < 0:
+            continue
+        made = _granule_bounds(p, cx, cy)
+        if made is None:
+            continue
+        if r_lo is None:
+            r_lo, r_hi, c_lo, c_hi = made
+        else:
+            r_lo = min(r_lo, made[0])
+            r_hi = max(r_hi, made[1])
+            c_lo = min(c_lo, made[2])
+            c_hi = max(c_hi, made[3])
+    if r_lo is None:
+        return None
     made = finish_window(r_lo, r_hi, c_lo, c_hi, bucket_h, bucket_w)
     if made is None:
         return None
@@ -200,6 +221,11 @@ class WarpExecutor:
         # window vs groups that declined (footprint ~ scene / no coords)
         self.win_engaged = 0
         self.win_declined = 0
+        # paged-path engagement (GSKY_PAGED on): dispatches served from
+        # the page pool vs declined back to buckets (page budget / pool
+        # pressure / multi-CRS)
+        self.paged_engaged = 0
+        self.paged_declined = 0
         from .batcher import RenderBatcher
         self._batcher = RenderBatcher()
 
@@ -493,7 +519,7 @@ class WarpExecutor:
             return None
         n_pad = _bucket_pow2(n_ns)
         if len(groups) == 1:
-            stack, _, params, step, _, ctrl_dev, win, win0, _ = groups[0]
+            stack, _, params, step, _, ctrl_dev, win, win0, *_ = groups[0]
             spmd = default_spmd()
             if spmd is not None:
                 # mesh path (GSKY_SPMD=1): granule axis over `granule`,
@@ -505,6 +531,33 @@ class WarpExecutor:
                     stack, ctrl_dev, params, method, n_pad,
                     (height, width), step, win=win, win0=win0)
                 return canv, best > -jnp.inf
+            if paged_enabled():
+                made_p = self._paged_from_group(groups[0], n_pad)
+                if made_p is not None:
+                    pool, tables, params16, _ = made_p
+                    self._note_paged(True)
+                    self._count("scene_mosaic_paged", tables.shape)
+                    from ..ops.paged import warp_scored_paged_raced
+
+                    def _xla():
+                        from ..ops.warp import warp_scenes_ctrl_scored
+                        c, b = warp_scenes_ctrl_scored(
+                            stack, ctrl_dev, jnp.asarray(params),
+                            method, n_pad, (height, width), step,
+                            win=win, win0=_dev_win0(win0))
+                        return c[None], b[None]
+
+                    try:
+                        with pool.locked_pool() as parr:
+                            canvs, bests = warp_scored_paged_raced(
+                                parr, jnp.asarray(tables[None]),
+                                jnp.asarray(params16), ctrl_dev[None],
+                                method, n_pad, (height, width), step,
+                                _xla)
+                    finally:
+                        pool.unpin(tables)
+                    return canvs[0], bests[0] > -jnp.inf
+                self._note_paged(False)
             self._count("scene_mosaic", (stack.shape, win))
             self._note_win(win)
             canv, best = warp_scored_raced(stack, ctrl_dev,
@@ -525,7 +578,7 @@ class WarpExecutor:
                     method, n_pad, (height, width), step,
                     win=win, win0_dev=_dev_win0(win0))
                  for stack, _, params, step, _, ctrl_dev, win,
-                 win0, _ in groups]
+                 win0, *_ in groups]
         canvs = jnp.stack([p[0] for p in parts])
         bests = jnp.stack([p[1] for p in parts])
         return combine_scored(canvs, bests)
@@ -545,7 +598,8 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, ctrl, params, step, skey, ctrl_dev, win, win0, win_raw = made
+        stack, ctrl, params, step, skey, ctrl_dev, win, win0, win_raw, \
+            *_ = made
         sp = np.array([offset, scale, clip], np.float32)
         statics = (method, _bucket_pow2(n_ns), (height, width), step,
                    auto, colour_scale)
@@ -557,6 +611,41 @@ class WarpExecutor:
                 stack, ctrl_dev, params, sp, *statics,
                 win=win, win0=win0))
         from .batcher import batching_enabled
+        if paged_enabled():
+            made_p = self._paged_from_group(made, statics[1])
+            if made_p is not None:
+                pool, tables, params16, real_pages = made_p
+                self._note_paged(True)
+                if batching_enabled():
+                    # the paged batch key carries NO stack/shape
+                    # identity: tiles over different scene sets and
+                    # window sizes coalesce into one ragged dispatch
+                    self._count("render_byte_paged_batched",
+                                tables.shape)
+                    fallback = (stack, params, win, win0)
+                    return self._batcher.render_paged(
+                        ("paged",) + statics, pool, tables, params16,
+                        ctrl, sp, statics, real_pages, fallback)
+                self._count("render_byte_paged", tables.shape)
+                from ..ops.paged import render_byte_paged_raced
+
+                def _xla():
+                    from ..ops.warp import render_scenes_ctrl
+                    return render_scenes_ctrl(
+                        stack, ctrl_dev, jnp.asarray(params),
+                        jnp.asarray(sp), *statics, win=win,
+                        win0=_dev_win0(win0))[None]
+
+                try:
+                    with pool.locked_pool() as parr:
+                        out = render_byte_paged_raced(
+                            parr, jnp.asarray(tables[None]),
+                            jnp.asarray(params16), ctrl_dev[None],
+                            jnp.asarray(sp[None]), *statics, _xla)
+                finally:
+                    pool.unpin(tables)
+                return _prefetch(out[0])
+            self._note_paged(False)
         if batching_enabled():
             # batched tiles share one dispatch; the batcher unions the
             # per-tile windows at flush (its win_batches/full_batches
@@ -590,7 +679,7 @@ class WarpExecutor:
                                   dst_crs, height, width, cache)
         if made is None:
             return None
-        stack, _, params, step, _, ctrl_dev, win, win0, _ = made
+        stack, _, params, step, _, ctrl_dev, win, win0, *_ = made
         self._count("render_bands", (stack.shape, win))
         self._note_win(win)
         sp = jnp.asarray(np.array([offset, scale, clip], np.float32))
@@ -695,6 +784,91 @@ class WarpExecutor:
             packed, ctrl_dev, jnp.asarray(param), jnp.asarray(sp),
             method, (height, width), step, auto, colour_scale,
             win=win, win0=_dev_win0(win0)))
+
+    def _note_paged(self, engaged: bool) -> None:
+        with self._lock:
+            if engaged:
+                self.paged_engaged += 1
+            else:
+                self.paged_declined += 1
+
+    def _paged_from_group(self, group, n_pad: int):
+        """Page tables + 16-wide kernel params for one scene group
+        (`_scene_groups` tuple), or None when the paged path can't
+        serve it — page budget exceeded, pool full of pinned pages, or
+        the page block over VMEM — and the caller keeps the bucketed
+        dispatch.
+
+        Returns (pool, tables (T, S) int32, params16 (T, 16) f32,
+        real_pages).  Page coverage per granule comes from the SAME
+        `_granule_bounds` margins the bucketed window uses, so both
+        paths gather identical taps; table slots come back PINNED and
+        the caller must `pool.unpin(tables)` once its dispatch is
+        enqueued."""
+        from ..ops.paged import page_slots, paged_vmem_ok
+        from .pages import default_page_pool
+        (_, ctrl, _, _, _, _, _, _, _, gs, params64) = group
+        pool = default_page_pool()
+        pr, pc = pool.page_rows, pool.page_cols
+        cx = np.asarray(ctrl[0], np.float64)
+        cy = np.asarray(ctrl[1], np.float64)
+        T = int(params64.shape[0])
+        spans = []
+        maxnpg = 1
+        cap = page_slots()
+        for k in range(T):
+            p = params64[k]
+            if p[10] < 0 or k >= len(gs):
+                spans.append(None)      # batch-padding row
+                continue
+            made = _granule_bounds(p, cx, cy)
+            if made is None:
+                spans.append(None)      # nothing to gather
+                continue
+            r_lo, r_hi, c_lo, c_hi = made
+            dev = gs[k].dev
+            bh, bw = int(dev.shape[0]), int(dev.shape[1])
+            i0 = max(0, r_lo) // pr
+            i1 = min(-(-bh // pr) - 1, r_hi // pr)
+            j0 = max(0, c_lo) // pc
+            j1 = min(-(-bw // pc) - 1, c_hi // pc)
+            if i1 < i0 or j1 < j0:
+                spans.append(None)      # footprint entirely off-scene
+                continue
+            npg = (i1 - i0 + 1) * (j1 - j0 + 1)
+            if npg > cap:
+                return None
+            maxnpg = max(maxnpg, npg)
+            spans.append((i0, i1, j0, j1))
+        S = _bucket_pow2(maxnpg)
+        if not paged_vmem_ok(S, n_pad, pr, pc):
+            return None
+        tables = np.zeros((T, S), np.int32)
+        params16 = np.zeros((T, PAGED_PARAMS_W), np.float32)
+        params16[:, :11] = params64[:, :11].astype(np.float32)
+        pinned = []
+        real_pages = 0
+        for k, span in enumerate(spans):
+            if span is None:
+                # zero-extent row (slots 13/14 stay 0): every tap is
+                # out of window, exactly a bucketed all-masked granule
+                continue
+            i0, i1, j0, j1 = span
+            s = gs[k]
+            slots = pool.table_for(s.dev, s.serial, i0, i1, j0, j1)
+            if slots is None:
+                for t in pinned:
+                    pool.unpin(t)
+                return None
+            pinned.append(slots)
+            tables[k, :slots.size] = slots
+            real_pages += int(slots.size)
+            params16[k, 11] = i0 * pr
+            params16[k, 12] = j0 * pc
+            params16[k, 13] = (i1 - i0 + 1) * pr
+            params16[k, 14] = (j1 - j0 + 1) * pc
+            params16[k, 15] = j1 - j0 + 1
+        return pool, tables, params16, real_pages
 
     def _scene_inputs(self, granules, ns_ids, prios, dst_gt, dst_crs,
                       height, width, cache=None):
@@ -861,8 +1035,12 @@ class WarpExecutor:
                     int(stack.shape[1]), int(stack.shape[2]))
                 if made_w is not None:
                     win, win0, win_raw = made_w
+            # trailing members (scenes + f64 params) feed the paged
+            # dispatch (`_paged_from_group`); consumers of the bucketed
+            # 9-prefix unpack with `*_`
             groups.append((stack, ctrl, params.astype(np.float32), step,
-                           skey, ctrl_dev, win, win0, win_raw))
+                           skey, ctrl_dev, win, win0, win_raw, gs,
+                           params))
         return groups
 
 

@@ -1,0 +1,243 @@
+"""Paged gather-window pool: the HBM residency layer behind ragged
+paged rendering (`ops.paged`, docs/KERNELS.md).
+
+Scenes are cut into a fixed grid of (page_rows, page_cols) f32 pages
+(page (pi, pj) covers scene rows [pi*PR, (pi+1)*PR), cols [pj*PC,
+(pj+1)*PC); validity stays NaN-encoded, exactly the scene-cache
+convention).  Pages live in ONE preallocated device pool array of
+shape (capacity, PR, PC) and are content-keyed on (scene serial, pi,
+pj): a window is staged into pages at most once per residency, and
+overlapping tiles — adjacent GetMap tiles over the same granule, the
+common WMS pattern — share the staged pages instead of re-pulling
+overlapping gather windows, which is where the bucketed path paid its
+padded-pull byte cost.
+
+Slot 0 is a reserved all-NaN null page used to pad page tables (and
+backs the zero-extent padding granules of a ragged batch): a kernel
+tap through slot 0 is always invalid, never garbage.
+
+Staging runs under `jax.jit` with the pool buffer DONATED, so each
+stage is an in-place page write, not a pool-sized copy.  Donation
+invalidates the previous Python reference, so the coherence rule is
+strict: every pool-array access — staging in `table_for` AND the
+dispatch enqueue that consumes a snapshot — happens under `self.lock`
+(use `locked_pool()` around the kernel call).  Once a dispatch is
+enqueued the device stream owns the value (jax arrays are immutable
+values; later donation copies if the buffer is still held), so the
+lock only needs to cover the enqueue, not the execution.
+
+Eviction is LRU over page keys with one hard rule: slots PINNED by a
+built-but-not-yet-dispatched table are never evicted (`table_for`
+returns None instead — the caller falls back to the bucketed path).
+Pins are taken by `table_for` and must be released with `unpin` after
+the dispatch is enqueued; without the rule a concurrent request could
+recycle a queued batch item's pages between enqueue-to-batcher and
+flush.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import threading
+import warnings
+from collections import OrderedDict
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.paged import page_shape
+
+
+def _pool_capacity(pr: int, pc: int) -> int:
+    """Pool page count from GSKY_PAGE_POOL_MB (default 64 MiB): at the
+    default 128x512 f32 page (256 KiB) that is 256 pages — dozens of
+    concurrent 1-4 page windows plus sharing headroom."""
+    try:
+        mb = int(os.environ.get("GSKY_PAGE_POOL_MB", "64"))
+    except ValueError:
+        mb = 64
+    page_bytes = pr * pc * 4
+    return max(2, (max(1, mb) << 20) // page_bytes)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _stage(pool, scene, ij, slot):
+    """Write scene page (ij[0], ij[1]) into pool[slot] in place.  The
+    scene is NaN-padded up to page multiples BEFORE the dynamic_slice
+    (slice sizes larger than a dim are an error, and the pad is the
+    validity encoding for the off-scene region anyway)."""
+    pr, pc = pool.shape[1], pool.shape[2]
+    sh, sw = scene.shape
+    ph = -(-sh // pr) * pr
+    pw = -(-sw // pc) * pc
+    sp = jnp.pad(scene.astype(jnp.float32),
+                 ((0, ph - sh), (0, pw - sw)),
+                 constant_values=jnp.nan)
+    page = jax.lax.dynamic_slice(sp, (ij[0] * pr, ij[1] * pc), (pr, pc))
+    zero = jnp.zeros((), slot.dtype)    # match index dtypes under x64
+    return jax.lax.dynamic_update_slice(pool, page[None],
+                                        (slot, zero, zero))
+
+
+class PagePool:
+    """Device-resident page pool + LRU page table.  Thread-safe; see
+    the module docstring for the lock/pin coherence rules."""
+
+    def __init__(self, capacity: int | None = None,
+                 page_rows: int | None = None,
+                 page_cols: int | None = None):
+        pr, pc = page_shape()
+        self.page_rows = int(page_rows or pr)
+        self.page_cols = int(page_cols or pc)
+        if capacity is None:
+            capacity = _pool_capacity(self.page_rows, self.page_cols)
+        self.capacity = max(2, int(capacity))
+        self.lock = threading.RLock()
+        self._pool = None            # lazy: first use allocates
+        self._slots = OrderedDict()  # (serial, pi, pj) -> slot, LRU
+        self._free = list(range(self.capacity - 1, 0, -1))
+        self._pins: Dict[int, int] = {}   # slot -> pin count
+        # stats (under lock)
+        self.staged = 0
+        self.hits = 0
+        self.evictions = 0
+        self.declined = 0
+
+    # -- internals (hold self.lock) -----------------------------------
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            # slot 0 (and every unstaged slot) is all-NaN: a tap into
+            # an unstaged page is invalid, never stale garbage
+            self._pool = jnp.full(
+                (self.capacity, self.page_rows, self.page_cols),
+                jnp.nan, jnp.float32)
+
+    def _take_slot(self):
+        if self._free:
+            return self._free.pop()
+        for key in self._slots:    # LRU order: oldest first
+            slot = self._slots[key]
+            if self._pins.get(slot):
+                continue
+            del self._slots[key]
+            self.evictions += 1
+            return slot
+        return None                 # everything pinned: caller declines
+
+    def _stage_locked(self, dev, serial: int, pi: int, pj: int):
+        key = (int(serial), int(pi), int(pj))
+        slot = self._slots.get(key)
+        if slot is not None:
+            self._slots.move_to_end(key)
+            self.hits += 1
+            return slot
+        slot = self._take_slot()
+        if slot is None:
+            return None
+        self._ensure_pool()
+        with warnings.catch_warnings():
+            # donating a CPU-backed buffer warns; the fallback copy is
+            # still correct, just not in-place
+            warnings.simplefilter("ignore")
+            self._pool = _stage(self._pool, dev,
+                                jnp.asarray((pi, pj), jnp.int32),
+                                jnp.int32(slot))
+        self._slots[key] = slot
+        self.staged += 1
+        return slot
+
+    # -- public --------------------------------------------------------
+
+    def table_for(self, dev, serial: int, i0: int, i1: int,
+                  j0: int, j1: int):
+        """Stage pages (i0..i1) x (j0..j1) of scene `dev` and return
+        their slots row-major as (npages,) int32, PINNED — or None when
+        the pool can't hold the request's working set (caller falls
+        back to the bucketed path; partial pins are rolled back).  The
+        caller owns the pins and must `unpin` the returned slots once
+        its dispatch is enqueued (or abandoned)."""
+        slots = []
+        with self.lock:
+            for pi in range(int(i0), int(i1) + 1):
+                for pj in range(int(j0), int(j1) + 1):
+                    s = self._stage_locked(dev, serial, pi, pj)
+                    if s is None:
+                        self.declined += 1
+                        for t in slots:   # roll back partial pins
+                            self._pins[t] -= 1
+                            if not self._pins[t]:
+                                del self._pins[t]
+                        return None
+                    self._pins[s] = self._pins.get(s, 0) + 1
+                    slots.append(s)
+        return np.asarray(slots, np.int32)
+
+    def unpin(self, slots) -> None:
+        """Release pins taken by `table_for` (idempotence is the
+        caller's job: once per returned table)."""
+        with self.lock:
+            for s in np.asarray(slots).reshape(-1).tolist():
+                n = self._pins.get(int(s), 0) - 1
+                if n > 0:
+                    self._pins[int(s)] = n
+                else:
+                    self._pins.pop(int(s), None)
+
+    @contextlib.contextmanager
+    def locked_pool(self):
+        """The pool array to dispatch against, with staging locked out
+        for the duration — enqueue the kernel call INSIDE the block so
+        no concurrent stage donates the buffer between read and use."""
+        with self.lock:
+            self._ensure_pool()
+            yield self._pool
+
+    def drop_scene(self, serial: int):
+        """Free every unpinned page of a scene (cache eviction hook);
+        pinned pages stay resident until their dispatch retires them
+        through normal LRU."""
+        with self.lock:
+            dead = [k for k, s in self._slots.items()
+                    if k[0] == int(serial) and not self._pins.get(s)]
+            for k in dead:
+                self._free.append(self._slots.pop(k))
+
+    def stats(self):
+        with self.lock:
+            return {
+                "capacity": self.capacity,
+                "page_shape": [self.page_rows, self.page_cols],
+                "resident": len(self._slots),
+                "pinned": len(self._pins),
+                "staged": self.staged,
+                "hits": self.hits,
+                "evictions": self.evictions,
+                "declined": self.declined,
+                "pool_bytes": (self.capacity * self.page_rows
+                               * self.page_cols * 4),
+            }
+
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def default_page_pool() -> PagePool:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = PagePool()
+        return _default
+
+
+def reset_default_pool():
+    """Test hook: drop the singleton so the next caller re-reads the
+    GSKY_PAGE_* knobs."""
+    global _default
+    with _default_lock:
+        _default = None

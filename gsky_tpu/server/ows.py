@@ -317,8 +317,22 @@ class OWSServer:
                     "batches_windowed": ex._batcher.win_batches,
                     "batches_full": ex._batcher.full_batches,
                     # adaptive coalesce cap + the per-padded-size
-                    # per-tile latency EMAs that set it
-                    **ex._batcher.stats()}}
+                    # per-tile latency EMAs that set it, plus the
+                    # win/full/paged flush counters and padding bill
+                    **ex._batcher.stats()},
+                # ragged paged rendering (GSKY_PAGED, docs/KERNELS.md):
+                # dispatches served from the page pool vs declined back
+                # to buckets, and the pool's residency stats
+                "paged": {
+                    "engaged": ex.paged_engaged,
+                    "declined": ex.paged_declined}}
+            try:
+                from ..pipeline import pages
+                if pages._default is not None:
+                    doc["executor"]["paged"]["pool"] = \
+                        pages._default.stats()
+            except Exception:
+                pass
             doc["scene_cache_bytes"] = sc._bytes
             doc["drill_cache_bytes"] = dc._bytes
         except Exception:

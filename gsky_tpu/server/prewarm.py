@@ -25,6 +25,14 @@ via `jax.monitoring` — `compile_count()` deltas back the
 zero-recompile assertions in tests/test_tile_pipeline.py and
 `tools/soak.py --scenario burst`.
 
+Under paged serving (GSKY_PAGED on a pallas-capable backend,
+ops/paged.py) the single-band sweep collapses: instead of one program
+per (batch-pow2 x window-bucket) point, prewarm compiles the handful
+of ragged paged variants — (method, granule-pow2, page-slot-pow2) —
+and those programs serve EVERY tile/window shape, which is what lets
+`tools/soak.py --scenario burst` hold fresh compiles to a small
+constant under a heterogeneous-shape storm (docs/PERF.md).
+
 Knobs: GSKY_PREWARM=0 disables; GSKY_PREWARM_SIZES (tile edges,
 default "256"), GSKY_PREWARM_BUCKET (scene bucket edge, default 512),
 GSKY_PREWARM_MAX_SCENES (largest batched scene count, pow2, default 2).
@@ -188,8 +196,12 @@ def prewarm(configs: Dict,
     program).  Returns {"specs", "programs", "failures", "compiles",
     "seconds"}."""
     import jax.numpy as jnp
+    from ..ops.paged import (page_slots, paged_enabled, paged_vmem_ok,
+                             render_byte_paged_raced,
+                             warp_scored_paged_raced)
     from ..ops.pallas_tpu import render_byte_raced, warp_scored_raced
-    from ..ops.warp import render_rgba_ctrl, render_scenes_bands_ctrl
+    from ..ops.warp import (render_rgba_ctrl, render_scenes_bands_ctrl,
+                            render_scenes_ctrl, warp_scenes_ctrl_scored)
     from ..pipeline.executor import _bucket_pow2
 
     install_compile_probe()
@@ -222,7 +234,55 @@ def prewarm(configs: Dict,
             sp = jnp.asarray(np.zeros(3, np.float32))
             batches = sorted({_bucket_pow2(b)
                               for b in range(1, max_scenes + 1)})
-            if n_exprs == 1:
+            if n_exprs == 1 and paged_enabled():
+                # paged serving collapses the shape sweep: one program
+                # per (statics, granule-pow2 T, page-slot-pow2 S) point
+                # serves EVERY tile/window shape (ops/paged.py), so the
+                # sweep is a handful of ragged-pad lattice points
+                # instead of a bucket zoo.  Tables stay all-null (slot
+                # 0): the gather walks real NaN pages, so both race
+                # legs do representative work.  The pool must be the
+                # RUNTIME singleton — its (capacity, PR, PC) shape is
+                # part of the compiled program.
+                from ..pipeline.pages import default_page_pool
+                n_pad = _bucket_pow2(1)
+                pool = default_page_pool()
+                pr, pc = pool.page_rows, pool.page_cols
+                scap = _bucket_pow2(page_slots())
+                slot_sweep = [s for s in (1, 2, 4, 8)
+                              if s <= scap and paged_vmem_ok(s, n_pad,
+                                                             pr, pc)]
+                for B in batches:
+                    stack = jnp.full((B, bh, bw), jnp.nan, jnp.float32)
+                    params = jnp.asarray(_params(B, bh, bw))
+
+                    def _xla_byte(stack=stack, params=params):
+                        return render_scenes_ctrl(
+                            stack, ctrl, params, sp, method, n_pad,
+                            (hw, hw), step, auto, colour_scale)[None]
+
+                    def _xla_scored(stack=stack, params=params):
+                        c, b = warp_scenes_ctrl_scored(
+                            stack, ctrl, params, method, n_pad,
+                            (hw, hw), step)
+                        return c[None], b[None]
+
+                    for S in slot_sweep:
+                        tables = jnp.zeros((1, B, S), jnp.int32)
+                        p16 = np.zeros((B, 16), np.float32)
+                        p16[:, :11] = np.asarray(_params(B, bh, bw))
+                        p16[:, 13] = pr     # 1-page window extents:
+                        p16[:, 14] = pc     # real gather work over the
+                        p16[:, 15] = 1.0    # null page
+                        with pool.locked_pool() as parr:
+                            run(render_byte_paged_raced, parr, tables,
+                                jnp.asarray(p16), ctrl[None], sp[None],
+                                method, n_pad, (hw, hw), step, auto,
+                                colour_scale, _xla_byte)
+                            run(warp_scored_paged_raced, parr, tables,
+                                jnp.asarray(p16), ctrl[None], method,
+                                n_pad, (hw, hw), step, _xla_scored)
+            elif n_exprs == 1:
                 n_pad = _bucket_pow2(1)
                 for B in batches:
                     stack = jnp.full((B, bh, bw), jnp.nan, jnp.float32)

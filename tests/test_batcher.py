@@ -215,8 +215,14 @@ class TestThroughputKnee:
     def test_stats_payload_shape(self):
         b = RenderBatcher()
         st = b.stats()
-        assert set(st) == {"batch_knee", "tile_ms"}
+        assert set(st) == {"batch_knee", "tile_ms", "win_batches",
+                           "full_batches", "paged_batches",
+                           "pad_waste_bytes"}
         assert st["batch_knee"] == b.knee
+        assert st["win_batches"] == 0
+        assert st["full_batches"] == 0
+        assert st["paged_batches"] == 0
+        assert st["pad_waste_bytes"] == 0
 
 
 class TestSplitBBoxRaggedEdges:
