@@ -102,10 +102,18 @@ async def encode_async(fn, *args, spans: Optional[Dict] = None, **kw):
     ctx = contextvars.copy_context()
     cpu = [0.0]
 
+    def _job():
+        # inside the copied context so current_token() resolves: a
+        # request cancelled while its encode queued gives its pool
+        # slot back without burning CPU on bytes nobody will read
+        from ..resilience import check_cancel
+        check_cancel("encode")
+        return fn(*args, **kw)
+
     def run():
         t1 = time.perf_counter()
         try:
-            return ctx.run(fn, *args, **kw)
+            return ctx.run(_job)
         finally:
             cpu[0] = time.perf_counter() - t1
             with _pool_lock:

@@ -273,8 +273,57 @@ def _collect_batcher():
     return out
 
 
+def _collect_overload():
+    """Overload-survival surfaces (docs/RESILIENCE.md "Overload &
+    brownout"): the adaptive admission limits the AIMD controller is
+    running at, per-tenant queue depths behind them, cancellation
+    counts by pipeline stage, and the memory-pressure state driving
+    brownout."""
+    out: List = []
+    try:
+        from ..serving import default_gateway
+        st = default_gateway.admission.stats()
+        classes = st.get("classes") or {}
+        if classes:
+            out.append(_g("gsky_admit_limit",
+                          "Current adaptive admission limit per "
+                          "service class.",
+                          [({"class": s}, float(c.get("limit", 0)))
+                           for s, c in classes.items()]))
+        tenants = st.get("tenants") or {}
+        if tenants:
+            out.append(_g("gsky_admit_queue_depth",
+                          "Requests queued at admission per "
+                          "tenant/service-class pair.",
+                          [({"tenant_class": k}, float(v))
+                           for k, v in tenants.items()]))
+    except Exception:
+        pass
+    try:
+        from ..resilience import cancel_stats
+        stages = (cancel_stats() or {}).get("stages") or {}
+        if stages:
+            out.append(_c("gsky_cancelled_total",
+                          "Request cancellations observed per "
+                          "pipeline stage.",
+                          [({"stage": s}, float(v))
+                           for s, v in stages.items()]))
+    except Exception:
+        pass
+    try:
+        from ..resilience.pressure import default_monitor
+        out.append(_g("gsky_pressure_state",
+                      "Memory-pressure state (0 nominal, 1 brownout, "
+                      "2 critical).",
+                      [({}, float(default_monitor().stats()
+                                  .get("state", 0)))]))
+    except Exception:
+        pass
+    return out
+
+
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
-            _collect_runtime, _collect_batcher):
+            _collect_runtime, _collect_batcher, _collect_overload):
     _REG.register_collector(_fn)
 
 

@@ -29,6 +29,7 @@ import os
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Dict, List, Tuple
 
 import jax.numpy as jnp
@@ -129,6 +130,23 @@ class RenderBatcher:
                     "paged_batches": self.paged_batches,
                     "pad_waste_bytes": self.pad_waste_bytes}
 
+    @staticmethod
+    def _wait(fut: Future):
+        """Block on a batch future, cancellation-aware: a request whose
+        client disconnected stops waiting within one poll tick and
+        unwinds (releasing its admission permit / stage slot) while the
+        batch itself still executes for its surviving companions —
+        cancelling one tile must never fail a shared flush."""
+        from ..resilience import current_token
+        tok = current_token()
+        if tok is None:
+            return fut.result()
+        while True:
+            try:
+                return fut.result(timeout=0.05)
+            except _FutTimeout:
+                tok.check("batch")
+
     def render(self, key: tuple, stack, ctrl, params, sp,
                statics: tuple, win_raw=None) -> np.ndarray:
         """Submit one tile; blocks until its batch executes.  ``key``
@@ -159,7 +177,7 @@ class RenderBatcher:
             # pop nothing — cancel it with the batch already claimed
             flush_now[2].cancel()
             self._execute(flush_now, statics, trigger="size")
-        return fut.result()
+        return self._wait(fut)
 
     def _union_window(self, items, stack):
         """One (win, win0) covering every tile's RAW footprint bounds,
@@ -284,7 +302,7 @@ class RenderBatcher:
         if flush_now is not None:
             flush_now[2].cancel()
             self._execute_paged(flush_now[1], statics, trigger="size")
-        return fut.result()
+        return self._wait(fut)
 
     def _flush_key_paged(self, key: tuple, statics: tuple):
         with self._lock:

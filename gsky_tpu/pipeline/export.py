@@ -422,6 +422,14 @@ class ExportPipeline:
                       "decode_workers": self.decode_workers,
                       "encode_workers": self.encode_workers,
                       "queue_depth": self.queue_depth}
+        # request-scoped cancellation: a client disconnect (or deadline
+        # expiry) fires the token, which trips the engine's existing
+        # stop flag — every stage loop already checks it, so decode /
+        # warp / encode threads drain within one queue hop instead of
+        # finishing an export nobody will download
+        from ..resilience import current_token
+        tok = current_token()
+        unhook = tok.on_cancel(self.cancel) if tok else None
         with obs_span("export.plan") as psp:
             plan = self._plan()
             psp.set(tiles=len(self.tiles),
@@ -467,9 +475,13 @@ class ExportPipeline:
             decode_t.join()
             for t in encoders:
                 t.join()
+            if unhook is not None:
+                unhook()
         with self._err_lock:
             if self._errors:
                 raise self._errors[0]
+        if tok is not None:
+            tok.check("export")     # raises RequestCancelled when fired
         if self._stop.is_set():
             raise RuntimeError("export cancelled")
         self.stats["encode_s"] = round(sum(b[0] for b in enc_busy), 6)

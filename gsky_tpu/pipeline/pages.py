@@ -161,6 +161,14 @@ class PagePool:
         back to the bucketed path; partial pins are rolled back).  The
         caller owns the pins and must `unpin` the returned slots once
         its dispatch is enqueued (or abandoned)."""
+        from ..resilience.pressure import staging_allowed
+        if not staging_allowed():
+            # critical memory pressure: growing HBM residency now risks
+            # the whole process — decline and let the caller fall back
+            # to the bucketed dispatch path
+            with self.lock:
+                self.declined += 1
+            return None
         slots = []
         with self.lock:
             for pi in range(int(i0), int(i1) + 1):

@@ -41,6 +41,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..obs import record_span, span as obs_span
+from ..resilience import check_cancel
 
 
 def tile_pipeline_enabled() -> bool:
@@ -150,11 +151,15 @@ def _decode_stage(pipe, req, granules, spans: Dict) -> None:
     identical outcomes, just earlier, bounded, and overlapped."""
     from .export import _scene_key
     gate = _gate("decode")
+    check_cancel("decode")
     t0 = time.perf_counter()
     with gate.enter(spans, "decode_queue_max"):
         seen = set()
         dst_gt = req.dst_gt()
         for g in granules:
+            # per-granule: an abandoned request stops warming scenes
+            # and releases the decode slot within one granule
+            check_cancel("decode")
             k = _scene_key(g)
             if k in seen:
                 continue
@@ -175,6 +180,7 @@ def _dispatch_stage(dispatch, spans: Dict):
     device->host transfer is already in flight — the next request's
     dispatch overlaps this one's readback."""
     from .batcher import batching_enabled
+    check_cancel("dispatch")
     t0 = time.perf_counter()
     try:
         with obs_span("tile.dispatch") as sp:
@@ -192,6 +198,9 @@ def _dispatch_stage(dispatch, spans: Dict):
                     sp.set(batched=True)
                     return dispatch()
                 with _gate("dispatch").enter(spans, "dispatch_queue_max"):
+                    # re-check AFTER the gate wait: the client may have
+                    # gone away while this request queued for the slot
+                    check_cancel("dispatch")
                     return dispatch()
             finally:
                 if compile_count is not None:
@@ -206,6 +215,7 @@ def _readback(dev, spans: Dict) -> np.ndarray:
     """Complete the in-flight device->host copy.  No gate: the transfer
     was started under the dispatch gate; this just blocks until the
     bytes land, which is exactly the overlap window other requests use."""
+    check_cancel("readback")
     t0 = time.perf_counter()
     with obs_span("tile.readback") as sp:
         arr = np.asarray(dev)

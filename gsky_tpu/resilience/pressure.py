@@ -1,0 +1,241 @@
+"""Host memory-pressure watchdog feeding admission, caches and brownout.
+
+The worker's OOM monitor (`worker/oom.py`) defends the *decode
+subprocesses*; nothing above it reacted to memory pressure until the
+kernel's OOM killer did.  This module generalises the same signals
+upward into a process-wide **pressure state**:
+
+    0  nominal
+    1  elevated  — brownout: degrade quality before availability
+                   (overview substitution, cheapest PNG effort,
+                   ``X-GSKY-Degraded: brownout``), admission ceilings
+                   tighten
+    2  critical  — additionally trim the scene/response caches and
+                   decline new page-pool staging before a MemoryError
+                   or HBM OOM can kill the process
+
+Two inputs, both cheap to read: host ``MemAvailable`` (the same
+``/proc/meminfo`` parse the OOM monitor uses) and page-pool occupancy
+(pinned+resident over capacity).  The monitor is *pull-based*: there is
+no polling thread — ``state()`` recomputes at most once per
+``GSKY_PRESSURE_POLL_S`` when someone (admission, the render path, a
+metrics scrape) asks, so idle processes pay nothing and tests stay
+deterministic.  Rising pressure applies immediately; recovery is
+hysteretic (the raw signal must stay clear for
+``GSKY_PRESSURE_CLEAR_S``) so brownout does not flap at the threshold.
+
+Knobs::
+
+    GSKY_PRESSURE=0              disable entirely (state is always 0)
+    GSKY_PRESSURE_AVAIL_MB=256   elevated below this MemAvailable
+    GSKY_PRESSURE_CRIT_MB=128    critical below this MemAvailable
+    GSKY_PRESSURE_POOL=0.90      elevated at this page-pool occupancy
+    GSKY_PRESSURE_POOL_CRIT=0.97 critical at this page-pool occupancy
+    GSKY_PRESSURE_POLL_S=0.5     recompute interval
+    GSKY_PRESSURE_CLEAR_S=3.0    sustained-clear window for recovery
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _pool_occupancy() -> Optional[float]:
+    """Pinned+resident fraction of the page pool, or None when no pool
+    has been allocated (never allocate one just to measure it)."""
+    try:
+        from ..pipeline import pages
+        if pages._default is None:
+            return None
+        st = pages._default.stats()
+        cap = st.get("capacity") or 0
+        if cap <= 0:
+            return None
+        return min(1.0, (st.get("resident", 0)) / cap)
+    except Exception:
+        return None
+
+
+def _mem_available() -> Optional[int]:
+    try:
+        from ..worker.oom import mem_available_bytes
+        return mem_available_bytes()
+    except Exception:
+        return None
+
+
+class PressureMonitor:
+    """Lazy-recomputing pressure state with hysteretic recovery and
+    critical-transition cache relief.  Readers are injectable so tests
+    drive the exact threshold-crossing sequences."""
+
+    def __init__(self,
+                 avail_reader: Callable[[], Optional[int]] = _mem_available,
+                 pool_reader: Callable[[], Optional[float]] = _pool_occupancy,
+                 clock: Callable[[], float] = time.monotonic):
+        self.avail_reader = avail_reader
+        self.pool_reader = pool_reader
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = 0
+        self._forced: Optional[int] = None
+        self._last_check = -1e9
+        self._clear_since: Optional[float] = None
+        self.transitions = 0
+        self.trims = 0
+        self._last_avail: Optional[int] = None
+        self._last_pool: Optional[float] = None
+
+    # -- config (re-read per recompute: live-tunable via environment) --
+
+    @staticmethod
+    def _enabled() -> bool:
+        return os.environ.get("GSKY_PRESSURE", "1") != "0"
+
+    def _raw_state(self) -> int:
+        avail = self.avail_reader()
+        pool = self.pool_reader()
+        self._last_avail = avail
+        self._last_pool = pool
+        crit_b = _env_float("GSKY_PRESSURE_CRIT_MB", 128.0) * (1 << 20)
+        elev_b = _env_float("GSKY_PRESSURE_AVAIL_MB", 256.0) * (1 << 20)
+        pool_e = _env_float("GSKY_PRESSURE_POOL", 0.90)
+        pool_c = _env_float("GSKY_PRESSURE_POOL_CRIT", 0.97)
+        if (avail is not None and avail < crit_b) or \
+                (pool is not None and pool >= pool_c):
+            return 2
+        if (avail is not None and avail < elev_b) or \
+                (pool is not None and pool >= pool_e):
+            return 1
+        return 0
+
+    # -- relief actions -------------------------------------------------
+
+    def _relieve(self) -> None:
+        """Critical transition: drop rebuildable device/host caches NOW
+        — a cold cache beats a dead process.  Each sink is best-effort
+        and lazily imported (pressure must never fail a request)."""
+        self.trims += 1
+        try:
+            from ..pipeline.scene_cache import default_scene_cache
+            default_scene_cache.clear()
+        except Exception:
+            pass
+        try:
+            from ..pipeline.drill_cache import default_drill_cache
+            default_drill_cache.clear()
+        except Exception:
+            pass
+        try:
+            from ..serving import default_gateway
+            default_gateway.cache.clear()
+        except Exception:
+            pass
+
+    # -- state ----------------------------------------------------------
+
+    def force(self, state: Optional[int]) -> None:
+        """Pin the state (tests, the overload soak, operator drills);
+        ``force(None)`` resumes measurement."""
+        relieve = False
+        with self._lock:
+            self._forced = state
+            if state is not None and state != self._state:
+                self.transitions += 1
+                relieve = state >= 2 > self._state
+                self._state = state
+            self._clear_since = None
+        if relieve:
+            self._relieve()
+
+    def state(self) -> int:
+        if not self._enabled():
+            return 0
+        with self._lock:
+            if self._forced is not None:
+                return self._forced
+            now = self.clock()
+            if now - self._last_check < _env_float(
+                    "GSKY_PRESSURE_POLL_S", 0.5):
+                return self._state
+            self._last_check = now
+            raw = self._raw_state()
+            prev = self._state
+            if raw >= prev:
+                # rising (or holding): apply immediately
+                if raw > prev:
+                    self._state = raw
+                    self.transitions += 1
+                self._clear_since = None
+                step_to_crit = raw >= 2 > prev
+            else:
+                # falling: require a sustained clear window
+                step_to_crit = False
+                if self._clear_since is None:
+                    self._clear_since = now
+                elif now - self._clear_since >= _env_float(
+                        "GSKY_PRESSURE_CLEAR_S", 3.0):
+                    self._state = raw
+                    self.transitions += 1
+                    self._clear_since = None
+        if step_to_crit:
+            self._relieve()
+        return self._state
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "state": self._state if self._enabled() else 0,
+                "forced": self._forced,
+                "mem_available_mb": None if self._last_avail is None
+                else round(self._last_avail / (1 << 20), 1),
+                "pool_occupancy": None if self._last_pool is None
+                else round(self._last_pool, 3),
+                "transitions": self.transitions,
+                "trims": self.trims,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state = 0
+            self._forced = None
+            self._last_check = -1e9
+            self._clear_since = None
+            self.transitions = 0
+            self.trims = 0
+            self._last_avail = None
+            self._last_pool = None
+
+
+_default = PressureMonitor()
+
+
+def default_monitor() -> PressureMonitor:
+    return _default
+
+
+def pressure_state() -> int:
+    """The process pressure state right now (0 / 1 / 2)."""
+    return _default.state()
+
+
+def brownout_level() -> int:
+    """0 when nominal; the pressure state (1 or 2) when the server
+    should degrade quality before availability."""
+    return _default.state()
+
+
+def staging_allowed() -> bool:
+    """Whether the page pool may stage NEW pages — critical pressure
+    declines staging so paged renders fall back to bucketed dispatch
+    instead of growing HBM residency into an OOM."""
+    return _default.state() < 2

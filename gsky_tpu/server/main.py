@@ -109,7 +109,12 @@ def main(argv=None):
                   file=sys.stderr)
 
     app.on_shutdown.append(_drain)
+    # handler_cancellation: aiohttp >= 3.9 no longer cancels handlers
+    # when the client drops the connection; the end-to-end cancellation
+    # path (resilience/cancel.py) depends on that CancelledError to
+    # fire the request's token and reclaim permits/pins/threads
     web.run_app(app, host=args.host, port=args.port,
+                handler_cancellation=True,
                 print=lambda *a: print(
                     f"gsky-ows listening on {args.host}:{args.port}"))
     return 0

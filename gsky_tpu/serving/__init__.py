@@ -48,7 +48,13 @@ class ServingGateway:
         """ConfigWatcher reload hook: eagerly drop cached responses
         whose layer config changed or vanished (the fingerprint folded
         into every cache key already orphans them; this returns the
-        bytes now)."""
+        bytes now).  The admission controller re-resolves its
+        ``GSKY_ADMIT_*`` knobs on the same reload — they must never be
+        latched at import time."""
+        try:
+            self.admission.reconfigure()
+        except Exception:
+            pass
         fps = {ns: {layer_fingerprint(l) for l in cfg.layers}
                for ns, cfg in configs.items()}
         return self.cache.invalidate(fps)

@@ -10,10 +10,33 @@ and shifts from queueing to *shedding* once a request has waited past
 its deadline: a fast OGC-exception 503 with ``Retry-After`` costs the
 client a retry, not a timeout, and costs the server nothing.
 
+Two operating modes:
+
+* **Fixed** (``GSKY_ADMIT_ADAPTIVE=0``): the original static permits —
+  one ``threading.Semaphore`` per class sized by ``GSKY_ADMIT_*``,
+  awaited via ``asyncio.to_thread``.  Byte-identical to the historical
+  behaviour.
+* **Adaptive** (default): an AIMD controller per class tracks the
+  latency of recently completed renders against a slow-moving baseline
+  and shrinks the in-flight limit multiplicatively when latency leaves
+  the knee (recent EWMA > ``GSKY_ADMIT_RATIO`` x baseline), growing it
+  back additively while latency is healthy.  The ``GSKY_ADMIT_*``
+  value is the *ceiling*; the floor is ceiling/8 (min 1).  Host
+  memory pressure (``resilience/pressure.py``) clamps the effective
+  limit further (x0.5 elevated, x0.25 critical).  Waiters queue in a
+  **weighted-fair per-tenant queue with priority aging**: each grant
+  goes to the waiter whose tenant has consumed the least
+  weight-normalised service, minus an aging credit
+  (``GSKY_ADMIT_AGING`` per waited second) so no tenant starves.
+  Tenant weights come from ``GSKY_TENANT_WEIGHTS``
+  (``"bulk:0.25,premium:4"``; default 1.0).
+
 Limits come from ``GSKY_ADMIT_{WMS,WCS,WPS,DAP4}``; the queue-wait
-deadline from ``GSKY_ADMIT_QUEUE_S``.  The primitives are
-``threading``-based (awaited via ``asyncio.to_thread``) so one
-process-wide controller serves any number of event loops.
+deadline from ``GSKY_ADMIT_QUEUE_S``.  Both are re-resolved every time
+a controller is built (or ``reconfigure()`` runs on a SIGHUP reload) —
+never latched at import time.  The primitives are ``threading``-based
+(awaited via ``asyncio.to_thread``) so one process-wide controller
+serves any number of event loops.
 """
 
 from __future__ import annotations
@@ -22,7 +45,11 @@ import asyncio
 import contextlib
 import os
 import threading
+import time
 from typing import Callable, Dict, Optional
+
+from ..resilience.cancel import current_token
+from ..resilience.pressure import pressure_state
 
 
 def _env_int(name: str, default: int) -> int:
@@ -39,13 +66,46 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
-DEFAULT_LIMITS = {
-    "WMS": _env_int("GSKY_ADMIT_WMS", 32),
-    "WCS": _env_int("GSKY_ADMIT_WCS", 8),
-    "WPS": _env_int("GSKY_ADMIT_WPS", 4),
-    "DAP4": _env_int("GSKY_ADMIT_DAP4", 8),
+# class -> (env knob, default ceiling).  Resolved at controller build,
+# NOT at import: a SIGHUP reload rebuilds the controller and must see
+# the environment as it is *now*.
+_LIMIT_KNOBS = {
+    "WMS": ("GSKY_ADMIT_WMS", 32),
+    "WCS": ("GSKY_ADMIT_WCS", 8),
+    "WPS": ("GSKY_ADMIT_WPS", 4),
+    "DAP4": ("GSKY_ADMIT_DAP4", 8),
 }
-DEFAULT_QUEUE_DEADLINE_S = _env_float("GSKY_ADMIT_QUEUE_S", 5.0)
+
+
+def default_limits() -> Dict[str, int]:
+    return {svc: _env_int(env, d) for svc, (env, d) in _LIMIT_KNOBS.items()}
+
+
+def default_queue_deadline_s() -> float:
+    return _env_float("GSKY_ADMIT_QUEUE_S", 5.0)
+
+
+def _tenant_weights() -> Dict[str, float]:
+    """GSKY_TENANT_WEIGHTS="bulk:0.25,premium:4" -> {..}; default 1.0."""
+    out: Dict[str, float] = {}
+    spec = os.environ.get("GSKY_TENANT_WEIGHTS", "")
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause or ":" not in clause:
+            continue
+        name, _, w = clause.rpartition(":")
+        try:
+            out[name.strip()] = max(0.01, float(w))
+        except ValueError:
+            continue
+    return out
+
+
+# Backwards-compatible module constants (import-time snapshot). Nothing
+# inside this module reads them any more — they remain only so existing
+# imports keep resolving.
+DEFAULT_LIMITS = default_limits()
+DEFAULT_QUEUE_DEADLINE_S = default_queue_deadline_s()
 
 
 class AdmissionShed(Exception):
@@ -78,15 +138,39 @@ def _fleet_advisor() -> Optional[str]:
 
 
 class _ClassState:
-    __slots__ = ("limit", "sem", "in_use", "queued", "shed", "admitted")
+    __slots__ = ("limit", "ceiling", "floor", "sem", "in_use", "queued",
+                 "shed", "admitted", "cancelled", "adjustments",
+                 "baseline_s", "recent_s", "last_adjust_t", "waiters",
+                 "tenant_served", "tenant_queued")
 
     def __init__(self, limit: int):
-        self.limit = limit
-        self.sem = threading.Semaphore(limit)
+        self.limit = limit               # current (adaptive) limit
+        self.ceiling = limit             # configured GSKY_ADMIT_* value
+        self.floor = max(1, limit // 8)
+        self.sem = threading.Semaphore(limit)   # fixed-mode primitive
         self.in_use = 0
         self.queued = 0
         self.shed = 0
         self.admitted = 0
+        self.cancelled = 0               # permits released by cancel
+        self.adjustments = 0             # AIMD limit changes
+        self.baseline_s = 0.0            # slow latency EWMA
+        self.recent_s = 0.0              # fast latency EWMA
+        self.last_adjust_t = 0.0
+        self.waiters: list = []          # adaptive-mode fair queue
+        self.tenant_served: Dict[str, float] = {}
+        self.tenant_queued: Dict[str, int] = {}
+
+
+class _Waiter:
+    __slots__ = ("tenant", "event", "state", "t_enq")
+    WAITING, GRANTED, ABANDONED = 0, 1, 2
+
+    def __init__(self, tenant: str, clock: float):
+        self.tenant = tenant
+        self.event = threading.Event()
+        self.state = _Waiter.WAITING
+        self.t_enq = clock
 
 
 def _release_orphaned_permit(st: _ClassState):
@@ -107,16 +191,44 @@ def _release_orphaned_permit(st: _ClassState):
 
 class AdmissionController:
     def __init__(self, limits: Optional[Dict[str, int]] = None,
-                 queue_deadline_s: float = DEFAULT_QUEUE_DEADLINE_S,
+                 queue_deadline_s: Optional[float] = None,
                  shed_advisor: Optional[Callable[[], Optional[str]]]
-                 = _fleet_advisor):
-        merged = dict(DEFAULT_LIMITS)
-        if limits:
-            merged.update(limits)
+                 = _fleet_advisor,
+                 adaptive: Optional[bool] = None):
         self._lock = threading.Lock()
-        self._classes = {svc: _ClassState(n) for svc, n in merged.items()}
-        self.queue_deadline_s = queue_deadline_s
         self.shed_advisor = shed_advisor
+        self.adaptive = (os.environ.get("GSKY_ADMIT_ADAPTIVE", "1") != "0"
+                         if adaptive is None else adaptive)
+        self._explicit_limits = dict(limits) if limits else None
+        self._explicit_deadline = queue_deadline_s
+        self._classes: Dict[str, _ClassState] = {}
+        self.queue_deadline_s = 0.0
+        self.reconfigure()
+
+    def reconfigure(self) -> None:
+        """(Re)resolve limits and the queue deadline from the
+        environment — run at build time and again on SIGHUP reload so
+        ``GSKY_ADMIT_*`` changes land without a restart.  Live counters
+        carry over; ceilings, floors and fixed-mode semaphores are
+        rebuilt from the fresh values."""
+        merged = default_limits()
+        if self._explicit_limits:
+            merged.update(self._explicit_limits)
+        with self._lock:
+            self.queue_deadline_s = (
+                default_queue_deadline_s()
+                if self._explicit_deadline is None
+                else self._explicit_deadline)
+            for svc, n in merged.items():
+                st = self._classes.get(svc)
+                if st is None:
+                    self._classes[svc] = _ClassState(n)
+                elif st.ceiling != n:
+                    st.ceiling = n
+                    st.floor = max(1, n // 8)
+                    st.limit = min(max(st.limit, st.floor), n)
+                    st.sem = threading.Semaphore(n)
+                    st.adjustments += 1
 
     def _state(self, service_class: str) -> _ClassState:
         st = self._classes.get(service_class)
@@ -128,9 +240,196 @@ class AdmissionController:
                         service_class, _ClassState(32))
         return st
 
-    @contextlib.asynccontextmanager
-    async def admit(self, service_class: str):
+    # ---- adaptive machinery -------------------------------------------
+
+    def _effective_limit(self, st: _ClassState) -> int:
+        """The AIMD limit, clamped further under memory pressure."""
+        limit = st.limit
+        try:
+            p = pressure_state()
+        except Exception:
+            p = 0
+        if p >= 2:
+            limit = max(1, int(limit * 0.25))
+        elif p == 1:
+            limit = max(1, int(limit * 0.5))
+        return limit
+
+    def observe(self, service_class: str, latency_s: float) -> None:
+        """Fold one completed render's latency into the class's AIMD
+        controller.  Multiplicative decrease when the fast EWMA leaves
+        the knee (recent > ratio x baseline), additive recovery toward
+        the ceiling while latency tracks the baseline."""
+        if not self.adaptive:
+            return
         st = self._state(service_class)
+        ratio = _env_float("GSKY_ADMIT_RATIO", 1.5)
+        interval = _env_float("GSKY_ADMIT_INTERVAL_S", 1.0)
+        now = time.monotonic()
+        with self._lock:
+            if st.baseline_s <= 0.0:
+                st.baseline_s = st.recent_s = latency_s
+            else:
+                st.recent_s += 0.3 * (latency_s - st.recent_s)
+                st.baseline_s += 0.05 * (latency_s - st.baseline_s)
+            if now - st.last_adjust_t < interval:
+                return
+            threshold = max(st.baseline_s * ratio, st.baseline_s + 0.05)
+            if st.recent_s > threshold and st.limit > st.floor:
+                st.limit = max(st.floor, int(st.limit * 0.7))
+                st.adjustments += 1
+                st.last_adjust_t = now
+            elif st.recent_s <= st.baseline_s * 1.1 \
+                    and st.limit < st.ceiling:
+                st.limit += 1
+                st.adjustments += 1
+                st.last_adjust_t = now
+
+    def _grant_waiters(self, st: _ClassState) -> None:
+        """Weighted-fair scheduler: while capacity is free, grant the
+        waiter whose tenant has the least weight-normalised service,
+        minus an aging credit so long-queued tenants always drain.
+        Caller holds the lock."""
+        weights = _tenant_weights()
+        aging = _env_float("GSKY_ADMIT_AGING", 0.5)
+        now = time.monotonic()
+        while st.waiters and st.in_use < self._effective_limit(st):
+            best = None
+            best_score = None
+            for w in st.waiters:
+                if w.state != _Waiter.WAITING:
+                    continue
+                wt = weights.get(w.tenant, 1.0)
+                score = (st.tenant_served.get(w.tenant, 0.0) / wt
+                         - aging * (now - w.t_enq))
+                # FIFO within a tenant: earlier enqueue wins ties
+                if best_score is None or score < best_score or \
+                        (score == best_score and w.t_enq < best.t_enq):
+                    best, best_score = w, score
+            if best is None:
+                break
+            best.state = _Waiter.GRANTED
+            st.waiters.remove(best)
+            st.in_use += 1
+            st.admitted += 1
+            self._charge(st, best.tenant)
+            best.event.set()
+
+    def _charge(self, st: _ClassState, tenant: str) -> None:
+        """One unit of service against the tenant's ledger, decaying
+        the whole ledger so old consumption stops mattering (caller
+        holds the lock)."""
+        served = st.tenant_served
+        served[tenant] = served.get(tenant, 0.0) + 1.0
+        if served[tenant] > 1e6:            # keep the floats bounded
+            for t in list(served):
+                served[t] *= 0.5
+        # decay: every charge fades everyone slightly, so fairness is
+        # about the recent past, not the process lifetime
+        for t in list(served):
+            served[t] *= 0.995
+            if served[t] < 1e-3:
+                del served[t]
+
+    def _release_adaptive(self, st: _ClassState,
+                          cancelled: bool = False) -> None:
+        with self._lock:
+            st.in_use -= 1
+            if cancelled:
+                st.cancelled += 1
+            self._grant_waiters(st)
+
+    @contextlib.asynccontextmanager
+    async def _admit_adaptive(self, st: _ClassState, service_class: str,
+                              tenant: str):
+        granted = False
+        tok = None
+        with self._lock:
+            if not st.waiters and st.in_use < self._effective_limit(st):
+                st.in_use += 1
+                st.admitted += 1
+                self._charge(st, tenant)
+                granted = True
+            else:
+                w = _Waiter(tenant, time.monotonic())
+                st.waiters.append(w)
+                st.queued += 1
+                st.tenant_queued[tenant] = \
+                    st.tenant_queued.get(tenant, 0) + 1
+        if not granted:
+            tok = current_token()
+            try:
+                # block in a worker thread, not the event loop; shield
+                # so a cancelled request can still hand a won permit back
+                waiter_fut = asyncio.ensure_future(asyncio.to_thread(
+                    w.event.wait, self.queue_deadline_s))
+                try:
+                    await asyncio.shield(waiter_fut)
+                except asyncio.CancelledError:
+                    with self._lock:
+                        if w.state == _Waiter.WAITING:
+                            w.state = _Waiter.ABANDONED
+                            try:
+                                st.waiters.remove(w)
+                            except ValueError:
+                                pass
+                            st.cancelled += 1
+                        else:       # granted in the race: hand it back
+                            st.in_use -= 1
+                            st.cancelled += 1
+                            self._grant_waiters(st)
+                    w.event.set()   # release the worker thread now
+                    raise
+                with self._lock:
+                    if w.state == _Waiter.GRANTED:
+                        granted = True
+                    else:
+                        w.state = _Waiter.ABANDONED
+                        try:
+                            st.waiters.remove(w)
+                        except ValueError:
+                            pass
+            finally:
+                with self._lock:
+                    st.queued -= 1
+                    n = st.tenant_queued.get(tenant, 1) - 1
+                    if n <= 0:
+                        st.tenant_queued.pop(tenant, None)
+                    else:
+                        st.tenant_queued[tenant] = n
+        if not granted:
+            with self._lock:
+                st.shed += 1
+            alt = None
+            if self.shed_advisor is not None:
+                try:
+                    alt = self.shed_advisor()
+                except Exception:
+                    alt = None
+            raise AdmissionShed(
+                service_class,
+                retry_after=max(1, int(round(self.queue_deadline_s))),
+                alt_node=alt)
+        if tok is None:
+            tok = current_token()
+        t0 = time.monotonic()
+        try:
+            yield
+        except asyncio.CancelledError:
+            self._release_adaptive(st, cancelled=True)
+            raise
+        except BaseException:
+            self._release_adaptive(st)
+            raise
+        else:
+            self._release_adaptive(
+                st, cancelled=tok is not None and tok.cancelled())
+            self.observe(service_class, time.monotonic() - t0)
+
+    # ---- fixed (legacy) machinery -------------------------------------
+
+    @contextlib.asynccontextmanager
+    async def _admit_fixed(self, st: _ClassState, service_class: str):
         ok = st.sem.acquire(blocking=False)
         if not ok:
             with self._lock:
@@ -142,6 +441,8 @@ class AdmissionController:
                 ok = await asyncio.shield(waiter)
             except asyncio.CancelledError:
                 waiter.add_done_callback(_release_orphaned_permit(st))
+                with self._lock:
+                    st.cancelled += 1
                 raise
             finally:
                 with self._lock:
@@ -169,17 +470,50 @@ class AdmissionController:
                 st.in_use -= 1
             st.sem.release()
 
+    def admit(self, service_class: str, tenant: str = ""):
+        """Async context manager bounding one in-flight render.
+
+        ``tenant`` (API key / client IP / namespace) keys the adaptive
+        mode's weighted-fair queue; the fixed mode ignores it."""
+        st = self._state(service_class)
+        if self.adaptive:
+            return self._admit_adaptive(st, service_class,
+                                        tenant or "anon")
+        return self._admit_fixed(st, service_class)
+
     @property
     def total_shed(self) -> int:
         with self._lock:
             return sum(st.shed for st in self._classes.values())
 
+    @property
+    def total_adjustments(self) -> int:
+        with self._lock:
+            return sum(st.adjustments for st in self._classes.values())
+
+    @property
+    def total_cancelled(self) -> int:
+        with self._lock:
+            return sum(st.cancelled for st in self._classes.values())
+
     def stats(self) -> Dict:
         with self._lock:
+            tenants = {}
+            for svc, st in self._classes.items():
+                for t, n in st.tenant_queued.items():
+                    tenants[f"{t}/{svc}"] = n
             return {
                 "queue_deadline_s": self.queue_deadline_s,
+                "adaptive": self.adaptive,
                 "classes": {
-                    svc: {"limit": st.limit, "in_use": st.in_use,
+                    svc: {"limit": st.limit, "ceiling": st.ceiling,
+                          "effective_limit": self._effective_limit(st)
+                          if self.adaptive else st.limit,
+                          "in_use": st.in_use,
                           "queued": st.queued, "admitted": st.admitted,
-                          "shed": st.shed}
-                    for svc, st in self._classes.items()}}
+                          "shed": st.shed, "cancelled": st.cancelled,
+                          "adjustments": st.adjustments,
+                          "recent_ms": round(st.recent_s * 1e3, 2),
+                          "baseline_ms": round(st.baseline_s * 1e3, 2)}
+                    for svc, st in self._classes.items()},
+                "tenants": tenants}

@@ -268,7 +268,13 @@ class WorkerClient:
         busy = 0
         last: Optional[Exception] = None
         last_busy = ""
+        from ..resilience import current_token
+        tok = current_token()
         for pos, i in enumerate(order):
+            if tok is not None:
+                # a cancelled request must not start (or fail over to)
+                # another RPC attempt
+                tok.check("rpc")
             br = self._breakers[i]
             if not br.allow():
                 continue
@@ -291,8 +297,8 @@ class WorkerClient:
                             rsp.set(node=node, hedge_won=True)
                             _note("hedge_won", node=node)
                     else:
-                        res = self._stubs[i](task, timeout=timeout,
-                                             metadata=md)
+                        res = self._call_cancellable(i, task, timeout,
+                                                     md, tok)
                 dt = time.monotonic() - t0
             except Exception as e:
                 br.record_failure()
@@ -361,6 +367,27 @@ class WorkerClient:
         raise BackendUnavailable(
             f"all {n} worker node(s) failed (last: {last})",
             site="worker") from last
+
+    def _call_cancellable(self, i: int, task: pb.Task, timeout: float,
+                          md, tok) -> pb.Result:
+        """One RPC that honours the request's cancel token end-to-end:
+        the token fires ``fut.cancel()``, gRPC propagates the abort to
+        the server (whose handler polls ``ctx.is_active()`` and stops
+        decoding/warping for the dead client), and the caller unwinds
+        as :class:`RequestCancelled` — a BaseException, so the breaker
+        records neither success nor failure for work WE abandoned."""
+        if tok is None:
+            return self._stubs[i](task, timeout=timeout, metadata=md)
+        import grpc
+        fut = self._stubs[i].future(task, timeout=timeout, metadata=md)
+        unhook = tok.on_cancel(lambda: fut.cancel())
+        try:
+            return fut.result()
+        except grpc.FutureCancelledError:
+            tok.check("rpc")    # raises RequestCancelled when fired
+            raise               # cancelled by someone else: propagate
+        finally:
+            unhook()
 
     def _call_hedged(self, task: pb.Task, i: int, j: int,
                      timeout: float, md=None) -> Tuple[pb.Result, bool]:

@@ -101,7 +101,7 @@ class WorkerService:
             except Exception:
                 header = None
         with remote_trace(header, f"worker.{op}") as wtrace:
-            res = self._process(task, op)
+            res = self._process(task, op, ctx)
             if wtrace is not None and not res.info_json \
                     and op in ("warp", "drill", "extent"):
                 try:
@@ -111,7 +111,7 @@ class WorkerService:
                     pass
             return res
 
-    def _process(self, task: pb.Task, op: str) -> pb.Result:
+    def _process(self, task: pb.Task, op: str, ctx=None) -> pb.Result:
         try:
             # node-level chaos (GSKY_FAULTS="node:kill:..." etc.) hits
             # every RPC including health probes — a killed node just dies
@@ -122,7 +122,7 @@ class WorkerService:
                 return self._worker_info()
             with self.drain.track():
                 if op == "warp":
-                    return self._warp(task)
+                    return self._warp(task, ctx)
                 if op == "drill":
                     return self._drill(task)
                 if op in ("extent", "info", "decode"):
@@ -148,13 +148,25 @@ class WorkerService:
         r.info_json = json.dumps(self.drain.stats())
         return r
 
-    def _warp(self, task: pb.Task) -> pb.Result:
+    def _warp(self, task: pb.Task, ctx=None) -> pb.Result:
         from ..geo.crs import parse_crs
         from ..geo.transform import GeoTransform
         from ..pipeline.decode import DecodedWindow
 
+        # the gateway's cancel token propagates here as a gRPC
+        # cancellation; ctx.is_active() goes False the moment the
+        # client aborts, so poll it at the expensive boundaries and
+        # stop decoding/warping for a response nobody will receive
+        def _gone() -> bool:
+            try:
+                return ctx is not None and not ctx.is_active()
+            except Exception:
+                return False
+
         d = task.dst
         res = pb.Result()
+        if _gone():
+            return pb.Result(error="cancelled: client departed")
         g = granule_from_pb(task.granule)
         if g.geo_loc:
             # curvilinear granules have no affine window to decode; warp
@@ -200,6 +212,10 @@ class WorkerService:
             dsp.set(bytes_read=int(dres.metrics.bytes_read))
         if dres.error:
             return dres
+        if _gone():
+            # decoded bytes for a departed client: stop before the
+            # device dispatch, the costliest remaining step
+            return pb.Result(error="cancelled: client departed")
         win = unpack_raster(dres)
         if win is None:  # granule doesn't touch the tile -> empty result
             return res

@@ -645,3 +645,46 @@ def test_load_trace_file(tmp_path):
     p.write_text(json.dumps(_synthetic_trace()))
     doc = trace_view.load_trace(str(p))
     assert doc["trace_id"] == "abc123"
+
+
+def test_overload_series_roundtrip_strict_parser():
+    """The overload-survival collector families (adaptive limits,
+    per-tenant queue depth, cancellations by stage, pressure state)
+    must round-trip the strict parser with live data behind them."""
+    from gsky_tpu.obs.metrics import render_metrics
+    from gsky_tpu.resilience import reset_cancel_stats
+    from gsky_tpu.resilience.cancel import CancelToken, RequestCancelled
+    from gsky_tpu.resilience.pressure import default_monitor
+    from gsky_tpu.serving import default_gateway
+
+    reset_cancel_stats()
+    tok = CancelToken()
+    tok.cancel("test")
+    with pytest.raises(RequestCancelled):
+        tok.check("decode")
+    default_monitor().force(1)
+    adm = default_gateway.admission
+    st = adm._state("WMS")
+    try:
+        with adm._lock:
+            st.tenant_queued["10.0.0.9"] = 3
+        fams = parse_exposition(render_metrics())
+        assert fams["gsky_admit_limit"]["type"] == "gauge"
+        limits = fams["gsky_admit_limit"]["samples"]
+        assert limits[("gsky_admit_limit",
+                       (("class", "WMS"),))] == float(st.limit)
+        depth = fams["gsky_admit_queue_depth"]["samples"]
+        assert depth[("gsky_admit_queue_depth",
+                      (("tenant_class", "10.0.0.9/WMS"),))] == 3.0
+        cancelled = fams["gsky_cancelled_total"]
+        assert cancelled["type"] == "counter"
+        assert cancelled["samples"][
+            ("gsky_cancelled_total", (("stage", "decode"),))] == 1.0
+        assert fams["gsky_pressure_state"]["samples"][
+            ("gsky_pressure_state", ())] == 1.0
+    finally:
+        with adm._lock:
+            st.tenant_queued.pop("10.0.0.9", None)
+        default_monitor().force(None)
+        default_monitor().reset()
+        reset_cancel_stats()

@@ -1,0 +1,379 @@
+"""Overload survival: cancel tokens, the memory-pressure monitor,
+adaptive (AIMD) admission with weighted-fair tenant queues, and the
+brownout degrade path over real HTTP — the subsystems behind
+docs/RESILIENCE.md "Overload & brownout"."""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+from gsky_tpu.resilience import (CancelToken, RequestCancelled,
+                                 cancel_scope, cancel_stats, check_cancel,
+                                 current_token, reset_cancel_stats)
+from gsky_tpu.resilience.pressure import (PressureMonitor, default_monitor,
+                                          staging_allowed)
+from gsky_tpu.serving import AdmissionController, AdmissionShed
+
+from fixtures import make_archive
+from test_serving import fetch, getmap, make_env
+
+
+@pytest.fixture(autouse=True)
+def _fresh_overload_state():
+    reset_cancel_stats()
+    default_monitor().reset()
+    yield
+    reset_cancel_stats()
+    default_monitor().reset()
+
+
+@pytest.fixture(scope="module")
+def arch(tmp_path_factory):
+    return make_archive(str(tmp_path_factory.mktemp("ovl") / "data"))
+
+
+# ---------------------------------------------------------------------------
+# cancel token
+# ---------------------------------------------------------------------------
+
+
+class TestCancelToken:
+    def test_fire_once_and_check_raises(self):
+        tok = CancelToken()
+        tok.check("decode")             # not fired: no-op
+        assert tok.cancel("deadline") is True
+        assert tok.cancel("again") is False      # idempotent
+        assert tok.reason == "deadline"
+        with pytest.raises(RequestCancelled) as ei:
+            tok.check("decode")
+        # RequestCancelled must unwind through `except Exception`
+        # ladders: it is a CancelledError, i.e. a BaseException
+        assert isinstance(ei.value, asyncio.CancelledError)
+        assert not isinstance(ei.value, Exception)
+        assert ei.value.stage == "decode"
+        st = cancel_stats()
+        assert st["fired"] == 1 and st["stages"]["decode"] == 1
+
+    def test_callbacks_fire_once_and_late_registration_runs(self):
+        tok = CancelToken()
+        hits = []
+        remove = tok.on_cancel(lambda: hits.append("a"))
+        tok.on_cancel(lambda: hits.append("b"))
+        remove()                        # unhooked before the fire
+        tok.cancel()
+        assert hits == ["b"]
+        tok.on_cancel(lambda: hits.append("late"))   # fires immediately
+        assert hits == ["b", "late"]
+
+    def test_scope_rides_contextvar_across_to_thread(self):
+        async def go():
+            with cancel_scope() as tok:
+                assert current_token() is tok
+                tok.cancel("client-disconnect")
+                with pytest.raises(RequestCancelled):
+                    await asyncio.to_thread(check_cancel, "dispatch")
+            assert current_token() is None
+        asyncio.new_event_loop().run_until_complete(go())
+        assert cancel_stats()["stages"] == {"dispatch": 1}
+
+    def test_check_cancel_without_scope_is_noop(self):
+        check_cancel("anything")        # no token bound: must not raise
+
+
+# ---------------------------------------------------------------------------
+# pressure monitor
+# ---------------------------------------------------------------------------
+
+
+def _mon(avail_mb, pool=None, clock=None):
+    readings = {"avail": avail_mb, "pool": pool}
+    mon = PressureMonitor(
+        avail_reader=lambda: None if readings["avail"] is None
+        else int(readings["avail"] * (1 << 20)),
+        pool_reader=lambda: readings["pool"],
+        clock=clock or time.monotonic)
+    return mon, readings
+
+
+class TestPressureMonitor:
+    def test_threshold_crossings_rise_immediately(self, monkeypatch):
+        monkeypatch.setenv("GSKY_PRESSURE_POLL_S", "0")
+        mon, r = _mon(1024)
+        assert mon.state() == 0
+        r["avail"] = 200                # below 256 MB: elevated
+        assert mon.state() == 1
+        r["avail"] = 100                # below 128 MB: critical
+        assert mon.state() == 2
+        assert mon.transitions == 2
+        assert mon.stats()["mem_available_mb"] == 100.0
+
+    def test_pool_occupancy_drives_state(self, monkeypatch):
+        monkeypatch.setenv("GSKY_PRESSURE_POLL_S", "0")
+        mon, r = _mon(8192, pool=0.5)
+        assert mon.state() == 0
+        r["pool"] = 0.95
+        assert mon.state() == 1
+        r["pool"] = 0.99
+        assert mon.state() == 2
+
+    def test_recovery_is_hysteretic(self, monkeypatch):
+        monkeypatch.setenv("GSKY_PRESSURE_POLL_S", "0")
+        monkeypatch.setenv("GSKY_PRESSURE_CLEAR_S", "10")
+        t = [100.0]
+        mon, r = _mon(100, clock=lambda: t[0])
+        assert mon.state() == 2
+        r["avail"] = 8192               # raw signal clears...
+        t[0] += 1.0
+        assert mon.state() == 2         # ...but not for long enough
+        t[0] += 5.0
+        assert mon.state() == 2
+        t[0] += 10.0                    # sustained clear window passed
+        assert mon.state() == 0
+
+    def test_critical_transition_trims_caches(self, monkeypatch):
+        monkeypatch.setenv("GSKY_PRESSURE_POLL_S", "0")
+        mon, r = _mon(1024)
+        assert mon.state() == 0 and mon.trims == 0
+        r["avail"] = 64
+        assert mon.state() == 2
+        assert mon.trims == 1           # _relieve ran exactly once
+        assert mon.state() == 2         # holding critical: no re-trim
+        assert mon.trims == 1
+
+    def test_force_and_disable(self, monkeypatch):
+        mon, _ = _mon(8192)
+        mon.force(2)
+        assert mon.state() == 2 and mon.trims == 1
+        mon.force(None)
+        monkeypatch.setenv("GSKY_PRESSURE", "0")
+        assert mon.state() == 0         # disabled: always nominal
+
+    def test_staging_allowed_tracks_default_monitor(self):
+        assert staging_allowed()
+        default_monitor().force(2)
+        assert not staging_allowed()
+        default_monitor().force(1)
+        assert staging_allowed()        # brownout still stages
+
+    def test_page_pool_declines_staging_under_critical_pressure(self):
+        from gsky_tpu.pipeline.pages import PagePool
+        pool = PagePool(capacity=4)
+        default_monitor().force(2)
+        assert pool.table_for(None, 1, 0, 0, 0, 0) is None
+        assert pool.declined == 1
+        assert pool.stats()["pinned"] == 0
+
+
+# ---------------------------------------------------------------------------
+# adaptive admission
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveAdmission:
+    def test_aimd_shrinks_on_latency_and_recovers(self, monkeypatch):
+        monkeypatch.setenv("GSKY_ADMIT_INTERVAL_S", "0")
+        ac = AdmissionController(limits={"WMS": 16}, adaptive=True)
+        st = ac.stats()["classes"]["WMS"]
+        assert st["limit"] == 16 and st["ceiling"] == 16
+        # healthy baseline, then a sustained latency excursion
+        for _ in range(20):
+            ac.observe("WMS", 0.01)
+        for _ in range(6):
+            ac.observe("WMS", 0.5)
+        shrunk = ac.stats()["classes"]["WMS"]["limit"]
+        assert shrunk < 16
+        assert shrunk >= max(1, 16 // 8)            # never below floor
+        assert ac.total_adjustments >= 1
+        # latency returns to baseline: additive recovery toward ceiling
+        for _ in range(200):
+            ac.observe("WMS", 0.01)
+        assert ac.stats()["classes"]["WMS"]["limit"] > shrunk
+
+    def test_fixed_mode_ignores_observations(self):
+        ac = AdmissionController(limits={"WMS": 8}, adaptive=False)
+        for _ in range(50):
+            ac.observe("WMS", 5.0)
+        st = ac.stats()["classes"]["WMS"]
+        assert st["limit"] == 8 and st["adjustments"] == 0
+        assert ac.stats()["adaptive"] is False
+
+    def test_pressure_clamps_effective_limit(self):
+        ac = AdmissionController(limits={"WMS": 16}, adaptive=True)
+        assert ac.stats()["classes"]["WMS"]["effective_limit"] == 16
+        default_monitor().force(1)
+        assert ac.stats()["classes"]["WMS"]["effective_limit"] == 8
+        default_monitor().force(2)
+        assert ac.stats()["classes"]["WMS"]["effective_limit"] == 4
+
+    def test_weighted_fair_queue_prefers_light_tenant(self):
+        """With one slot and a heavy/light tenant pair queued, grants
+        alternate by served-over-weight — the bulk tenant cannot
+        monopolise the class even when it queues more work."""
+        ac = AdmissionController(limits={"WMS": 1}, queue_deadline_s=5.0,
+                                 adaptive=True)
+        order = []
+
+        async def go():
+            async def one(tenant):
+                async with ac.admit("WMS", tenant):
+                    order.append(tenant)
+                    await asyncio.sleep(0.05)
+
+            async def hold():
+                async with ac.admit("WMS", "bulk"):
+                    order.append("bulk")
+                    await asyncio.sleep(0.2)   # everyone queues behind
+
+            h = asyncio.ensure_future(hold())
+            await asyncio.sleep(0.05)
+            tasks = [asyncio.ensure_future(one("bulk")) for _ in range(3)]
+            await asyncio.sleep(0.02)          # bulk enqueued first
+            tasks.append(asyncio.ensure_future(one("interactive")))
+            await asyncio.gather(h, *tasks)
+        asyncio.new_event_loop().run_until_complete(go())
+        # the interactive tenant must NOT drain last despite arriving
+        # last: fair scheduling puts it ahead of queued bulk work
+        assert order[0] == "bulk"
+        assert "interactive" in order[1:3]
+
+    def test_adaptive_cancel_mid_queue_releases_capacity(self):
+        ac = AdmissionController(limits={"WMS": 1}, queue_deadline_s=2.0,
+                                 adaptive=True)
+
+        async def go():
+            entered = asyncio.Event()
+            release = asyncio.Event()
+
+            async def hold():
+                async with ac.admit("WMS", "a"):
+                    entered.set()
+                    await release.wait()
+
+            holder = asyncio.ensure_future(hold())
+            await entered.wait()
+
+            async def queued():
+                async with ac.admit("WMS", "b"):
+                    pass
+
+            q = asyncio.ensure_future(queued())
+            await asyncio.sleep(0.1)
+            q.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await q
+            release.set()
+            await holder
+            async with ac.admit("WMS", "c"):
+                return True
+        assert asyncio.new_event_loop().run_until_complete(go())
+        st = ac.stats()["classes"]["WMS"]
+        assert st["in_use"] == 0 and st["queued"] == 0
+        assert st["cancelled"] >= 1
+        assert ac.stats()["tenants"] == {}
+
+    def test_reconfigure_rereads_environment(self, monkeypatch):
+        monkeypatch.setenv("GSKY_ADMIT_WMS", "6")
+        monkeypatch.setenv("GSKY_ADMIT_QUEUE_S", "1.5")
+        ac = AdmissionController()
+        assert ac.stats()["classes"]["WMS"]["ceiling"] == 6
+        assert ac.queue_deadline_s == 1.5
+        # a SIGHUP reload must see the environment as it is NOW —
+        # the import-time DEFAULT_LIMITS snapshot plays no part
+        monkeypatch.setenv("GSKY_ADMIT_WMS", "12")
+        monkeypatch.setenv("GSKY_ADMIT_QUEUE_S", "2.5")
+        ac.reconfigure()
+        st = ac.stats()["classes"]["WMS"]
+        assert st["ceiling"] == 12 and st["limit"] <= 12
+        assert ac.queue_deadline_s == 2.5
+
+    def test_gateway_reload_reconfigures_admission(self, monkeypatch):
+        from gsky_tpu.serving import ServingGateway
+        monkeypatch.setenv("GSKY_ADMIT_WCS", "3")
+        gw = ServingGateway()
+        assert gw.admission.stats()["classes"]["WCS"]["ceiling"] == 3
+        monkeypatch.setenv("GSKY_ADMIT_WCS", "9")
+        gw.invalidate_for_configs({})
+        assert gw.admission.stats()["classes"]["WCS"]["ceiling"] == 9
+
+
+# ---------------------------------------------------------------------------
+# brownout over HTTP
+# ---------------------------------------------------------------------------
+
+
+class TestBrownout:
+    def test_brownout_degrades_and_recovers(self, tmp_path, arch):
+        server, _, _ = make_env(tmp_path, arch)
+        default_monitor().force(1)
+        try:
+            (status, ctype, body, headers), = fetch(server, [getmap()])
+            assert status == 200 and ctype == "image/png"
+            assert "brownout" in headers.get("X-GSKY-Degraded", "")
+            # degraded responses are never cached: the recovery render
+            # must not replay a brownout tile
+            assert server.gateway.cache.stats()["entries"] == 0
+        finally:
+            default_monitor().force(None)
+            default_monitor().reset()
+        (status, _, _, headers), = fetch(server, [getmap()])
+        assert status == 200
+        assert "X-GSKY-Degraded" not in headers
+        assert server.gateway.cache.stats()["entries"] == 1
+
+    def test_debug_exposes_cancel_and_pressure(self, tmp_path, arch):
+        server, _, _ = make_env(tmp_path, arch)
+        default_monitor().force(2)
+        try:
+            (_, _, body, _), = fetch(server, ["/debug"])
+            doc = json.loads(body)
+            assert doc["pressure"]["state"] == 2
+            assert "fired" in doc["cancel"]
+            adm = doc["serving"]["admission"]
+            assert adm["adaptive"] is True
+            assert adm["classes"]["WMS"]["effective_limit"] <= \
+                adm["classes"]["WMS"]["limit"]
+        finally:
+            default_monitor().force(None)
+            default_monitor().reset()
+
+    def test_client_disconnect_cancels_and_frees_permit(self, tmp_path,
+                                                        arch, monkeypatch):
+        """Dropping the connection mid-render fires the request's cancel
+        token; the admission permit comes back and the cancellation is
+        visible in the ledger."""
+        from gsky_tpu.pipeline.tile import TilePipeline
+        started = threading.Event()
+        orig = TilePipeline.composite_dispatch
+
+        def slow(self, *a, **k):
+            started.set()
+            time.sleep(0.5)
+            return orig(self, *a, **k)
+        monkeypatch.setattr(TilePipeline, "composite_dispatch", slow)
+        server, _, _ = make_env(tmp_path, arch)
+
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+            client = TestClient(TestServer(server.app()))
+            await client.start_server()
+            try:
+                task = asyncio.ensure_future(client.get(getmap()))
+                await asyncio.to_thread(started.wait, 5.0)
+                task.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await task
+                # unwind is cooperative: give the worker thread a beat
+                for _ in range(100):
+                    st = server.gateway.admission.stats()
+                    if st["classes"]["WMS"]["in_use"] == 0:
+                        break
+                    await asyncio.sleep(0.05)
+                return server.gateway.admission.stats()
+            finally:
+                await client.close()
+        st = asyncio.new_event_loop().run_until_complete(go())
+        assert st["classes"]["WMS"]["in_use"] == 0
+        assert cancel_stats()["fired"] >= 1

@@ -440,3 +440,114 @@ class TestPrewarm:
                         bucket=512, max_scenes=2)
         assert again["compiles"] == 0
         assert again["failures"] == 0
+
+
+class TestCancellation:
+    """End-to-end cooperative cancellation at the pipeline stages: a
+    fired token must unwind decode/dispatch/readback/encode/batch waits
+    promptly AND give every gate slot / pool slot back."""
+
+    class _Req:
+        @staticmethod
+        def dst_gt():
+            return None
+        crs, height, width = None, 64, 64
+
+    def test_cancel_unwinds_decode_and_releases_gate(self):
+        from gsky_tpu.resilience import (RequestCancelled, cancel_scope,
+                                         reset_cancel_stats)
+        from gsky_tpu.resilience.cancel import cancel_stats
+        reset_cancel_stats()
+        tile_stages.reset_gates()
+        try:
+            with cancel_scope() as tok:
+                tok.cancel("client-disconnect")
+                with pytest.raises(RequestCancelled):
+                    tile_stages._decode_stage(None, self._Req(),
+                                              [object()], {})
+            gate = tile_stages._gate("decode")
+            st = gate.stats()
+            assert st["waiting"] == 0
+            # every slot came back: fill the gate without blocking
+            import contextlib
+            with contextlib.ExitStack() as stack:
+                for _ in range(gate.limit):
+                    stack.enter_context(gate.enter())
+            assert cancel_stats()["stages"].get("decode", 0) >= 1
+        finally:
+            tile_stages.reset_gates()
+            reset_cancel_stats()
+
+    def test_cancel_inside_dispatch_gate_skips_dispatch(self):
+        from gsky_tpu.resilience import (RequestCancelled, cancel_scope,
+                                         reset_cancel_stats)
+        reset_cancel_stats()
+        tile_stages.reset_gates()
+        ran = []
+        try:
+            with cancel_scope() as tok:
+                tok.cancel("deadline")
+                with pytest.raises(RequestCancelled):
+                    tile_stages._dispatch_stage(
+                        lambda: ran.append(1), {})
+            assert ran == []            # the device never saw it
+            gate = tile_stages._gate("dispatch")
+            import contextlib
+            with contextlib.ExitStack() as stack:
+                for _ in range(gate.limit):
+                    stack.enter_context(gate.enter())
+        finally:
+            tile_stages.reset_gates()
+            reset_cancel_stats()
+
+    def test_cancel_before_readback(self):
+        from gsky_tpu.resilience import (RequestCancelled, cancel_scope,
+                                         reset_cancel_stats)
+        reset_cancel_stats()
+        with cancel_scope() as tok:
+            tok.cancel()
+            with pytest.raises(RequestCancelled):
+                tile_stages._readback(np.zeros((2, 2)), {})
+        reset_cancel_stats()
+
+    def test_cancelled_encode_returns_slot_without_encoding(self):
+        from gsky_tpu.resilience import (RequestCancelled, cancel_scope,
+                                         reset_cancel_stats)
+        reset_cancel_stats()
+        reset_encode_pool()
+        ran = []
+
+        async def go():
+            with cancel_scope() as tok:
+                tok.cancel("client-disconnect")
+                with pytest.raises(RequestCancelled):
+                    await encode_async(lambda: ran.append(1))
+        try:
+            asyncio.new_event_loop().run_until_complete(go())
+            assert ran == []            # no CPU burnt for a dead client
+            st = encode_pool_stats()
+            assert st["pending"] == 0
+        finally:
+            reset_encode_pool()
+            reset_cancel_stats()
+
+    def test_batcher_wait_unblocks_on_cancel_and_batch_survives(self):
+        """Cancelling one waiter mid-flush window frees it within one
+        poll tick while the shared future still completes for the
+        batch's surviving companions."""
+        from gsky_tpu.pipeline.batcher import RenderBatcher
+        from gsky_tpu.resilience import (RequestCancelled, cancel_scope,
+                                         reset_cancel_stats)
+        from concurrent.futures import Future
+        reset_cancel_stats()
+        fut = Future()
+        with cancel_scope() as tok:
+            t = time.perf_counter()
+            import threading
+            threading.Timer(0.05, tok.cancel, ("disconnect",)).start()
+            with pytest.raises(RequestCancelled):
+                RenderBatcher._wait(fut)
+            assert time.perf_counter() - t < 1.0    # one tick, not never
+        fut.set_result("tile")          # companions are unaffected
+        assert fut.result() == "tile"
+        reset_cancel_stats()

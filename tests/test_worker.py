@@ -771,3 +771,152 @@ def test_sub_tiled_assembly_when_one_job_per_granule():
     # granule 1's quadrant is the top-left, granule 2's bottom-right
     assert out[0][1][:32, :32].all()
     assert out[1][1][32:, 32:].all()
+
+
+def test_oom_poll_interval_clamps(tmp_path):
+    """The adaptive curve must respect both clamp ends: a glacial fill
+    rate polls at MAX_POLL_S, a catastrophic one at MIN_POLL_S."""
+    from gsky_tpu.worker.oom import MAX_POLL_S, MIN_POLL_S
+    mon = OOMMonitor(child_pids=lambda: [], threshold_bytes=0)
+    # rising memory (negative fill) -> slowest cadence
+    mon._last_avail, mon._last_t = 1 << 30, time.monotonic() - 1.0
+    assert mon.poll_interval(2 << 30) == MAX_POLL_S
+    # memory collapsing at ~10 GB/s with no headroom -> fastest cadence
+    mon._last_avail, mon._last_t = 11 << 30, time.monotonic() - 1.0
+    assert mon.poll_interval(1 << 30) >= MIN_POLL_S
+    mon2 = OOMMonitor(child_pids=lambda: [], threshold_bytes=1 << 30)
+    mon2._last_avail = 100 << 30
+    mon2._last_t = time.monotonic() - 0.001
+    assert mon2.poll_interval((1 << 30) + (1 << 20)) == MIN_POLL_S
+
+
+def test_oom_poll_interval_scales_with_fill_rate(tmp_path):
+    """Same headroom, faster fill -> shorter interval (the eta/4 curve
+    of oom_monitor.go:154-174)."""
+    threshold = (8 << 30) - (256 << 20)   # 256 MB of headroom left
+    slow = OOMMonitor(child_pids=lambda: [], threshold_bytes=threshold)
+    slow._last_avail = (8 << 30) + (64 << 20)
+    slow._last_t = time.monotonic() - 1.0
+    i_slow = slow.poll_interval(8 << 30)          # 64 MB/s fill
+    fast = OOMMonitor(child_pids=lambda: [], threshold_bytes=threshold)
+    fast._last_avail = (8 << 30) + (1 << 30)
+    fast._last_t = time.monotonic() - 1.0
+    i_fast = fast.poll_interval(8 << 30)          # 1 GB/s fill
+    assert i_fast < i_slow
+
+
+def test_oom_kill_skips_dead_children(tmp_path):
+    """A pid that has already exited reads rss 0 and must never be the
+    victim; the largest LIVE child is."""
+    meminfo = tmp_path / "meminfo"
+    meminfo.write_text("MemAvailable: 100 kB\n")
+    killed = []
+    dead_pid = 2 ** 22 + 12345          # beyond pid_max: no /proc entry
+    mon = OOMMonitor(child_pids=lambda: [dead_pid, os.getpid()],
+                     threshold_bytes=10 << 20,
+                     meminfo_path=str(meminfo), kill=killed.append)
+    assert mon.check_once() == os.getpid()
+    assert killed == [os.getpid()]
+
+
+def test_oom_threshold_crossing_sequence(tmp_path):
+    """Drive the monitor through above -> below -> above with faked
+    meminfo readings: it must kill exactly once, on the crossing."""
+    meminfo = tmp_path / "meminfo"
+    killed = []
+    mon = OOMMonitor(child_pids=lambda: [os.getpid()],
+                     threshold_bytes=500 << 20,
+                     meminfo_path=str(meminfo), kill=killed.append)
+    meminfo.write_text("MemAvailable: 2000000 kB\n")   # ~2 GB: fine
+    assert mon.check_once() is None
+    meminfo.write_text("MemAvailable: 100000 kB\n")    # ~100 MB: cross
+    assert mon.check_once() == os.getpid()
+    meminfo.write_text("MemAvailable: 2000000 kB\n")   # recovered
+    assert mon.check_once() is None
+    assert killed == [os.getpid()]
+
+
+# ---------------------------------------------------------------------------
+# RPC cancellation
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_refuses_new_attempts_after_cancel(grpc_worker):
+    """A fired token stops the candidate loop before any RPC leaves the
+    process — and the fleet's in-flight ledger stays balanced."""
+    from gsky_tpu.resilience import (RequestCancelled, cancel_scope,
+                                     reset_cancel_stats)
+    from gsky_tpu.worker import WorkerClient
+    reset_cancel_stats()
+    c = WorkerClient([grpc_worker])
+    try:
+        with cancel_scope() as tok:
+            tok.cancel("client-disconnect")
+            with pytest.raises(RequestCancelled):
+                c._dispatch(pb.Task(operation="worker_info"), None)
+    finally:
+        c.close()
+        reset_cancel_stats()
+
+
+def test_inflight_rpc_future_cancelled_by_token():
+    """Mid-flight cancellation: the token's callback cancels the gRPC
+    future, and the caller unwinds as RequestCancelled (a BaseException
+    — the breaker must not record a failure for abandoned work)."""
+    import grpc
+    from gsky_tpu.resilience import (RequestCancelled, cancel_scope,
+                                     reset_cancel_stats)
+    from gsky_tpu.worker.client import WorkerClient
+    reset_cancel_stats()
+
+    class FakeFuture:
+        def __init__(self):
+            self._ev = threading.Event()
+            self.cancelled_ = False
+
+        def cancel(self):
+            self.cancelled_ = True
+            self._ev.set()
+
+        def result(self):
+            self._ev.wait(5.0)
+            if self.cancelled_:
+                raise grpc.FutureCancelledError()
+            return pb.Result()
+
+    class FakeStub:
+        def __init__(self):
+            self.fut = FakeFuture()
+
+        def future(self, task, timeout=None, metadata=None):
+            return self.fut
+
+    c = WorkerClient.__new__(WorkerClient)   # no channels needed
+    stub = FakeStub()
+    c._stubs = [stub]
+    with cancel_scope() as tok:
+        threading.Timer(0.05, tok.cancel, ("disconnect",)).start()
+        t0 = time.monotonic()
+        with pytest.raises(RequestCancelled):
+            c._call_cancellable(0, pb.Task(operation="warp"), 1.0,
+                                None, tok)
+        assert time.monotonic() - t0 < 2.0
+        assert stub.fut.cancelled_
+    reset_cancel_stats()
+
+
+def test_worker_server_skips_warp_for_departed_client(pool):
+    """ctx.is_active() False (the client aborted) short-circuits the
+    warp before the decode pool and the device are touched."""
+
+    class DeadCtx:
+        def invocation_metadata(self):
+            return ()
+
+        def is_active(self):
+            return False
+
+    svc = WorkerService(pool=pool)
+    task = pb.Task(operation="warp")
+    res = svc.process(task, DeadCtx())
+    assert res.error.startswith("cancelled:")

@@ -79,6 +79,22 @@ a strict /metrics parse including the worker-RPC histogram, and prints
 the slowest request's critical-path waterfall (tools/trace_view.py).
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario fleet --seconds 25
+
+``--scenario overload``: overload survival (docs/RESILIENCE.md
+"Overload & brownout").  Drives the adaptive-admission gateway through
+five phases: a serial warm lap that sets the AIMD latency baseline, a
+two-tenant storm (premium + bulk ``X-API-Key``) at concurrency well
+past the WMS limit, a client-disconnect volley whose aborted requests
+must hand their permits back (end-to-end cancellation), a forced
+memory-pressure brownout (degraded-but-labelled 200s, clamped
+effective limit, page staging declined), and a recovery lap that must
+come back clean.  Passes only when zero responses are bare 5xx or
+dropped connections, every admission shed is a 503 carrying
+``Retry-After``, the AIMD controller made at least one limit
+adjustment, at least one cancellation released capacity, and /metrics
+exposes the overload families through the strict parser.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario overload --seconds 20
 """
 
 from __future__ import annotations
@@ -145,7 +161,7 @@ def main(argv=None):
     ap.add_argument("--max-rss-growth-mb", type=float, default=256.0)
     ap.add_argument("--scenario",
                     choices=("churn", "hot", "wcs", "chaos", "burst",
-                             "fleet"),
+                             "fleet", "overload"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -233,7 +249,12 @@ def main(argv=None):
             from aiohttp import web
 
             async def _boot():
-                runner = web.AppRunner(server.app())
+                # mirror production (server/main.py): without handler
+                # cancellation a dropped client never fires the
+                # request's cancel token and permits leak for the
+                # duration of the render
+                runner = web.AppRunner(server.app(),
+                                       handler_cancellation=True)
                 await runner.setup()
                 site = web.TCPSite(runner, "127.0.0.1", 0)
                 await site.start()
@@ -263,6 +284,8 @@ def main(argv=None):
         return run_burst(args, watcher, mas_client, merc, boot)
     if args.scenario == "fleet":
         return run_fleet(args, watcher, mas_client, merc, boot)
+    if args.scenario == "overload":
+        return run_overload(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -1129,6 +1152,262 @@ def run_fleet(args, watcher, mas_client, merc, boot) -> int:
                 proc.kill()
             except Exception:
                 pass
+
+
+def run_overload(args, watcher, mas_client, merc, boot) -> int:
+    """Overload survival: adaptive admission under a two-tenant storm,
+    client-disconnect cancellation reclaiming permits, forced
+    memory-pressure brownout, and clean recovery (see module
+    docstring for the pass criteria)."""
+    import socket
+    import threading
+
+    from gsky_tpu.resilience import cancel_stats
+    from gsky_tpu.resilience.pressure import default_monitor
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+    from gsky_tpu.serving import default_gateway
+
+    # knobs BEFORE reconfigure(): a small WMS ceiling + short queue
+    # deadline so the storm genuinely queues and sheds at soak scale, a
+    # fast AIMD cadence so adjustments land within the run, and distinct
+    # weights for the two tenants the storm interleaves
+    os.environ["GSKY_ADMIT_ADAPTIVE"] = "1"
+    os.environ["GSKY_ADMIT_WMS"] = "4"
+    os.environ["GSKY_ADMIT_QUEUE_S"] = "1.0"
+    os.environ["GSKY_ADMIT_INTERVAL_S"] = "0.2"
+    os.environ["GSKY_TENANT_WEIGHTS"] = "key:bulk:0.25,key:premium:4"
+    adm = default_gateway.admission
+    adm.reconfigure()
+    mon = default_monitor()
+    mon.force(None)
+
+    # the DEFAULT gateway, not a private one: /metrics'
+    # gsky_admit_limit family reads the process-wide instance
+    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                       metrics=MetricsLogger(), gateway=default_gateway)
+    host = boot(server)
+
+    counter = itertools.count()
+    lock = threading.Lock()
+    shed_meta = {"sheds": 0, "missing_retry_after": 0}
+
+    def url_for(i: int, px: int = 256) -> str:
+        # multiplicative-hash bbox, ~4096 distinct values per axis:
+        # every request is an uncached render, so admission gates real
+        # work rather than response-cache hits (which bypass it)
+        fx = 0.75 * ((i * 2654435761) % 4096) / 4096.0
+        fy = 0.75 * ((i * 1597334677) % 4096) / 4096.0
+        w = merc.width * 0.22
+        bb = (f"{merc.xmin + fx * merc.width},"
+              f"{merc.ymin + fy * merc.height},"
+              f"{merc.xmin + fx * merc.width + w},"
+              f"{merc.ymin + fy * merc.height + w}")
+        return (f"http://{host}/ows?service=WMS&request=GetMap"
+                f"&version=1.3.0&layers=landsat&crs=EPSG:3857&bbox={bb}"
+                f"&width={px}&height={px}&format=image/png"
+                f"&time=2020-01-{10 + i % 4:02d}T00:00:00.000Z")
+
+    def classify(url: str, headers=None) -> str:
+        req = urllib.request.Request(url, headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                degraded = r.headers.get("X-GSKY-Degraded")
+                r.read()
+                return "degraded" if degraded else "ok"
+        except urllib.error.HTTPError as e:
+            ctype = e.headers.get("Content-Type", "")
+            retry = e.headers.get("Retry-After")
+            e.read()
+            if e.code == 500 or "vnd.ogc.se_xml" not in ctype:
+                return "hard_5xx"
+            if e.code == 503:
+                # no faults are injected in this scenario, so every 503
+                # is an admission shed — it must carry Retry-After
+                with lock:
+                    shed_meta["sheds"] += 1
+                    if not retry:
+                        shed_meta["missing_retry_after"] += 1
+            return "ogc_error"
+        except Exception:
+            return "transport"
+
+    def drive(seconds: float, conc: int, counts: dict):
+        tenants = ("premium", "bulk")
+
+        def one(_):
+            i = next(counter)
+            hdrs = {"X-API-Key": tenants[i % len(tenants)]}
+            c = classify(url_for(i), hdrs)
+            with lock:
+                counts[c] = counts.get(c, 0) + 1
+
+        t_end = time.time() + seconds
+        with cf.ThreadPoolExecutor(conc) as ex:
+            while time.time() < t_end:
+                list(ex.map(one, range(conc * 2)))
+
+    # phase 1 — serial warm lap: pays compiles + scene decode and sets
+    # the AIMD latency baseline LOW, so the contended storm after it
+    # reads as a knee and forces a multiplicative decrease
+    warm_counts: dict = {}
+    for _ in range(6):
+        c = classify(url_for(next(counter)))
+        warm_counts[c] = warm_counts.get(c, 0) + 1
+
+    # phase 2 — two-tenant storm at concurrency well past the limit:
+    # contended renders inflate service time (decrease), queue waits
+    # past the deadline shed as clean 503s
+    storm_counts: dict = {}
+    drive(max(args.seconds * 0.4, 8.0), max(args.conc, 10), storm_counts)
+
+    # phase 3 — cooldown: light serial load while latency is healthy
+    # again gives the controller room for additive recovery
+    cool_counts: dict = {}
+    t_end = time.time() + max(args.seconds * 0.15, 3.0)
+    while time.time() < t_end:
+        c = classify(url_for(next(counter)))
+        cool_counts[c] = cool_counts.get(c, 0) + 1
+    adjustments = adm.total_adjustments
+
+    # phase 4 — client-disconnect volley: renders slowed past every
+    # hold time (injected decode latency + a cold scene cache, so a
+    # warmed pipeline can't finish before the client departs), then
+    # aborted mid-flight; handler cancellation must fire each request's
+    # token and hand the permit (or queue slot) back
+    h, _, p = host.partition(":")
+    fired0 = cancel_stats()["fired"] + adm.total_cancelled
+
+    def disconnect_midflight(hold_s: float):
+        i = next(counter)
+        # default size (wms_max_width caps at 512; an oversized request
+        # would be rejected before admission with nothing to cancel) —
+        # the injected decode latency is what outlasts the hold
+        path = url_for(i).split(host, 1)[1]
+        s = socket.create_connection((h, int(p)), timeout=10)
+        try:
+            s.sendall((f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                       "Connection: close\r\n\r\n").encode())
+            time.sleep(hold_s)
+        finally:
+            s.close()
+
+    from gsky_tpu.pipeline.scene_cache import default_scene_cache
+    from gsky_tpu.resilience import faults
+    default_scene_cache.clear()
+    faults.configure("decode:latency:400ms:1.0", seed=5)
+    try:
+        ths = [threading.Thread(target=disconnect_midflight,
+                                args=(hold,))
+               for hold in (0.3, 0.3, 0.45, 0.45, 0.6, 0.6, 0.75, 0.75)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+    finally:
+        faults.reset()
+    cancel_seen = 0
+    drained = False
+    t_end = time.time() + 20
+    while time.time() < t_end:
+        cancel_seen = (cancel_stats()["fired"] + adm.total_cancelled
+                       - fired0)
+        cls = adm.stats()["classes"]
+        drained = all(c["in_use"] == 0 and c["queued"] == 0
+                      for c in cls.values())
+        if drained and cancel_seen >= 1:
+            break
+        time.sleep(0.5)
+
+    # phase 5 — forced brownout: elevated pressure must label fresh
+    # renders degraded (and keep them OUT of the response cache);
+    # critical pressure must clamp the effective limit and still answer
+    mon.force(1)
+    brown_hdr = 0
+    brown_counts: dict = {}
+    crit_counts: dict = {}
+    clamped = False
+    try:
+        for _ in range(4):
+            i = next(counter)
+            req = urllib.request.Request(url_for(i))
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    tag = r.headers.get("X-GSKY-Degraded") or ""
+                    r.read()
+                    if "brownout" in tag:
+                        brown_hdr += 1
+                    brown_counts["degraded" if tag else "ok"] = \
+                        brown_counts.get("degraded" if tag else "ok",
+                                         0) + 1
+            except Exception:
+                brown_counts["error"] = brown_counts.get("error", 0) + 1
+
+        mon.force(2)
+        wms = adm.stats()["classes"]["WMS"]
+        clamped = (wms["effective_limit"]
+                   <= max(1, wms["limit"] // 2))
+        drive(max(args.seconds * 0.2, 4.0), max(args.conc, 8),
+              crit_counts)
+    finally:
+        mon.force(None)
+
+    # phase 6 — recovery: pressure released; wait out the falling
+    # hysteresis (GSKY_PRESSURE_CLEAR_S holds the degraded state for a
+    # calm window), then serial renders must come back clean (no
+    # degraded label, no shed)
+    t_end = time.time() + 15
+    while time.time() < t_end and mon.state() != 0:
+        # state() (not stats()) — only state() recomputes the
+        # falling edge; stats() just reports the latched value
+        time.sleep(0.25)
+    rec_ok = sum(classify(url_for(next(counter))) == "ok"
+                 for _ in range(3))
+
+    metrics = check_metrics(host, require=(
+        "gsky_requests_total", "gsky_request_seconds",
+        "gsky_stage_seconds", "gsky_admit_limit",
+        "gsky_cancelled_total", "gsky_pressure_state"))
+    trace_rep = slowest_trace_report(host)
+
+    all_counts: dict = {}
+    for d in (warm_counts, storm_counts, cool_counts, brown_counts,
+              crit_counts):
+        for k, v in d.items():
+            all_counts[k] = all_counts.get(k, 0) + v
+
+    out = {
+        "scenario": "overload",
+        "phases": {"warm": warm_counts, "storm": storm_counts,
+                   "cooldown": cool_counts, "brownout": brown_counts,
+                   "critical": crit_counts, "recovery_ok": rec_ok},
+        "sheds": shed_meta,
+        "adjustments": adjustments,
+        "cancellation": {"fired": cancel_seen, "drained": drained},
+        "brownout_labelled": brown_hdr,
+        "pressure_clamped": clamped,
+        "admission": adm.stats(),
+        "cancel": cancel_stats(),
+        "pressure": mon.stats(),
+        "metrics": metrics,
+        "slowest_trace": trace_rep,
+    }
+    print(json.dumps(out))
+    ok = (all_counts.get("hard_5xx", 0) == 0
+          and all_counts.get("transport", 0) == 0
+          and all_counts.get("ok", 0) > 0
+          and warm_counts.get("ok", 0) == 6
+          and shed_meta["sheds"] >= 1
+          and shed_meta["missing_retry_after"] == 0
+          and adjustments >= 1
+          and cancel_seen >= 1
+          and drained
+          and brown_hdr >= 1
+          and clamped
+          and rec_ok == 3
+          and not metrics["missing"])
+    print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+    return 0 if ok else 1
 
 
 def run_wcs(args, watcher, mas_client, merc, boot) -> int:
