@@ -650,6 +650,86 @@ def bench_ragged():
     return out
 
 
+def bench_cfg_ingest(store, utm, tmp):
+    """Config ingest: ranged-vs-whole-file A/B (docs/INGEST.md).
+
+    A sparse pan walk — two tile rows of the grid, each tile visited
+    once, the access pattern of a client dragging the map — decoded two
+    ways over the SAME archive: leg A through whole-scene residency
+    (``GSKY_INGEST=0``, the classic path), leg B routed through
+    chunk-granular ranged windows (``GSKY_INGEST_WINDOW_FRAC`` set, so
+    the scene cache declines residency for the small footprints and the
+    modular fallback reads only touched chunks).  Reports per leg the
+    bytes the decode layer pulled (the ledger's whole+ranged counters),
+    the decode-stage p50 (the windowed decode timed alone, outside the
+    render path) and e2e tiles/sec."""
+    from gsky_tpu.index import MASClient
+    from gsky_tpu.ingest import (reset_sources, reset_staging_pool,
+                                 stats as ingest_stats)
+    from gsky_tpu.pipeline import TilePipeline
+    from gsky_tpu.pipeline.decode import decode_window
+
+    bands = [f"LC08_20200{110 + k}_T1" for k in range(N_SCENES)]
+    # rows j=3,4 of the shared 8x8 grid: 16 tiles, one visit each
+    reqs = _grid_reqs(utm, tmp, bands, 9, 15)[3 * GRID:5 * GRID]
+
+    def leg(env):
+        keys = ("GSKY_INGEST", "GSKY_INGEST_WINDOW_FRAC",
+                "GSKY_INGEST_WINDOW_PROMOTE")
+        saved = {k: os.environ.get(k) for k in keys}
+        os.environ.update(env)
+        try:
+            ingest_stats.reset()
+            reset_sources()
+            reset_staging_pool()
+            pipe = TilePipeline(MASClient(store))
+            render = _palette_render(
+                pipe, [(0, 0, 120, 255), (250, 250, 90, 255)])
+            tps, elapsed, latency = _timed_tiles(render, reqs)
+            # decode stage alone: the same windows, timed without the
+            # warp/encode tail (handle cache is warm from the render)
+            dts = []
+            for req in reqs[:4]:
+                for g in pipe.index(req):
+                    t0 = time.perf_counter()
+                    decode_window(g, req.bbox, req.crs,
+                                  resample=req.resample)
+                    dts.append((time.perf_counter() - t0) * 1e3)
+            dts.sort()
+            snap = ingest_stats.snapshot()
+            return {
+                "tiles_per_sec": round(tps, 2),
+                "elapsed_s": round(elapsed, 3),
+                "latency": latency,
+                "decode_p50_ms": (round(dts[len(dts) // 2], 3)
+                                  if dts else None),
+                "bytes_read": int(snap["ranged_read_bytes"]
+                                  + snap["whole_read_bytes"]),
+                "ranged_reads": snap["ranged_reads"],
+                "ranged_windows": snap["ranged_windows"],
+                "fallbacks": snap["fallbacks"],
+                "overlap_ratio": snap["overlap_ratio"],
+            }
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            ingest_stats.reset()
+            reset_sources()
+            reset_staging_pool()
+
+    whole = leg({"GSKY_INGEST": "0", "GSKY_INGEST_WINDOW_FRAC": "0",
+                 "GSKY_INGEST_WINDOW_PROMOTE": "0"})
+    ranged = leg({"GSKY_INGEST": "1", "GSKY_INGEST_WINDOW_FRAC": "0.5",
+                  "GSKY_INGEST_WINDOW_PROMOTE": "0"})
+    ratio = (round(whole["bytes_read"] / ranged["bytes_read"], 2)
+             if ranged["bytes_read"] else None)
+    return {"value": ratio, "unit": "x fewer bytes (whole/ranged)",
+            "tiles": len(reqs), "whole": whole, "ranged": ranged}
+
+
 # ---------------------------------------------------------------------------
 # device-kernel microbenchmarks (VERDICT r4 #2: chip time, not link time)
 # ---------------------------------------------------------------------------
@@ -908,6 +988,7 @@ def run_all():
         "cfg5_drill_1000": bench_cfg5_drill(tmp_drill),
         "cfg6_wcs_pipelined": bench_cfg6_wcs_pipelined(store, utm, tmp),
         "cfg_ragged": bench_ragged(),
+        "cfg_ingest": bench_cfg_ingest(store, utm, tmp),
     }
 
 

@@ -1,0 +1,53 @@
+"""Cloud-native ingest: ranged chunk reads, zero-copy page staging,
+predictive prefetch (docs/INGEST.md).
+
+The subsystem replaces whole-file window decode with chunk-granular
+byte-range reads overlapped with device compute:
+
+* `source`  — pluggable ByteSource (local pread / HTTP Range with
+  pooling + retry), range coalescing, the `fetch_ranges` funnel;
+* `stats`   — the one ledger both decode paths report to
+  (`gsky_ranged_reads_total`, `gsky_ingest_overlap_ratio`, …);
+* `staging` — preallocated page-grid host buffers the scene cache
+  decodes into and `device_put` consumes (no intermediate copies);
+* `prefetch` — the `PrefetchPlanner` warming scenes ahead of the
+  request stream (pan/zoom adjacency, WCS scan order), budgeted,
+  pressure-aware and cancellable.
+
+``GSKY_INGEST=0`` is the escape hatch: every caller checks
+`ingest_enabled()` per request and falls back to the byte-identical
+whole-file path.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import stats  # noqa: F401  (re-exported module)
+from .source import (ByteSource, HTTPRangeSource, LocalFileSource,  # noqa: F401
+                     coalesce_ranges, fetch_ranges, open_source,
+                     reset_sources, source_for)
+from .staging import (StagingPool, default_staging_pool,  # noqa: F401
+                      reset_staging_pool)
+from .prefetch import (PrefetchPlanner, default_planner,  # noqa: F401
+                       reset_default_planner)
+
+
+def ingest_enabled() -> bool:
+    """GSKY_INGEST=0 escape hatch — read per call so a live server can
+    flip back to whole-file decode without restart."""
+    return os.environ.get("GSKY_INGEST", "1") != "0"
+
+
+def window_route_frac() -> float:
+    """Footprint fraction under which a non-resident scene is served
+    through the ranged window path instead of whole-scene residency
+    (``GSKY_INGEST_WINDOW_FRAC``).  Default 0 = routing off: declining
+    residency makes the fused dispatch fall back to the modular window
+    path, which is the right trade only when the operator knows the
+    workload is cold-heavy (sparse pans over a huge archive) — so it is
+    opt-in, unlike ranged reads and staging which change no behaviour."""
+    try:
+        return float(os.environ.get("GSKY_INGEST_WINDOW_FRAC", 0.0))
+    except (TypeError, ValueError):
+        return 0.0

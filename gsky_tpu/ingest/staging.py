@@ -1,0 +1,173 @@
+"""Preallocated host staging buffers sized to the page grid.
+
+The scene-cache load used to materialise three full-scene host arrays
+per load: the decoded window, its f32 cast, and the bucket-padded copy
+`jax.device_put` ships.  With ingest on, decode writes straight into a
+NaN-prefilled, page-grid-aligned staging buffer (the same (page_rows,
+page_cols) multiples `pipeline/pages.py` cuts scenes into), NaN-encode
+happens in place, and `device_put` consumes the very same buffer — one
+allocation, zero intermediate copies, and pool pages stage from the
+resulting device scene without re-pulling overlapping windows.
+
+Reuse is upload-safe: a released buffer parks in a cooling list tied
+to the device array it backed and only returns to the free list once
+that upload is observably complete (``dev.is_ready()``) or the device
+array itself has been collected — ``device_put`` is async, and
+recycling the host memory under an in-flight DMA would corrupt the
+scene.  Capacity is bounded by ``GSKY_STAGING_MB`` (default 128);
+beyond it, `acquire` simply allocates an unpooled buffer (degradation
+is an extra allocation, never a stall).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+class StagingPool:
+    """Shape-keyed free list of NaN-prefilled f32 host buffers."""
+
+    def __init__(self, max_mb: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._free: Dict[Tuple[int, int], List[np.ndarray]] = {}
+        self._cooling: List[Tuple[object, np.ndarray]] = []  # (dev ref, buf)
+        self._bytes = 0
+        self._max_bytes = (max_mb if max_mb is not None
+                           else _env_int("GSKY_STAGING_MB", 128)) << 20
+        self.allocated = 0
+        self.reused = 0
+        self.unpooled = 0
+
+    def _drain_cooling_locked(self) -> None:
+        still = []
+        for ref, buf in self._cooling:
+            dev = ref() if isinstance(ref, weakref.ref) else ref
+            done = dev is None
+            if not done:
+                is_ready = getattr(dev, "is_ready", None)
+                try:
+                    done = bool(is_ready()) if callable(is_ready) else False
+                except Exception:
+                    done = True
+            if done:
+                self._free.setdefault(buf.shape, []).append(buf)
+            else:
+                still.append((ref, buf))
+        self._cooling = still
+
+    def acquire(self, rows: int, cols: int) -> np.ndarray:
+        """A NaN-filled f32 (rows, cols) buffer — pooled when one of
+        the shape is free (or cooled), freshly allocated otherwise."""
+        shape = (int(rows), int(cols))
+        with self._lock:
+            self._drain_cooling_locked()
+            bucket = self._free.get(shape)
+            if bucket:
+                buf = bucket.pop()
+                self.reused += 1
+                buf.fill(np.nan)
+                return buf
+            nbytes = shape[0] * shape[1] * 4
+            pooled = self._bytes + nbytes <= self._max_bytes
+            if pooled:
+                self._bytes += nbytes
+                self.allocated += 1
+            else:
+                self.unpooled += 1
+        buf = np.full(shape, np.nan, np.float32)
+        if not pooled:
+            buf = _Unpooled(buf)
+        return buf
+
+    def release(self, buf: np.ndarray, dev=None) -> None:
+        """Return a buffer.  With ``dev`` (the device array fed from
+        this buffer) the buffer cools until the upload is done; without
+        it the buffer is free immediately (caller guarantees no
+        in-flight consumer)."""
+        if isinstance(buf, _Unpooled):
+            return
+        base = buf if buf.base is None else buf.base
+        if dev is not None and _aliases(dev, base):
+            # CPU jax may zero-copy device_put: the "device" array IS
+            # this host memory, forever.  Uncharge and forget the
+            # buffer — recycling it would rewrite the resident scene.
+            with self._lock:
+                self._bytes = max(0, self._bytes - base.nbytes)
+            return
+        with self._lock:
+            if dev is not None:
+                try:
+                    ref: object = weakref.ref(dev)
+                except TypeError:
+                    ref = dev
+                self._cooling.append((ref, base))
+            else:
+                self._free.setdefault(base.shape, []).append(base)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            free = sum(len(v) for v in self._free.values())
+            return {"allocated": self.allocated, "reused": self.reused,
+                    "unpooled": self.unpooled, "free": free,
+                    "cooling": len(self._cooling),
+                    "pool_bytes": self._bytes,
+                    "max_bytes": self._max_bytes}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._free.clear()
+            self._cooling.clear()
+            self._bytes = 0
+            self.allocated = self.reused = self.unpooled = 0
+
+
+def _aliases(dev, base: np.ndarray) -> bool:
+    """True when a device array shares memory with the host buffer that
+    fed it (CPU-backend zero-copy device_put).  Errs towards True —
+    "can't prove it's safe" must mean "don't recycle"."""
+    try:
+        plats = {d.platform for d in dev.devices()}
+        if plats and plats != {"cpu"}:
+            return False
+        return bool(np.shares_memory(np.asarray(dev), base))
+    except Exception:
+        return True
+
+
+class _Unpooled(np.ndarray):
+    """Marker subclass for over-budget buffers: behaves as a normal
+    array, silently dropped on release."""
+
+    def __new__(cls, arr: np.ndarray):
+        return arr.view(cls)
+
+
+_default: Optional[StagingPool] = None
+_default_lock = threading.Lock()
+
+
+def default_staging_pool() -> StagingPool:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = StagingPool()
+        return _default
+
+
+def reset_staging_pool() -> None:
+    """Test hook: drop the singleton so GSKY_STAGING_MB re-reads."""
+    global _default
+    with _default_lock:
+        _default = None

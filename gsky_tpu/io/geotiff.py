@@ -174,6 +174,44 @@ class IFD:
         return int(self.val(T_HEIGHT))
 
 
+@dataclass
+class ChunkMap:
+    """Per-chunk byte-range layout of one IFD (tile grid, or strips —
+    modelled as a 1-wide chunk column of chunk_w == raster width).
+    ``offsets``/``counts`` are the raw TIFF arrays, plane-major for
+    PlanarConfiguration=2."""
+    tiled: bool
+    chunk_w: int
+    chunk_h: int
+    chunks_x: int
+    chunks_y: int
+    offsets: tuple
+    counts: tuple
+    samples: int
+    planar: int
+
+    @property
+    def nchunks(self) -> int:
+        return self.chunks_x * self.chunks_y
+
+    def ranges_for(self, window: Tuple[int, int, int, int],
+                   band: int = 1) -> List[Tuple[int, int]]:
+        """(offset, nbytes) of every chunk a (col0, row0, w, h) window
+        touches, row-major — the exact byte set a ranged reader fetches
+        for that window."""
+        c0, r0, w, h = window
+        bi = band - 1
+        plane_off = bi * self.nchunks if self.planar == 2 else 0
+        out: List[Tuple[int, int]] = []
+        for cy in range(r0 // self.chunk_h,
+                        (r0 + h - 1) // self.chunk_h + 1):
+            for cx in range(c0 // self.chunk_w,
+                            (c0 + w - 1) // self.chunk_w + 1):
+                idx = plane_off + cy * self.chunks_x + cx
+                out.append((int(self.offsets[idx]), int(self.counts[idx])))
+        return out
+
+
 class GeoTIFF:
     """Reader.  Open, inspect, read windows; overview IFDs exposed as
     `overviews` (list of (factor, IFD))."""
@@ -385,12 +423,41 @@ class GeoTIFF:
     def bbox(self) -> BBox:
         return self.gt.bbox(self.width, self.height)
 
+    def chunk_map(self, ifd: Optional[IFD] = None) -> "ChunkMap":
+        """The byte-range layout of one IFD: per-chunk (offset, nbytes)
+        over the tile/strip grid — what a ranged reader needs to fetch
+        exactly the chunks a window touches (docs/INGEST.md)."""
+        ifd = ifd or self.ifd
+        W, H = ifd.width, ifd.height
+        samples = int(ifd.val(T_SAMPLES, 1))
+        planar = int(ifd.val(T_PLANAR, 1))
+        if ifd.tags.get(T_TILE_OFFSETS):
+            tw, th = int(ifd.val(T_TILE_W)), int(ifd.val(T_TILE_H))
+            return ChunkMap(True, tw, th, (W + tw - 1) // tw,
+                            (H + th - 1) // th,
+                            ifd.arr(T_TILE_OFFSETS), ifd.arr(T_TILE_COUNTS),
+                            samples, planar)
+        rps = int(ifd.val(T_ROWS_PER_STRIP, H))
+        return ChunkMap(False, W, rps, 1, (H + rps - 1) // rps,
+                        ifd.arr(T_STRIP_OFFSETS), ifd.arr(T_STRIP_COUNTS),
+                        samples, planar)
+
     # -- reading -------------------------------------------------------------
 
     def read(self, band: int = 1, window: Optional[Tuple[int, int, int, int]] = None,
-             ifd: Optional[IFD] = None) -> np.ndarray:
+             ifd: Optional[IFD] = None, *, source=None,
+             out: Optional[np.ndarray] = None) -> np.ndarray:
         """Read one band (1-based, GDAL convention).  window =
-        (col0, row0, w, h).  Returns (h, w) in storage dtype."""
+        (col0, row0, w, h).  Returns (h, w) in storage dtype.
+
+        ``source`` (an `ingest.source.ByteSource`) reroutes the block
+        byte fetches through coalesced ranged reads instead of the
+        handle's seek+read loop — same blocks, same decode, same
+        assembly, so the output is byte-identical by construction.
+        ``out`` decodes straight into a caller-provided (h, w) array
+        (any assignable dtype — the ingest staging buffers pass
+        page-grid-aligned f32 views here to skip the intermediate
+        window copy)."""
         ifd = ifd or self.ifd
         W, H = ifd.width, ifd.height
         if window is None:
@@ -409,7 +476,10 @@ class GeoTIFF:
         dt = _np_dtype(int(bits[0]), int(fmts[0])).newbyteorder(self._e)
         comp = int(ifd.val(T_COMPRESSION, 1))
         pred = int(ifd.val(T_PREDICTOR, 1))
-        out = np.zeros((h, w), dtype=dt.newbyteorder("="))
+        if out is None:
+            out = np.zeros((h, w), dtype=dt.newbyteorder("="))
+        elif out.shape != (h, w):
+            raise ValueError(f"out shape {out.shape} != window ({h}, {w})")
         bi = band - 1
         if not (0 <= bi < samples):
             raise ValueError(f"band {band} out of range (1..{samples})")
@@ -423,20 +493,24 @@ class GeoTIFF:
             tiles_y = (H + th - 1) // th
             plane_off = bi * tiles_x * tiles_y if planar == 2 else 0
             spp = 1 if planar == 2 else samples
-            for ty in range(r0 // th, (r0 + h - 1) // th + 1):
-                for tx in range(c0 // tw, (c0 + w - 1) // tw + 1):
-                    idx = plane_off + ty * tiles_x + tx
-                    block = self._decode_block(offsets[idx], counts[idx],
-                                               comp, pred, th, tw, spp, dt)
-                    data = block[..., 0 if planar == 2 else bi]
-                    # intersect tile with window
-                    br0, bc0 = ty * th, tx * tw
-                    rr0 = max(r0, br0)
-                    rr1 = min(r0 + h, br0 + th)
-                    cc0 = max(c0, bc0)
-                    cc1 = min(c0 + w, bc0 + tw)
-                    out[rr0 - r0:rr1 - r0, cc0 - c0:cc1 - c0] = \
-                        data[rr0 - br0:rr1 - br0, cc0 - bc0:cc1 - bc0]
+            blocks = [(ty, tx)
+                      for ty in range(r0 // th, (r0 + h - 1) // th + 1)
+                      for tx in range(c0 // tw, (c0 + w - 1) // tw + 1)]
+            raws = self._fetch_blocks(
+                [(offsets[plane_off + ty * tiles_x + tx],
+                  counts[plane_off + ty * tiles_x + tx])
+                 for ty, tx in blocks], source)
+            for (ty, tx), raw in zip(blocks, raws):
+                block = self._decode_raw(raw, comp, pred, th, tw, spp, dt)
+                data = block[..., 0 if planar == 2 else bi]
+                # intersect tile with window
+                br0, bc0 = ty * th, tx * tw
+                rr0 = max(r0, br0)
+                rr1 = min(r0 + h, br0 + th)
+                cc0 = max(c0, bc0)
+                cc1 = min(c0 + w, bc0 + tw)
+                out[rr0 - r0:rr1 - r0, cc0 - c0:cc1 - c0] = \
+                    data[rr0 - br0:rr1 - br0, cc0 - bc0:cc1 - bc0]
         else:
             rps = int(ifd.val(T_ROWS_PER_STRIP, H))
             offsets = ifd.arr(T_STRIP_OFFSETS)
@@ -444,11 +518,13 @@ class GeoTIFF:
             strips = (H + rps - 1) // rps
             plane_off = bi * strips if planar == 2 else 0
             spp = 1 if planar == 2 else samples
-            for s in range(r0 // rps, (r0 + h - 1) // rps + 1):
+            rows = list(range(r0 // rps, (r0 + h - 1) // rps + 1))
+            raws = self._fetch_blocks(
+                [(offsets[plane_off + s], counts[plane_off + s])
+                 for s in rows], source)
+            for s, raw in zip(rows, raws):
                 srows = min(rps, H - s * rps)
-                block = self._decode_block(offsets[plane_off + s],
-                                           counts[plane_off + s],
-                                           comp, pred, srows, W, spp, dt)
+                block = self._decode_raw(raw, comp, pred, srows, W, spp, dt)
                 data = block[..., 0 if planar == 2 else bi]
                 br0 = s * rps
                 rr0 = max(r0, br0)
@@ -456,22 +532,39 @@ class GeoTIFF:
                 out[rr0 - r0:rr1 - r0, :] = data[rr0 - br0:rr1 - br0, c0:c0 + w]
         return out
 
+    def _fetch_blocks(self, ranges, source) -> List[bytes]:
+        """Raw (compressed) bytes for each (offset, nbytes) block — via
+        coalesced ranged reads through ``source`` when given, else the
+        handle's own fp.  Bounds are enforced for BOTH paths: a corrupt
+        header must not drive a huge pre-allocating read anywhere."""
+        for offset, nbytes in ranges:
+            if offset < 0 or nbytes < 0 \
+                    or offset + nbytes > self._file_size:
+                raise ValueError(
+                    f"corrupt TIFF: block [{offset}, {offset + nbytes}) "
+                    f"beyond file size {self._file_size}")
+        if source is not None:
+            from ..ingest.source import fetch_ranges
+            return fetch_ranges(source, ranges)
+        out = []
+        with self._fp_lock:  # shared handles are read from worker threads
+            for offset, nbytes in ranges:
+                self._fp.seek(offset)
+                out.append(self._fp.read(nbytes))
+        return out
+
     def _decode_block(self, offset: int, nbytes: int, comp: int, pred: int,
                       rows: int, cols: int, samples: int, dt: np.dtype) -> np.ndarray:
+        raw = self._fetch_blocks([(offset, nbytes)], None)[0]
+        return self._decode_raw(raw, comp, pred, rows, cols, samples, dt)
+
+    def _decode_raw(self, raw: bytes, comp: int, pred: int,
+                    rows: int, cols: int, samples: int, dt: np.dtype) -> np.ndarray:
         expected = rows * cols * samples * dt.itemsize
-        # bound every size a corrupt header controls: fp.read and the
-        # decompress output buffer both PRE-ALLOCATE their full size
-        if offset < 0 or nbytes < 0 \
-                or offset + nbytes > self._file_size:
-            raise ValueError(
-                f"corrupt TIFF: block [{offset}, {offset + nbytes}) "
-                f"beyond file size {self._file_size}")
         if expected > (1 << 31):
+            # the decompress output buffer PRE-ALLOCATES its full size
             raise ValueError(
                 f"corrupt TIFF: block declares {expected} bytes")
-        with self._fp_lock:  # shared handles are read from worker threads
-            self._fp.seek(offset)
-            raw = self._fp.read(nbytes)
         data = _decompress(raw, comp, expected)
         if len(data) < expected:
             data = data + b"\0" * (expected - len(data))

@@ -200,6 +200,7 @@ class NetCDF:
             self._nc3 = None
             self._h5 = h5py.File(path, "r")
             self.variables = {}
+            self._h5_datasets: Dict[str, object] = {}
             self.attrs = {k: self._h5.attrs[k] for k in self._h5.attrs}
 
             def visit(name, obj):
@@ -213,6 +214,7 @@ class NetCDF:
                     self.variables[name.split("/")[-1]] = NCVar(
                         name.split("/")[-1], dims, obj.shape, obj.dtype,
                         attrs, _reader=obj.__getitem__)
+                    self._h5_datasets[name.split("/")[-1]] = obj
             self._h5.visititems(visit)
         else:
             raise ValueError(f"{path}: not a NetCDF file")
@@ -350,6 +352,87 @@ class NetCDF:
             t = 0 if time_index is None else time_index
             return np.asarray(v[(t, 0, ys, xs)])
         raise ValueError(f"unsupported rank {len(v.shape)} for {var_name}")
+
+    # -- ranged ingest -------------------------------------------------------
+
+    def chunk_map(self, var_name: str) -> Dict[str, object]:
+        """The chunk index of one variable, for ranged readers
+        (docs/INGEST.md).  NetCDF-3 layouts are exact byte arithmetic
+        (begin/record stride/row bytes — every hyperslab maps to row
+        ranges); NetCDF-4 reports the HDF5 chunk shape and count (h5py
+        owns the B-tree, so ranged NC4 reads stay with h5py)."""
+        v = self.variables[var_name]
+        if self._nc3 is not None:
+            rd = v._reader
+            if not isinstance(rd, _NC3Reader):
+                raise ValueError(f"{var_name}: no NC3 layout")
+            itemsize = rd.dt.itemsize
+            return {"kind": "nc3", "begin": rd.begin,
+                    "record": rd.is_record, "rec_stride": rd.rec_stride,
+                    "itemsize": itemsize, "shape": tuple(v.shape),
+                    "row_bytes": int(v.shape[-1]) * itemsize}
+        ds = self._h5_datasets.get(var_name)
+        if ds is None:
+            raise KeyError(var_name)
+        out: Dict[str, object] = {
+            "kind": "hdf5", "shape": tuple(v.shape),
+            "chunks": tuple(ds.chunks) if ds.chunks else None}
+        try:
+            out["nchunks"] = int(ds.id.get_num_chunks())
+        except Exception:
+            out["nchunks"] = None
+        return out
+
+    def read_slice_source(self, var_name: str, source,
+                          time_index: Optional[int] = None,
+                          window: Optional[Tuple[int, int, int, int]] = None,
+                          step: int = 1) -> np.ndarray:
+        """`read_slice` served by coalesced byte-range reads through a
+        pluggable `ingest.source.ByteSource` — NetCDF-3 only (the flat
+        layout makes every hyperslab a set of row ranges; NC4/HDF5
+        chunk decode stays with h5py).  Byte-identical to `read_slice`
+        by construction: same rows, same dtype normalisation, same
+        ``_Unsigned`` handling."""
+        if self._nc3 is None:
+            raise ValueError("ranged hyperslabs require NetCDF-3")
+        v = self.variables[var_name]
+        rd = v._reader
+        rank = len(v.shape)
+        if rank not in (2, 3, 4):
+            raise ValueError(f"unsupported rank {rank} for {var_name}")
+        H, W = v.shape[-2], v.shape[-1]
+        c0, r0, w, h = window if window is not None else (0, 0, W, H)
+        if c0 < 0 or r0 < 0 or c0 + w > W or r0 + h > H:
+            raise ValueError(
+                f"window {(c0, r0, w, h)} outside raster {W}x{H}")
+        itemsize = rd.dt.itemsize
+        if rank == 2:
+            base = rd.begin
+        else:
+            t = 0 if time_index is None else int(time_index)
+            if not 0 <= t < v.shape[0]:
+                raise IndexError(
+                    f"record index {t} out of range for {var_name}")
+            if rd.is_record:
+                base = rd.begin + t * rd.rec_stride
+            else:
+                per0 = int(np.prod(v.shape[1:], dtype=np.int64))
+                base = rd.begin + t * per0 * itemsize
+            # rank 4 reads plane z=0 (matching read_slice), which is
+            # the first H*W block of the record — no extra offset
+        st = step if step > 1 else 1
+        rows = range(r0, r0 + h, st)
+        ranges = [(base + (r * W + c0) * itemsize, w * itemsize)
+                  for r in rows]
+        from ..ingest.source import fetch_ranges
+        raws = fetch_ranges(source, ranges)
+        arr = np.stack([np.frombuffer(raw, rd.dt)[::st] for raw in raws]) \
+            if raws else np.zeros((0, 0), rd.dt)
+        out = np.ascontiguousarray(arr).astype(rd.dt.newbyteorder("="))
+        if str(v.attrs.get("_Unsigned", "")).lower() in ("true", "1") \
+                and out.dtype.kind == "i":
+            out = out.view(np.dtype(f"u{out.dtype.itemsize}"))
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -322,8 +322,40 @@ def _collect_overload():
     return out
 
 
+def _collect_ingest():
+    """Cloud-native ingest surfaces (docs/INGEST.md): ranged-read
+    volume, prefetch outcome counts, and how much of the ranged-read
+    time hid under an in-flight device dispatch."""
+    out: List = []
+    try:
+        from ..ingest import stats as ingest_stats
+        st = ingest_stats.snapshot()
+        out.append(_c("gsky_ranged_reads_total",
+                      "Coalesced byte-range requests issued by the "
+                      "ingest read path.",
+                      [({}, float(st.get("ranged_reads", 0)))]))
+        out.append(_c("gsky_ranged_read_bytes_total",
+                      "Bytes fetched through ranged reads.",
+                      [({}, float(st.get("ranged_read_bytes", 0)))]))
+        pf = st.get("prefetch") or {}
+        out.append(_c("gsky_prefetch_total",
+                      "Prefetch outcomes: predicted-and-used (hit), "
+                      "requested-but-not-ready (miss), warmed-but-"
+                      "expired (wasted).",
+                      [({"outcome": k}, float(pf.get(k, 0)))
+                       for k in ("hit", "miss", "wasted")]))
+        out.append(_g("gsky_ingest_overlap_ratio",
+                      "Fraction of ranged-read seconds spent while a "
+                      "device dispatch was in flight.",
+                      [({}, float(st.get("overlap_ratio", 0.0)))]))
+    except Exception:
+        pass
+    return out
+
+
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
-            _collect_runtime, _collect_batcher, _collect_overload):
+            _collect_runtime, _collect_batcher, _collect_overload,
+            _collect_ingest):
     _REG.register_collector(_fn)
 
 

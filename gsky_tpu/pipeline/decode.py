@@ -44,30 +44,56 @@ class _HandleCache:
         self._lock = threading.Lock()
         self._handles: Dict[str, object] = {}
         self._order: List[str] = []
+        self._opening: Dict[str, threading.Event] = {}
         self._max = max_handles
 
     def get(self, path: str, is_netcdf: bool):
+        # per-path open latch: concurrent callers for the same path wait
+        # for the first opener instead of each paying the (expensive)
+        # duplicate open and closing the loser afterwards
+        while True:
+            with self._lock:
+                h = self._handles.get(path)
+                if h is not None:
+                    return h
+                ev = self._opening.get(path)
+                if ev is None:
+                    ev = self._opening[path] = threading.Event()
+                    break
+            # opener in flight: wait, then re-check (a set() without a
+            # cached handle means the open failed — retry it ourselves)
+            ev.wait()
+        try:
+            # non-NetCDF granules resolve through the format registry
+            # (GeoTIFF fast path, GMT grids, adapter tier) — the GDALOpen
+            # driver-dispatch role (`worker/gdalprocess/warp.go:89-101`)
+            from ..io.registry import open_raster
+            h = NetCDF(path) if is_netcdf else open_raster(path)
+        except BaseException:
+            with self._lock:
+                self._opening.pop(path, None)
+            ev.set()
+            raise
         with self._lock:
-            h = self._handles.get(path)
-            if h is not None:
-                return h
-        # non-NetCDF granules resolve through the format registry
-        # (GeoTIFF fast path, GMT grids, adapter tier) — the GDALOpen
-        # driver-dispatch role (`worker/gdalprocess/warp.go:89-101`)
-        from ..io.registry import open_raster
-        h = NetCDF(path) if is_netcdf else open_raster(path)
-        with self._lock:
+            self._opening.pop(path, None)
             if path in self._handles:
-                h.close()
-                return self._handles[path]
-            self._handles[path] = h
-            self._order.append(path)
-            while len(self._order) > self._max:
-                old = self._order.pop(0)
+                # unreachable with the latch, but keeps the invariant
+                # under any future insertion path: close the loser
                 try:
-                    self._handles.pop(old).close()
+                    h.close()
                 except Exception:
                     pass
+                h = self._handles[path]
+            else:
+                self._handles[path] = h
+                self._order.append(path)
+                while len(self._order) > self._max:
+                    old = self._order.pop(0)
+                    try:
+                        self._handles.pop(old).close()
+                    except Exception:
+                        pass
+        ev.set()
         return h
 
 
@@ -85,6 +111,92 @@ def _count_read() -> None:
     global window_reads
     with _counter_lock:
         window_reads += 1
+
+
+def _ingest_source(path: str):
+    """ByteSource for a granule when ranged ingest is on (None → the
+    classic whole-file handle read).  Never raises — any source failure
+    degrades to the plain path."""
+    try:
+        from ..ingest import ingest_enabled
+        if not ingest_enabled():
+            return None
+        from ..ingest.source import source_for
+        return source_for(path)
+    except Exception:
+        return None
+
+
+def _read_tiff(h, band: int, win, ifd, path: str) -> np.ndarray:
+    """GeoTIFF window read, ranged when a ByteSource is available.
+
+    The ranged leg reuses the exact decode/assembly code of the plain
+    leg (`GeoTIFF.read(source=...)` only swaps how raw block bytes are
+    fetched), so output is byte-identical by construction; any ranged
+    failure falls back to the handle read and is counted."""
+    from ..ingest import stats as _istats
+    src = _ingest_source(path) if isinstance(h, GeoTIFF) else None
+    if src is not None:
+        try:
+            out = h.read(band, win, ifd=ifd, source=src)
+            _istats.record_ranged_window()
+            return out
+        except Exception:
+            _istats.record_fallback()
+    out = h.read(band, win, ifd=ifd) if ifd is not None else h.read(band, win)
+    _istats.record_whole(out.nbytes)
+    return out
+
+
+def _read_nc(h, var_name: str, time_index, win, step: int,
+             path: str) -> np.ndarray:
+    """NetCDF hyperslab read, ranged (NetCDF-3 row byte-ranges) when a
+    ByteSource is available; HDF5-backed files always take the handle
+    path (h5py owns chunk decode)."""
+    from ..ingest import stats as _istats
+    src = _ingest_source(path) if getattr(h, "_nc3", None) is not None else None
+    if src is not None:
+        try:
+            out = h.read_slice_source(var_name, src, time_index, win,
+                                      step=step)
+            _istats.record_ranged_window()
+            return out
+        except Exception:
+            _istats.record_fallback()
+    out = h.read_slice(var_name, time_index, win, step=step)
+    _istats.record_whole(out.nbytes)
+    return out
+
+
+def granule_footprint_frac(granule: Granule, dst_bbox: BBox,
+                           dst_crs: CRS) -> Optional[float]:
+    """Fraction of the granule's raster the dst footprint touches
+    (0..1), or None when it can't be computed (callers treat None as
+    "assume full").  Drives the scene cache's window-vs-residency
+    routing: tiny footprints stream through ranged window decode
+    instead of forcing a whole-scene load."""
+    if granule.geo_loc:
+        return None
+    try:
+        src_crs = parse_crs(granule.srs) if granule.srs else dst_crs
+        gt = GeoTransform.from_gdal(granule.geo_transform)
+        src_bbox = transform_bbox(dst_bbox, dst_crs, src_crs)
+        h = _handles.get(granule.path, granule.is_netcdf)
+        if granule.is_netcdf:
+            v = h.variables.get(granule.var_name)
+            if v is None:
+                return None
+            H, W = v.shape[-2], v.shape[-1]
+        else:
+            W, H = h.width, h.height
+        if not W or not H:
+            return None
+        win = _pixel_window(gt, src_bbox, W, H, margin=3)
+        if win is None:
+            return 0.0
+        return (win[2] * win[3]) / float(W * H)
+    except Exception:
+        return None
 
 
 def margin_for(resample: str) -> int:
@@ -162,9 +274,9 @@ def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
             if win is None:
                 return None
             c0, r0, w, ww = win
-            data = h.read_slice(granule.var_name, granule.time_index,
-                                (c0 * st, r0 * st, w * st, ww * st),
-                                step=st)
+            data = _read_nc(h, granule.var_name, granule.time_index,
+                            (c0 * st, r0 * st, w * st, ww * st),
+                            st, granule.path)
             gt = gt_ov
             win = (c0, r0, w, ww)
         else:
@@ -172,8 +284,8 @@ def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
             if win is None:
                 return None
             c0, r0, w, ww = win
-            data = h.read_slice(granule.var_name, granule.time_index,
-                                (c0, r0, w, ww))
+            data = _read_nc(h, granule.var_name, granule.time_index,
+                            (c0, r0, w, ww), 1, granule.path)
         nodata = granule.nodata if granule.nodata is not None else v.nodata
     else:
         W, H = h.width, h.height
@@ -188,14 +300,16 @@ def decode_window(granule: Granule, dst_bbox: BBox, dst_crs: CRS,
             if win is None:
                 return None
             c0, r0, w, ww = win
-            data = h.read(granule.band, (c0, r0, w, ww), ifd=ovr)
+            data = _read_tiff(h, granule.band, (c0, r0, w, ww), ovr,
+                              granule.path)
             gt = gt_ov
         else:
             win = _pixel_window(gt, src_bbox, W, H, margin)
             if win is None:
                 return None
             c0, r0, w, ww = win
-            data = h.read(granule.band, (c0, r0, w, ww))
+            data = _read_tiff(h, granule.band, (c0, r0, w, ww), None,
+                              granule.path)
         nodata = granule.nodata if granule.nodata is not None else h.nodata
     window_gt = gt.window(win[0], win[1])
     valid = nodata_mask(data, nodata)

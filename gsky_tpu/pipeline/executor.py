@@ -394,7 +394,9 @@ class WarpExecutor:
         cache = cache or default_scene_cache
         stride = 1.0 if g.geo_loc else self._granule_stride(
             g, dst_gt, dst_crs, height, width)
-        return cache.get(g, stride)
+        return cache.get(g, stride,
+                         dst_bbox=dst_gt.bbox(width, height),
+                         dst_crs=dst_crs)
 
     def warp_all(self, windows: Sequence[Optional[DecodedWindow]],
                  dst_gt: GeoTransform, dst_crs: CRS, height: int, width: int,
@@ -727,8 +729,10 @@ class WarpExecutor:
         # (one granule per namespace); channel k comes from the granule
         # whose ns id equals out_sel[k]
         chans = []
+        rgba_bbox = dst_gt.bbox(width, height)
         for ns in out_sel:
-            s = cache.get(granules[ns], stride)
+            s = cache.get(granules[ns], stride,
+                          dst_bbox=rgba_bbox, dst_crs=dst_crs)
             if s is None:
                 return None
             chans.append(s)
@@ -948,10 +952,11 @@ class WarpExecutor:
         from .scene_cache import default_scene_cache
         cache = cache or default_scene_cache
         scenes = []
+        grp_bbox = dst_gt.bbox(width, height)
         for g in granules:
             stride = 1.0 if g.geo_loc else self._granule_stride(
                 g, dst_gt, dst_crs, height, width)
-            s = cache.get(g, stride)
+            s = cache.get(g, stride, dst_bbox=grp_bbox, dst_crs=dst_crs)
             if s is None:
                 return None
             scenes.append(s)

@@ -185,6 +185,19 @@ class PagePool:
                     slots.append(s)
         return np.asarray(slots, np.int32)
 
+    def prewarm(self, dev, serial: int, i0: int, i1: int,
+                j0: int, j1: int) -> bool:
+        """Prefetch hook: stage a page window without keeping pins —
+        the planner warms pages it predicts a request will touch, and
+        the request's own `table_for` then hits.  Best-effort: declines
+        (pool full / pressure) are fine, the real request just stages
+        as usual."""
+        slots = self.table_for(dev, serial, i0, i1, j0, j1)
+        if slots is None:
+            return False
+        self.unpin(slots)
+        return True
+
     def unpin(self, slots) -> None:
         """Release pins taken by `table_for` (idempotence is the
         caller's job: once per returned table)."""

@@ -71,6 +71,12 @@ class SceneCache:
         self._inflight: Dict[tuple, threading.Event] = {}
         self.hits = 0
         self.misses = 0
+        # ranged-window routing: decline counts per key (promote-to-
+        # residency once a "cold" scene turns out to be hot), plus the
+        # running total of requests served through the window path
+        self._route_counts: Dict[tuple, int] = {}
+        self.window_routed = 0
+        self.staged_loads = 0
 
     def _key(self, g: Granule) -> tuple:
         return (g.path, g.band, g.var_name, g.time_index)
@@ -102,8 +108,52 @@ class SceneCache:
         except Exception:
             return 1
 
-    def get(self, g: Granule,
-            stride: float = 1.0) -> Optional[DeviceScene]:
+    @staticmethod
+    def _route_promote() -> int:
+        import os
+        try:
+            return int(os.environ.get("GSKY_INGEST_WINDOW_PROMOTE", 4))
+        except (TypeError, ValueError):
+            return 4
+
+    def _route_window(self, key: tuple, g: Granule, dst_bbox,
+                      dst_crs) -> bool:
+        """True when this request should stream through the ranged
+        window path instead of forcing whole-scene residency: ingest is
+        on, the scene is not (and is not becoming) resident, and the
+        request footprint covers less than ``GSKY_INGEST_WINDOW_FRAC``
+        of the raster.  After ``GSKY_INGEST_WINDOW_PROMOTE`` declines of
+        one key the scene has proven hot and is promoted to residency."""
+        try:
+            from ..ingest import ingest_enabled, window_route_frac
+            if not ingest_enabled():
+                return False
+            lim = window_route_frac()
+            if lim <= 0.0:
+                return False
+            with self._lock:
+                if key in self._scenes or key in self._inflight:
+                    return False      # resident scenes always serve
+            from .decode import granule_footprint_frac
+            frac = granule_footprint_frac(g, dst_bbox, dst_crs)
+            if frac is None or frac >= lim:
+                return False
+            promote = self._route_promote()
+            with self._lock:
+                n = self._route_counts.get(key, 0) + 1
+                self._route_counts[key] = n
+                if len(self._route_counts) > 4096:
+                    self._route_counts.pop(next(iter(self._route_counts)))
+                if 0 < promote <= n:
+                    del self._route_counts[key]
+                    return False      # hot after all: load it
+                self.window_routed += 1
+            return True
+        except Exception:
+            return False
+
+    def get(self, g: Granule, stride: float = 1.0,
+            dst_bbox=None, dst_crs=None) -> Optional[DeviceScene]:
         """Cached scene for a granule, decoding + uploading on first use.
         Returns None when the scene is uncacheable (too big / unreadable).
         Concurrent requests for the same scene decode once (per-key
@@ -112,9 +162,18 @@ class SceneCache:
         ``stride`` (source px per dst px) selects the cached resolution:
         zoomed-out requests get the overview/decimated level — which also
         makes scenes above ``max_scene_px`` cacheable once the level
-        fits (`worker/gdalprocess/warp.go:156-198`)."""
+        fits (`worker/gdalprocess/warp.go:156-198`).
+
+        ``dst_bbox``/``dst_crs`` (optional) describe the request
+        footprint; with ingest on, a non-resident scene barely touched
+        by the request is declined (None) so the caller's existing
+        uncacheable-scene fallback serves it through ranged window
+        decode instead of paying a whole-scene read + upload."""
         level = self._pick_level(g, stride)
         key = self._key(g) + (level,)
+        if dst_bbox is not None and dst_crs is not None and \
+                self._route_window(key, g, dst_bbox, dst_crs):
+            return None
         while True:
             with self._lock:
                 hit = self._scenes.get(key)
@@ -159,9 +218,46 @@ class SceneCache:
             self._order.clear()
             self._bytes = 0
 
+    def _staging_read(self, h, band: int, W: int, H: int, ovr,
+                      nodata):
+        """Decode a whole GeoTIFF scene straight into a pooled,
+        page-grid-padded f32 staging buffer: one allocation, in-place
+        NaN-encode, and `device_put` ships the same memory (zero
+        intermediate copies).  Returns (buf, pool) or (None, None) for
+        the classic path.  Only sources whose f32 cast is value-exact
+        (f32, and int/uint ≤ 16 bit with an f32-exact nodata) stage —
+        anything else would change the nodata compare and break the
+        GSKY_INGEST=0 byte-identity contract."""
+        try:
+            from ..ingest import ingest_enabled
+            from ..io.geotiff import GeoTIFF
+            if not ingest_enabled() or not isinstance(h, GeoTIFF):
+                return None, None
+            dt = h.dtype
+            exact = (dt.kind == "f" and dt.itemsize == 4) or \
+                (dt.kind in "iu" and dt.itemsize <= 2)
+            if not exact:
+                return None, None
+            if nodata is not None:
+                ndf = float(nodata)
+                if not (np.isnan(ndf) or float(np.float32(ndf)) == ndf):
+                    return None, None
+            from ..ingest.staging import default_staging_pool
+            pool = default_staging_pool()
+            buf = pool.acquire(_bucket(H), _bucket(W))
+            try:
+                h.read(band, (0, 0, W, H), ifd=ovr, out=buf[:H, :W])
+            except Exception:
+                pool.release(buf)
+                return None, None
+            return buf, pool
+        except Exception:
+            return None, None
+
     def _load(self, g: Granule, level: int = 1) -> Optional[DeviceScene]:
         from .decode import _handles
         gt = GeoTransform.from_gdal(g.geo_transform)
+        sbuf = spool = None
         try:
             from ..resilience import faults
             faults.inject("decode")
@@ -191,7 +287,12 @@ class SceneCache:
                     W, H = ovr.width, ovr.height
                 if H * W > self._max_scene_px:
                     return None
-                if ovr is not None:
+                nodata = g.nodata if g.nodata is not None else h.nodata
+                sbuf, spool = self._staging_read(h, g.band, W, H, ovr,
+                                                 nodata)
+                if sbuf is not None:
+                    data = None
+                elif ovr is not None:
                     data = h.read(g.band, (0, 0, W, H), ifd=ovr)
                 else:
                     # no ifd kwarg here: the registry read contract is
@@ -200,7 +301,6 @@ class SceneCache:
                     # the except below and were silently uncacheable,
                     # falling back to the window path every render
                     data = h.read(g.band, (0, 0, W, H))
-                nodata = g.nodata if g.nodata is not None else h.nodata
         except Exception as e:
             # "uncacheable" must stay a degradation, never a crash — but
             # it must also be VISIBLE: a signature drift in a handle's
@@ -212,8 +312,30 @@ class SceneCache:
             return None
         crs = parse_crs(g.srs) if g.srs else None
         if crs is None:
+            if sbuf is not None:
+                spool.release(sbuf)
             return None
         nd = float(nodata) if nodata is not None else float("nan")
+        from ..ingest import stats as _istats
+        if sbuf is not None:
+            # staged load: the buffer IS the scene — encode in place,
+            # ship it, and cool it in the pool until the async upload
+            # completes (recycling under an in-flight DMA would corrupt
+            # the resident scene)
+            from ..ops.raster import nodata_mask
+            view = sbuf[:H, :W]
+            if not np.isnan(nd):
+                valid = nodata_mask(view, nd)
+                valid &= np.isfinite(view)
+                view[~valid] = np.nan
+            dev = jax.device_put(sbuf)
+            spool.release(sbuf, dev)
+            _istats.record_whole(H * W * h.dtype.itemsize)
+            with self._lock:
+                self.staged_loads += 1
+            return DeviceScene(dev=dev, height=H, width=W,
+                               nodata=float("nan"), gt=gt, crs=crs)
+        _istats.record_whole(data.nbytes)
         true_h, true_w = data.shape
         # NaN-encode ONCE at load: invalid pixels (nodata / non-finite)
         # become NaN in an f32 scene, so every later dispatch's validity

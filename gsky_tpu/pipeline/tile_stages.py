@@ -180,10 +180,14 @@ def _dispatch_stage(dispatch, spans: Dict):
     device->host transfer is already in flight — the next request's
     dispatch overlaps this one's readback."""
     from .batcher import batching_enabled
+    from ..ingest import stats as ingest_stats
     check_cancel("dispatch")
     t0 = time.perf_counter()
     try:
-        with obs_span("tile.dispatch") as sp:
+        # mark the device-busy window: ranged reads running while ANY
+        # dispatch is in flight count as overlapped IO in the
+        # gsky_ingest_overlap_ratio gauge
+        with ingest_stats.dispatch_inflight(), obs_span("tile.dispatch") as sp:
             try:
                 from ..server.prewarm import compile_count
                 c0 = compile_count()

@@ -340,6 +340,24 @@ class OWSServer:
             doc["drill_cache_bytes"] = dc._bytes
         except Exception:
             pass
+        try:
+            from ..ingest import stats as ingest_stats
+            from ..ingest import ingest_enabled
+            from ..ingest.prefetch import _default as _planner
+            from ..ingest.staging import _default as _staging
+            from ..pipeline.scene_cache import default_scene_cache as _sc
+            doc["ingest"] = {
+                "enabled": ingest_enabled(),
+                **ingest_stats.snapshot(),
+                "window_routed": _sc.window_routed,
+                "staged_loads": _sc.staged_loads,
+            }
+            if _planner is not None:
+                doc["ingest"]["prefetch_planner"] = _planner.stats()
+            if _staging is not None:
+                doc["ingest"]["staging"] = _staging.stats()
+        except Exception:
+            pass
         if self.gateway is not None:
             doc["serving"] = self.gateway.stats()
         doc["drain"] = self.drain.stats()
@@ -699,6 +717,12 @@ class OWSServer:
         bbox, crs, size); incomplete requests fall through to _getmap
         for its usual validation errors."""
         key = meta = None
+        if p.layers and p.bbox is not None and p.crs is not None \
+                and p.width > 0 and p.height > 0:
+            # feed the admitted key to the prefetch planner: pan/zoom
+            # continuations predicted from this stream warm the scene
+            # cache ahead of the client's next tile (docs/INGEST.md)
+            self._note_prefetch(cfg, p)
         if self.gateway is not None and p.layers and p.bbox is not None \
                 and p.crs is not None and p.width > 0 and p.height > 0:
             lay, style = self._resolve_layer(cfg, p.layers[0], p.styles,
@@ -711,6 +735,107 @@ class OWSServer:
         return await self._serve_gated(
             request, "WMS", key, meta, collector,
             lambda: self._getmap(cfg, p, collector))
+
+    def _note_prefetch(self, cfg: Config, p) -> None:
+        """Feed one resolvable GetMap key to the prefetch planner,
+        registering the warm callback on first use.  Never raises and
+        never blocks: observation is bookkeeping, warming runs on the
+        planner's own worker thread."""
+        try:
+            from ..ingest import ingest_enabled
+            if not ingest_enabled():
+                return
+            from ..ingest.prefetch import default_planner
+            planner = default_planner()
+            if planner.warm_fn is None:
+                planner.warm_fn = self._prefetch_warm
+            b = p.bbox
+            # the whole times selection rides in the key (hashable
+            # tuple): a temporal-range GetMap must warm the same
+            # granule set the real request will mosaic
+            t = tuple(p.times) if getattr(p, "times", None) else None
+            planner.observe(
+                f"{cfg.service_config.namespace}\x1f{p.layers[0]}",
+                (b.xmin, b.ymin, b.xmax, b.ymax),
+                p.width, p.height, p.crs.name(), t)
+        except Exception:
+            pass
+
+    def _prefetch_warm(self, layer_key: str, qb, width: int, height: int,
+                       crs_s: str, time_s):
+        """Planner warm callback: resolve the predicted key exactly like
+        a real GetMap (same layer resolution, same tile request, same
+        index query), then warm the distinct scenes into the device
+        cache and their touched pages into the page pool.  Returns
+        approximate bytes warmed (the planner's budget currency)."""
+        import numpy as np
+        from ..geo.crs import parse_crs
+        from ..geo.transform import BBox
+        from ..pipeline.export import _scene_key
+        from ..resilience import check_cancel
+        ns, _, lname = layer_key.partition("\x1f")
+        cfg = self.watcher.get(ns)
+        if cfg is None:
+            return 0
+        lay, style = self._resolve_layer(cfg, lname, [], "wms")
+
+        class _P:
+            pass
+
+        p = _P()
+        p.bbox = BBox(*qb)
+        p.crs = parse_crs(crs_s)
+        if time_s is None:
+            p.times = []
+        elif isinstance(time_s, tuple):
+            p.times = list(time_s)
+        else:
+            p.times = [time_s]
+        p.axes = {}
+        p.axis_idx = {}
+        req = self._tile_request(cfg, lay, style, p, int(width),
+                                 int(height), lay.wms_polygon_segments)
+        pipe = self._pipeline(cfg)
+        granules = pipe.index(req)
+        dst_gt = req.dst_gt()
+        warmed = 0
+        seen = set()
+        for g in granules:
+            check_cancel("prefetch")
+            k = _scene_key(g)
+            if k in seen:
+                continue
+            seen.add(k)
+            s = pipe.executor.warm_scene(g, dst_gt, req.crs,
+                                         req.height, req.width)
+            if s is not None:
+                warmed += int(np.prod(s.bucket)) * 4
+                self._prewarm_pages(s, req)
+        return warmed
+
+    @staticmethod
+    def _prewarm_pages(s, req) -> None:
+        """Stage the pages this request footprint will gather through
+        (best-effort: pool declines are fine, the real request stages
+        as usual)."""
+        try:
+            from ..geo.transform import transform_bbox
+            from ..ops.paged import page_shape
+            from ..pipeline.decode import _pixel_window
+            from ..pipeline.pages import default_page_pool
+            src_bbox = transform_bbox(req.bbox, req.crs, s.crs)
+            win = _pixel_window(s.gt, src_bbox, s.width, s.height, 3)
+            if win is None:
+                return
+            c0, r0, w, h = win
+            pr, pc = page_shape()
+            i0, i1 = r0 // pr, (r0 + h - 1) // pr
+            j0, j1 = c0 // pc, (c0 + w - 1) // pc
+            if (i1 - i0 + 1) * (j1 - j0 + 1) > 64:
+                return      # a footprint that large isn't a tile pan
+            default_page_pool().prewarm(s.dev, s.serial, i0, i1, j0, j1)
+        except Exception:
+            pass
 
     async def _getmap(self, cfg: Config, p, collector):
         if not p.layers:

@@ -1,0 +1,784 @@
+"""Cloud-native ingest tests (docs/INGEST.md): byte sources + range
+coalescing, chunk maps, ranged-vs-whole byte identity (incl. granule
+edges), the handle-cache open latch, staging-pool reuse/upload safety,
+the prefetch planner's prediction + discipline, and the GSKY_INGEST=0
+escape-hatch parity contract."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gsky_tpu.geo.crs import EPSG4326, parse_crs
+from gsky_tpu.geo.transform import BBox, GeoTransform
+from gsky_tpu.ingest import stats as ingest_stats
+from gsky_tpu.ingest.source import (HTTPRangeSource, LocalFileSource,
+                                    coalesce_ranges, fetch_ranges,
+                                    reset_sources, source_for)
+from gsky_tpu.ingest.staging import StagingPool, reset_staging_pool
+from gsky_tpu.ingest.prefetch import PrefetchPlanner
+from gsky_tpu.io import GeoTIFF, write_geotiff
+from gsky_tpu.io.netcdf import NetCDF, write_netcdf3
+from gsky_tpu.pipeline.decode import decode_window, granule_footprint_frac
+from gsky_tpu.pipeline.types import Granule
+
+
+@pytest.fixture(autouse=True)
+def _clean_ingest_state():
+    ingest_stats.reset()
+    reset_sources()
+    reset_staging_pool()
+    yield
+    ingest_stats.reset()
+    reset_sources()
+    reset_staging_pool()
+
+
+def _tif_granule(path, data, gt=None, nodata=None, tile_size=None):
+    gt = gt or GeoTransform(100.0, 0.25, 0.0, -10.0, 0.0, -0.25)
+    kw = {}
+    if tile_size is not None:
+        kw["tile_size"] = tile_size
+    write_geotiff(path, data, gt, EPSG4326, nodata=nodata, **kw)
+    return Granule(
+        path=path, ds_name="d", namespace="v", base_namespace="v",
+        band=1, time_index=None, timestamp=0.0, srs="EPSG:4326",
+        geo_transform=gt.to_gdal(),
+        nodata=nodata if nodata is not None else float("nan"))
+
+
+# -- range coalescing ----------------------------------------------------
+
+class TestCoalesce:
+    def test_merges_within_gap(self):
+        groups = coalesce_ranges([(0, 10), (20, 10), (100, 5)], max_gap=16)
+        assert [(s, n) for s, n, _ in groups] == [(0, 30), (100, 5)]
+        assert groups[0][2] == [0, 1]
+        assert groups[1][2] == [2]
+
+    def test_no_merge_beyond_gap(self):
+        groups = coalesce_ranges([(0, 10), (50, 10)], max_gap=16)
+        assert len(groups) == 2
+
+    def test_unsorted_and_overlapping(self):
+        groups = coalesce_ranges([(30, 10), (0, 35)], max_gap=0)
+        assert [(s, n) for s, n, _ in groups] == [(0, 40)]
+        assert sorted(groups[0][2]) == [0, 1]
+
+    def test_negative_raises(self):
+        with pytest.raises(ValueError):
+            coalesce_ranges([(-1, 4)], max_gap=0)
+
+
+# -- byte sources --------------------------------------------------------
+
+class TestLocalFileSource:
+    def test_read_range(self, tmp_path):
+        p = tmp_path / "f.bin"
+        blob = bytes(range(256)) * 4
+        p.write_bytes(blob)
+        src = LocalFileSource(str(p))
+        try:
+            assert src.size() == len(blob)
+            assert src.read_range(10, 20) == blob[10:30]
+            assert src.read_range(0, len(blob)) == blob
+        finally:
+            src.close()
+
+    def test_out_of_bounds(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"abcdef")
+        src = LocalFileSource(str(p))
+        try:
+            with pytest.raises(ValueError):
+                src.read_range(4, 10)
+        finally:
+            src.close()
+
+    def test_threaded_reads(self, tmp_path):
+        p = tmp_path / "f.bin"
+        blob = os.urandom(1 << 16)
+        p.write_bytes(blob)
+        src = LocalFileSource(str(p))
+        errs = []
+
+        def rd():
+            try:
+                for i in range(50):
+                    off = (i * 997) % (len(blob) - 64)
+                    assert src.read_range(off, 64) == blob[off:off + 64]
+            except Exception as e:    # pragma: no cover
+                errs.append(e)
+        ts = [threading.Thread(target=rd) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        src.close()
+        assert not errs
+
+
+class _RangeHandler:
+    """Tiny HTTP handler speaking just enough Range for the client."""
+
+    def __new__(cls, blob, fail_first=0, no_ranges=False):
+        import http.server
+        state = {"fails": fail_first}
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_HEAD(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(blob)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                if state["fails"] > 0:
+                    state["fails"] -= 1
+                    self.send_response(503)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                rng = self.headers.get("Range")
+                if rng and not no_ranges:
+                    spec = rng.split("=", 1)[1]
+                    a, b = spec.split("-")
+                    a, b = int(a), min(int(b), len(blob) - 1)
+                    body = blob[a:b + 1]
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range",
+                        f"bytes {a}-{a + len(body) - 1}/{len(blob)}")
+                else:
+                    body = blob
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+        return H
+
+
+@pytest.fixture
+def http_blob():
+    import http.server
+    blob = os.urandom(1 << 14)
+    made = {}
+
+    def serve(fail_first=0, no_ranges=False):
+        srv = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), _RangeHandler(blob, fail_first, no_ranges))
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        made["srv"] = srv
+        return blob, f"http://127.0.0.1:{srv.server_address[1]}/f.bin"
+
+    yield serve
+    if "srv" in made:
+        made["srv"].shutdown()
+        made["srv"].server_close()
+
+
+class TestHTTPRangeSource:
+    def test_ranged_get(self, http_blob):
+        blob, url = http_blob()
+        src = HTTPRangeSource(url)
+        try:
+            assert src.read_range(100, 50) == blob[100:150]
+            assert src.size() == len(blob)
+            # second read reuses the pooled connection
+            assert src.read_range(0, 10) == blob[:10]
+        finally:
+            src.close()
+
+    def test_200_fallback_slices(self, http_blob):
+        blob, url = http_blob(no_ranges=True)
+        src = HTTPRangeSource(url)
+        try:
+            assert src.read_range(7, 21) == blob[7:28]
+        finally:
+            src.close()
+
+    def test_retries_5xx(self, http_blob):
+        blob, url = http_blob(fail_first=2)
+        src = HTTPRangeSource(url)
+        try:
+            assert src.read_range(5, 5) == blob[5:10]
+        finally:
+            src.close()
+
+    def test_source_kinds_gate(self, tmp_path, monkeypatch):
+        from gsky_tpu.ingest.source import open_source
+        monkeypatch.setenv("GSKY_INGEST_SOURCES", "http")
+        p = tmp_path / "x.bin"
+        p.write_bytes(b"1234")
+        assert open_source(str(p)) is None
+        monkeypatch.setenv("GSKY_INGEST_SOURCES", "local")
+        assert open_source("http://example.invalid/f") is None
+
+
+class TestFetchRanges:
+    def test_slices_back_and_records(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GSKY_RANGE_COALESCE_KB", "1")
+        p = tmp_path / "f.bin"
+        blob = os.urandom(8192)
+        p.write_bytes(blob)
+        src = LocalFileSource(str(p))
+        try:
+            ranges = [(0, 100), (200, 100), (4000, 50), (700, 10)]
+            out = fetch_ranges(src, ranges)
+            for (off, n), got in zip(ranges, out):
+                assert got == blob[off:off + n]
+            snap = ingest_stats.snapshot()
+            # (0,100)+(200,100)+(700,10) coalesce under the 1 KiB gap;
+            # (4000,50) stands alone
+            assert snap["ranged_reads"] == 2
+            assert snap["ranged_read_bytes"] >= 760
+        finally:
+            src.close()
+
+
+# -- chunk maps ----------------------------------------------------------
+
+class TestChunkMaps:
+    def test_tiled_tiff(self, tmp_path):
+        p = str(tmp_path / "t.tif")
+        data = np.arange(300 * 260, dtype=np.int16).reshape(300, 260)
+        gt = GeoTransform(0, 1, 0, 0, 0, -1)
+        write_geotiff(p, data, gt, EPSG4326, tile_size=128)
+        with GeoTIFF(p) as g:
+            cm = g.chunk_map()
+            assert cm.tiled
+            assert (cm.chunk_w, cm.chunk_h) == (128, 128)
+            assert (cm.chunks_x, cm.chunks_y) == (3, 3)
+            assert cm.nchunks == 9
+            # a window inside tile (0,0) touches exactly one chunk
+            assert len(cm.ranges_for((5, 5, 20, 20))) == 1
+            # straddling the 128-px boundary touches two
+            assert len(cm.ranges_for((120, 0, 16, 16))) == 2
+            # whole raster touches all nine
+            assert len(cm.ranges_for((0, 0, 260, 300))) == 9
+
+    def test_striped_tiff(self, tmp_path):
+        import io as _io
+        from PIL import Image
+        p = str(tmp_path / "s.tif")
+        data = (np.arange(90 * 40) % 251).astype(np.uint8).reshape(90, 40)
+        Image.fromarray(data).save(p, compression="tiff_adobe_deflate")
+        with GeoTIFF(p) as g:
+            cm = g.chunk_map()
+            assert not cm.tiled
+            assert cm.chunk_w == 40
+            assert cm.chunks_x == 1
+            assert cm.nchunks == cm.chunks_y
+            assert len(cm.ranges_for((0, 0, 40, 90))) == cm.nchunks
+
+    def test_nc3(self, tmp_path):
+        p = str(tmp_path / "a.nc")
+        data = np.ones((2, 12, 10), np.float32)
+        write_netcdf3(p, {"fc": data}, np.arange(10.0), np.arange(12.0),
+                      EPSG4326, times=np.array([0.0, 1.0]))
+        with NetCDF(p) as nc:
+            cm = nc.chunk_map("fc")
+            assert cm["kind"] == "nc3"
+            assert cm["shape"][-2:] == (12, 10)
+            assert cm["row_bytes"] == 10 * 4
+
+    def test_h5(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        p = str(tmp_path / "c.nc")
+        with h5py.File(p, "w") as f:
+            f.create_dataset("v", data=np.zeros((64, 64), np.float32),
+                             chunks=(16, 16))
+        with NetCDF(p) as nc:
+            cm = nc.chunk_map("v")
+            assert cm["kind"] == "hdf5"
+            assert tuple(cm["chunks"]) == (16, 16)
+            with pytest.raises(ValueError):
+                nc.read_slice_source("v", None, None, (0, 0, 4, 4))
+
+
+# -- ranged read byte identity -------------------------------------------
+
+class TestRangedIdentity:
+    @pytest.mark.parametrize("dtype,tile_size", [
+        (np.int16, 64), (np.float32, 64), (np.uint8, None)])
+    def test_tiff_windows(self, tmp_path, dtype, tile_size):
+        p = str(tmp_path / "t.tif")
+        rng = np.random.default_rng(3)
+        if np.issubdtype(dtype, np.integer):
+            data = rng.integers(0, 200, (150, 130)).astype(dtype)
+        else:
+            data = rng.normal(size=(150, 130)).astype(dtype)
+        kw = {"tile_size": tile_size} if tile_size else {}
+        write_geotiff(p, data, GeoTransform(0, 1, 0, 0, 0, -1),
+                      EPSG4326, **kw)
+        src = LocalFileSource(p)
+        with GeoTIFF(p) as g:
+            for win in [(0, 0, 130, 150), (5, 7, 40, 30),
+                        (60, 60, 70, 90), (129, 149, 1, 1)]:
+                a = g.read(1, win)
+                b = g.read(1, win, source=src)
+                np.testing.assert_array_equal(a, b)
+        src.close()
+
+    def test_tiff_overview_ifd(self, tmp_path):
+        p = str(tmp_path / "o.tif")
+        rng = np.random.default_rng(4)
+        data = rng.integers(0, 1000, (256, 256)).astype(np.int16)
+        write_geotiff(p, data, GeoTransform(0, 1, 0, 0, 0, -1), EPSG4326,
+                      tile_size=64, overviews=[2, 4])
+        src = LocalFileSource(p)
+        with GeoTIFF(p) as g:
+            if not g.overviews:
+                pytest.skip("writer built no overviews")
+            _, _, ovr = g.pick_overview(2.0)
+            a = g.read(1, (3, 3, 50, 40), ifd=ovr)
+            b = g.read(1, (3, 3, 50, 40), ifd=ovr, source=src)
+            np.testing.assert_array_equal(a, b)
+        src.close()
+
+    def test_out_buffer(self, tmp_path):
+        p = str(tmp_path / "t.tif")
+        data = np.arange(80 * 70, dtype=np.int16).reshape(80, 70)
+        write_geotiff(p, data, GeoTransform(0, 1, 0, 0, 0, -1), EPSG4326,
+                      tile_size=32)
+        with GeoTIFF(p) as g:
+            out = np.full((80, 70), np.nan, np.float32)
+            ret = g.read(1, (0, 0, 70, 80), out=out)
+            assert ret is out
+            np.testing.assert_array_equal(out, data.astype(np.float32))
+            with pytest.raises(ValueError):
+                g.read(1, (0, 0, 10, 10), out=np.zeros((4, 4), np.float32))
+
+    def test_nc3_hyperslabs(self, tmp_path):
+        p = str(tmp_path / "a.nc")
+        rng = np.random.default_rng(5)
+        data = rng.normal(size=(3, 40, 50)).astype(np.float32)
+        write_netcdf3(p, {"fc": data},
+                      np.linspace(100.0, 124.5, 50),
+                      np.linspace(-10.0, -29.5, 40), EPSG4326,
+                      times=np.array([0.0, 1.0, 2.0]))
+        src = LocalFileSource(p)
+        with NetCDF(p) as nc:
+            for t in (0, 2):
+                for win in [(0, 0, 50, 40), (10, 5, 20, 12),
+                            (49, 39, 1, 1)]:
+                    a = nc.read_slice("fc", t, win)
+                    b = nc.read_slice_source("fc", src, t, win)
+                    np.testing.assert_array_equal(a, b)
+            a = nc.read_slice("fc", 1, (0, 0, 48, 40), step=2)
+            b = nc.read_slice_source("fc", src, 1, (0, 0, 48, 40), step=2)
+            np.testing.assert_array_equal(a, b)
+        src.close()
+
+    def test_nc3_fixed_var(self, tmp_path):
+        p = str(tmp_path / "b.nc")
+        data = np.arange(30 * 20, dtype=np.int16).reshape(30, 20)
+        write_netcdf3(p, {"v": data}, np.arange(20.0), np.arange(30.0),
+                      EPSG4326)
+        src = LocalFileSource(p)
+        with NetCDF(p) as nc:
+            a = nc.read_slice("v", None, (3, 4, 10, 12))
+            b = nc.read_slice_source("v", src, None, (3, 4, 10, 12))
+            np.testing.assert_array_equal(a, b)
+        src.close()
+
+
+# -- decode_window parity + edge windows ---------------------------------
+
+class TestDecodeWindowParity:
+    def _decode_both(self, g, bbox, monkeypatch):
+        from gsky_tpu.pipeline import decode
+        monkeypatch.setenv("GSKY_INGEST", "0")
+        off = decode_window(g, bbox, EPSG4326)
+        # fresh handles so the ranged leg re-opens nothing stale
+        monkeypatch.setenv("GSKY_INGEST", "1")
+        on = decode_window(g, bbox, EPSG4326)
+        return off, on
+
+    def test_interior_and_edges(self, tmp_path, monkeypatch):
+        rng = np.random.default_rng(11)
+        data = rng.integers(-100, 3000, (200, 180)).astype(np.int16)
+        g = _tif_granule(str(tmp_path / "t.tif"), data, nodata=-1,
+                         tile_size=64)
+        # raster spans x [100, 145), y (-60, -10]
+        cases = {
+            "interior": BBox(110.0, -30.0, 112.0, -28.0),
+            "chunk_straddle": BBox(115.9, -26.1, 116.1, -25.9),
+            "partially_off_west": BBox(95.0, -30.0, 101.0, -25.0),
+            "partially_off_south": BBox(120.0, -65.0, 125.0, -58.0),
+            "fully_off": BBox(0.0, 0.0, 5.0, 5.0),
+        }
+        for name, bbox in cases.items():
+            off, on = self._decode_both(g, bbox, monkeypatch)
+            if off is None:
+                assert on is None, name
+                continue
+            assert on is not None, name
+            np.testing.assert_array_equal(off.data, on.data, err_msg=name)
+            np.testing.assert_array_equal(off.valid, on.valid,
+                                          err_msg=name)
+            assert off.window_gt.to_gdal() == on.window_gt.to_gdal()
+
+    def test_single_chunk_granule(self, tmp_path, monkeypatch):
+        data = np.arange(40 * 30, dtype=np.int16).reshape(40, 30)
+        g = _tif_granule(str(tmp_path / "one.tif"), data, tile_size=64)
+        bbox = BBox(100.5, -15.0, 103.0, -12.5)
+        off, on = self._decode_both(g, bbox, monkeypatch)
+        assert off is not None and on is not None
+        np.testing.assert_array_equal(off.data, on.data)
+        assert ingest_stats.snapshot()["ranged_windows"] >= 1
+
+    def test_netcdf_parity(self, tmp_path, monkeypatch):
+        rng = np.random.default_rng(12)
+        data = rng.normal(size=(2, 60, 80)).astype(np.float32)
+        p = str(tmp_path / "a.nc")
+        write_netcdf3(p, {"fc": data}, np.linspace(100.0, 139.5, 80),
+                      np.linspace(-10.0, -39.5, 60), EPSG4326,
+                      times=np.array([0.0, 1.0]), nodata=-999.0)
+        g = Granule(path=p, ds_name="d", namespace="fc",
+                    base_namespace="fc", band=1, time_index=1,
+                    timestamp=0.0, srs="EPSG:4326",
+                    geo_transform=[99.75, 0.5, 0, -9.75, 0, -0.5],
+                    nodata=-999.0, is_netcdf=True, var_name="fc")
+        bbox = BBox(105.0, -25.0, 115.0, -15.0)
+        off, on = self._decode_both(g, bbox, monkeypatch)
+        assert off is not None and on is not None
+        np.testing.assert_array_equal(off.data, on.data)
+        np.testing.assert_array_equal(off.valid, on.valid)
+
+    def test_footprint_frac(self, tmp_path):
+        data = np.zeros((100, 100), np.int16)
+        g = _tif_granule(str(tmp_path / "f.tif"), data)
+        # raster spans x [100, 125), y (-35, -10]
+        assert granule_footprint_frac(
+            g, BBox(0.0, 50.0, 1.0, 51.0), EPSG4326) == 0.0
+        full = granule_footprint_frac(
+            g, BBox(100.0, -35.0, 125.0, -10.0), EPSG4326)
+        assert full == 1.0
+        tiny = granule_footprint_frac(
+            g, BBox(110.0, -21.0, 111.0, -20.0), EPSG4326)
+        assert 0.0 < tiny < 0.02
+
+
+class TestHandleCacheLatch:
+    def test_single_open_under_contention(self, tmp_path, monkeypatch):
+        from gsky_tpu.io import registry
+        from gsky_tpu.pipeline.decode import _HandleCache
+        opens = []
+        lock = threading.Lock()
+
+        class SlowHandle:
+            def __init__(self, path):
+                with lock:
+                    opens.append(path)
+                time.sleep(0.05)
+                self.closed = False
+
+            def close(self):
+                self.closed = True
+
+        monkeypatch.setattr(registry, "open_raster",
+                            lambda p: SlowHandle(p))
+        hc = _HandleCache()
+        got = []
+
+        def get():
+            got.append(hc.get("/x/y.tif", False))
+        ts = [threading.Thread(target=get) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert len(opens) == 1            # the latch: ONE open, no losers
+        assert all(h is got[0] for h in got)
+        assert not got[0].closed
+
+    def test_failed_open_releases_latch(self, tmp_path, monkeypatch):
+        from gsky_tpu.io import registry
+        from gsky_tpu.pipeline.decode import _HandleCache
+        calls = {"n": 0}
+
+        class OkHandle:
+            def close(self):
+                pass
+
+        def flaky(path):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient")
+            return OkHandle()
+
+        monkeypatch.setattr(registry, "open_raster", flaky)
+        hc = _HandleCache()
+        with pytest.raises(OSError):
+            hc.get("/x/z.tif", False)
+        assert isinstance(hc.get("/x/z.tif", False), OkHandle)
+
+
+# -- staging pool --------------------------------------------------------
+
+class _FakeDev:
+    def __init__(self, ready=False):
+        self._ready = ready
+
+    def is_ready(self):
+        return self._ready
+
+    def devices(self):
+        return []
+
+
+class TestStagingPool:
+    def test_acquire_is_nan_filled_and_reused(self):
+        pool = StagingPool(max_mb=8)
+        a = pool.acquire(256, 256)
+        assert a.dtype == np.float32 and np.isnan(a).all()
+        a[:] = 1.0
+        pool.release(a)
+        b = pool.acquire(256, 256)
+        assert b is a or b.base is a     # recycled
+        assert np.isnan(b).all()         # re-prefilled
+        assert pool.stats()["reused"] == 1
+
+    def test_cooling_until_upload_ready(self):
+        pool = StagingPool(max_mb=8)
+        buf = pool.acquire(256, 256)
+        dev = _FakeDev(ready=False)
+        pool.release(buf, dev)
+        assert pool.stats()["cooling"] == 1
+        c = pool.acquire(256, 256)       # not recycled: upload in flight
+        assert c is not buf
+        dev._ready = True
+        d = pool.acquire(256, 256)       # drained into the free list
+        assert d is buf
+        pool.release(c)
+        pool.release(d)
+
+    def test_collected_dev_frees_buffer(self):
+        pool = StagingPool(max_mb=8)
+        buf = pool.acquire(128, 128)
+        pool.release(buf, _FakeDev(ready=False))
+        # the ref was weak and the dev is now collectable
+        import gc
+        gc.collect()
+        assert pool.acquire(128, 128) is buf
+
+    def test_over_budget_unpooled(self):
+        pool = StagingPool(max_mb=1)
+        a = pool.acquire(256, 1024)      # 1 MiB: fills the budget
+        b = pool.acquire(256, 1024)      # over budget -> unpooled
+        pool.release(b)
+        assert pool.stats()["unpooled"] == 1
+        assert pool.stats()["free"] == 0
+        pool.release(a)
+        assert pool.stats()["free"] == 1
+
+    def test_scene_cache_staged_load_parity(self, tmp_path, monkeypatch):
+        """A staged scene must be value-identical to the classic load
+        (same NaN-encode semantics), and its buffer must never recycle
+        while the upload can still see it."""
+        from gsky_tpu.pipeline.scene_cache import SceneCache
+        rng = np.random.default_rng(13)
+        data = rng.integers(-5, 5000, (150, 140)).astype(np.int16)
+        data[10:20, 30:40] = -1
+        g = _tif_granule(str(tmp_path / "s.tif"), data, nodata=-1,
+                         tile_size=64)
+        monkeypatch.setenv("GSKY_INGEST", "0")
+        classic = SceneCache().get(g)
+        monkeypatch.setenv("GSKY_INGEST", "1")
+        cache = SceneCache()
+        staged = cache.get(g)
+        assert classic is not None and staged is not None
+        assert cache.staged_loads == 1
+        np.testing.assert_array_equal(np.asarray(classic.dev),
+                                      np.asarray(staged.dev))
+        assert (classic.height, classic.width) == \
+            (staged.height, staged.width)
+
+
+# -- scene-cache window routing ------------------------------------------
+
+class TestWindowRouting:
+    def test_default_off(self, tmp_path):
+        from gsky_tpu.pipeline.scene_cache import SceneCache
+        g = _tif_granule(str(tmp_path / "r.tif"),
+                         np.zeros((400, 400), np.int16))
+        cache = SceneCache()
+        tiny = BBox(110.0, -21.0, 110.5, -20.5)
+        assert cache.get(g, dst_bbox=tiny, dst_crs=EPSG4326) is not None
+        assert cache.window_routed == 0
+
+    def test_declines_then_promotes(self, tmp_path, monkeypatch):
+        from gsky_tpu.pipeline.scene_cache import SceneCache
+        monkeypatch.setenv("GSKY_INGEST_WINDOW_FRAC", "0.1")
+        monkeypatch.setenv("GSKY_INGEST_WINDOW_PROMOTE", "3")
+        g = _tif_granule(str(tmp_path / "r.tif"),
+                         np.zeros((400, 400), np.int16))
+        cache = SceneCache()
+        tiny = BBox(110.0, -21.0, 110.5, -20.5)
+        assert cache.get(g, dst_bbox=tiny, dst_crs=EPSG4326) is None
+        assert cache.get(g, dst_bbox=tiny, dst_crs=EPSG4326) is None
+        assert cache.window_routed == 2
+        # third request of the same key proves the scene hot: promoted
+        s = cache.get(g, dst_bbox=tiny, dst_crs=EPSG4326)
+        assert s is not None
+        # resident now: later tiny requests serve from cache
+        assert cache.get(g, dst_bbox=tiny, dst_crs=EPSG4326) is s
+
+    def test_large_footprint_loads(self, tmp_path, monkeypatch):
+        from gsky_tpu.pipeline.scene_cache import SceneCache
+        monkeypatch.setenv("GSKY_INGEST_WINDOW_FRAC", "0.1")
+        g = _tif_granule(str(tmp_path / "r.tif"),
+                         np.zeros((400, 400), np.int16))
+        cache = SceneCache()
+        big = BBox(100.0, -60.0, 145.0, -10.0)
+        assert cache.get(g, dst_bbox=big, dst_crs=EPSG4326) is not None
+        assert cache.window_routed == 0
+
+    def test_no_hint_always_loads(self, tmp_path, monkeypatch):
+        from gsky_tpu.pipeline.scene_cache import SceneCache
+        monkeypatch.setenv("GSKY_INGEST_WINDOW_FRAC", "0.99")
+        g = _tif_granule(str(tmp_path / "r.tif"),
+                         np.zeros((100, 100), np.int16))
+        assert SceneCache().get(g) is not None
+
+
+# -- page pool prewarm ---------------------------------------------------
+
+def test_page_pool_prewarm(tmp_path):
+    from gsky_tpu.pipeline.pages import PagePool
+    import jax.numpy as jnp
+    pool = PagePool(capacity=8, page_rows=32, page_cols=32)
+    dev = jnp.zeros((64, 64), jnp.float32)
+    assert pool.prewarm(dev, serial=1, i0=0, i1=1, j0=0, j1=1)
+    st = pool.stats()
+    assert st["staged"] == 4
+    assert st["pinned"] == 0             # prewarm leaves nothing pinned
+    # the real request's table_for now hits every page
+    slots = pool.table_for(dev, 1, 0, 1, 0, 1)
+    assert slots is not None
+    assert pool.stats()["hits"] == 4
+    pool.unpin(slots)
+
+
+# -- prefetch planner ----------------------------------------------------
+
+class TestPrefetchPlanner:
+    def _mk(self, warm=None):
+        pl = PrefetchPlanner(warm_fn=warm or (lambda *a: 1024))
+        return pl
+
+    def _drain(self, pl, timeout=3.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            with pl._lock:
+                if not pl._pending:
+                    break
+            time.sleep(0.01)
+        time.sleep(0.05)                 # let the in-flight warm land
+
+    def test_pan_prediction_hits(self):
+        warmed = []
+
+        def warm(layer, qb, w, h, crs, t):
+            warmed.append(qb)
+            return 64
+
+        pl = self._mk(warm)
+        try:
+            # a client panning east by one tile width
+            for i in range(2):
+                pl.observe("l", (i * 1.0, 0.0, i * 1.0 + 1.0, 1.0),
+                           256, 256, "EPSG:4326")
+            self._drain(pl)
+            assert pl.stats()["warmed"] >= 1
+            assert (2.0, 0.0, 3.0, 1.0) in warmed
+            # the pan continues: the predicted tile is ready -> hit
+            pl.observe("l", (2.0, 0.0, 3.0, 1.0), 256, 256, "EPSG:4326")
+            assert ingest_stats.snapshot()["prefetch"]["hit"] == 1
+        finally:
+            pl.close()
+
+    def test_zoom_prediction(self):
+        preds = []
+        pl = self._mk(lambda l, qb, w, h, c, t: preds.append(qb) or 32)
+        try:
+            pl.observe("l", (0.0, 0.0, 8.0, 8.0), 256, 256, "c")
+            pl.observe("l", (2.0, 2.0, 6.0, 6.0), 256, 256, "c")
+            self._drain(pl)
+            assert (3.0, 3.0, 5.0, 5.0) in preds
+        finally:
+            pl.close()
+
+    def test_ttl_wasted(self, monkeypatch):
+        monkeypatch.setenv("GSKY_PREFETCH_TTL_S", "0.05")
+        pl = self._mk()
+        try:
+            pl.observe("l", (0.0, 0.0, 1.0, 1.0), 64, 64, "c")
+            pl.observe("l", (1.0, 0.0, 2.0, 1.0), 64, 64, "c")
+            self._drain(pl)
+            time.sleep(0.1)
+            pl.observe("x", (50.0, 0.0, 51.0, 1.0), 64, 64, "c")
+            assert ingest_stats.snapshot()["prefetch"]["wasted"] >= 1
+        finally:
+            pl.close()
+
+    def test_pressure_declines(self):
+        from gsky_tpu.resilience.pressure import default_monitor
+        default_monitor().force(1)
+        try:
+            pl = self._mk()
+            pl.observe("l", (0.0, 0.0, 1.0, 1.0), 64, 64, "c")
+            pl.observe("l", (1.0, 0.0, 2.0, 1.0), 64, 64, "c")
+            self._drain(pl)
+            assert pl.stats()["declined_pressure"] >= 1
+            assert pl.stats()["warmed"] == 0
+            pl.close()
+        finally:
+            default_monitor().force(None)
+            default_monitor().reset()
+
+    def test_budget_declines(self, monkeypatch):
+        monkeypatch.setenv("GSKY_PREFETCH_BUDGET_MB", "0")
+        pl = self._mk()
+        try:
+            pl.observe("l", (0.0, 0.0, 1.0, 1.0), 64, 64, "c")
+            pl.observe("l", (1.0, 0.0, 2.0, 1.0), 64, 64, "c")
+            self._drain(pl)
+            assert pl.stats()["declined_budget"] >= 1
+        finally:
+            pl.close()
+
+    def test_note_scan(self):
+        warmed = []
+        pl = self._mk(lambda l, qb, w, h, c, t: warmed.append(qb) or 8)
+        try:
+            boxes = [(float(i), 0.0, float(i + 1), 1.0) for i in range(4)]
+            pl.note_scan("l", boxes, 128, 128, "c")
+            self._drain(pl)
+            assert len(warmed) == 4
+            pl.observe("l", boxes[2], 128, 128, "c")
+            assert ingest_stats.snapshot()["prefetch"]["hit"] == 1
+        finally:
+            pl.close()
+
+    def test_close_cancels(self):
+        started = threading.Event()
+
+        def slow_warm(*a):
+            started.set()
+            from gsky_tpu.resilience import check_cancel
+            for _ in range(100):
+                time.sleep(0.02)
+                check_cancel("prefetch")
+            return 0
+
+        pl = self._mk(slow_warm)
+        pl.observe("l", (0.0, 0.0, 1.0, 1.0), 64, 64, "c")
+        pl.observe("l", (1.0, 0.0, 2.0, 1.0), 64, 64, "c")
+        assert started.wait(2.0)
+        t0 = time.monotonic()
+        pl.close()
+        assert time.monotonic() - t0 < 1.5   # cancelled, not joined-out

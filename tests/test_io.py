@@ -692,3 +692,73 @@ class TestCorruptFileRobustness:
             with pytest.raises(ValueError, match="declares"):
                 g._decode_block(0, 16, 1, 1, 1 << 20, 1 << 12, 1,
                                 np.dtype("<i2"))
+
+
+class TestRangedWindowEdges:
+    """Window math at granule edges, plain vs ranged-source reads
+    (docs/INGEST.md): both legs share decode/assembly, so any divergence
+    here is a chunk-map bug, not a codec bug."""
+
+    def _tif(self, tmp_path, shape=(150, 130), tile_size=64):
+        p = str(tmp_path / "edge.tif")
+        rng = np.random.default_rng(21)
+        data = rng.integers(-2000, 2000, shape).astype(np.int16)
+        gt = GeoTransform(0.0, 1.0, 0.0, 0.0, 0.0, -1.0)
+        write_geotiff(p, data, gt, EPSG4326, tile_size=tile_size)
+        return p, data
+
+    def test_window_clipped_to_last_partial_tile(self, tmp_path):
+        from gsky_tpu.ingest.source import LocalFileSource
+        p, data = self._tif(tmp_path)          # 150x130: ragged 64-px grid
+        src = LocalFileSource(p)
+        with GeoTIFF(p) as g:
+            # the bottom-right partial tile (rows 128.., cols 128..)
+            for win in [(128, 128, 2, 22), (120, 140, 10, 10),
+                        (0, 149, 130, 1), (129, 0, 1, 150)]:
+                a = g.read(1, win)
+                b = g.read(1, win, source=src)
+                np.testing.assert_array_equal(a, b)
+                c0, r0, w, h = win
+                np.testing.assert_array_equal(
+                    a, data[r0:r0 + h, c0:c0 + w])
+        src.close()
+
+    def test_chunk_boundary_straddle_touches_two_chunks(self, tmp_path):
+        from gsky_tpu.ingest.source import LocalFileSource
+        p, data = self._tif(tmp_path)
+        src = LocalFileSource(p)
+        with GeoTIFF(p) as g:
+            cm = g.chunk_map()
+            # 2x2 window straddling both tile axes at (64, 64)
+            assert len(cm.ranges_for((63, 63, 2, 2))) == 4
+            a = g.read(1, (63, 63, 2, 2), source=src)
+            np.testing.assert_array_equal(a, data[63:65, 63:65])
+        src.close()
+
+    def test_window_validation_unchanged_with_source(self, tmp_path):
+        from gsky_tpu.ingest.source import LocalFileSource
+        p, _ = self._tif(tmp_path)
+        src = LocalFileSource(p)
+        with GeoTIFF(p) as g:
+            with pytest.raises(ValueError):
+                g.read(1, (120, 0, 20, 10), source=src)  # past right edge
+            with pytest.raises(ValueError):
+                g.read(1, (-1, 0, 5, 5), source=src)
+        src.close()
+
+    def test_nc3_edge_rows(self, tmp_path):
+        from gsky_tpu.ingest.source import LocalFileSource
+        p = str(tmp_path / "edge.nc")
+        rng = np.random.default_rng(22)
+        data = rng.normal(size=(2, 33, 47)).astype(np.float32)
+        write_netcdf3(p, {"v": data}, np.arange(47.0), np.arange(33.0),
+                      EPSG4326, times=np.array([0.0, 1.0]))
+        src = LocalFileSource(p)
+        with NetCDF(p) as nc:
+            for win in [(46, 32, 1, 1), (0, 32, 47, 1), (46, 0, 1, 33)]:
+                a = nc.read_slice("v", 1, win)
+                b = nc.read_slice_source("v", src, 1, win)
+                np.testing.assert_array_equal(a, b)
+            with pytest.raises(ValueError):
+                nc.read_slice_source("v", src, 1, (40, 30, 10, 10))
+        src.close()

@@ -688,3 +688,42 @@ def test_overload_series_roundtrip_strict_parser():
         default_monitor().force(None)
         default_monitor().reset()
         reset_cancel_stats()
+
+
+def test_ingest_series_roundtrip_strict_parser():
+    """The ingest collector families (ranged-read volume, prefetch
+    outcomes, overlap ratio) must round-trip the strict parser with
+    live ledger data behind them."""
+    from gsky_tpu.ingest import stats as ingest_stats
+    from gsky_tpu.obs.metrics import render_metrics
+
+    ingest_stats.reset()
+    try:
+        ingest_stats.record_ranged(3, 4096, seconds=0.2)
+        with ingest_stats.dispatch_inflight():
+            ingest_stats.record_ranged(1, 1024, seconds=0.1)
+        ingest_stats.record_prefetch("hit", 2)
+        ingest_stats.record_prefetch("miss")
+        ingest_stats.record_prefetch("wasted", 3)
+        fams = parse_exposition(render_metrics())
+
+        assert fams["gsky_ranged_reads_total"]["type"] == "counter"
+        assert fams["gsky_ranged_reads_total"]["samples"][
+            ("gsky_ranged_reads_total", ())] == 4.0
+        assert fams["gsky_ranged_read_bytes_total"]["samples"][
+            ("gsky_ranged_read_bytes_total", ())] == 5120.0
+        pf = fams["gsky_prefetch_total"]
+        assert pf["type"] == "counter"
+        assert pf["samples"][
+            ("gsky_prefetch_total", (("outcome", "hit"),))] == 2.0
+        assert pf["samples"][
+            ("gsky_prefetch_total", (("outcome", "miss"),))] == 1.0
+        assert pf["samples"][
+            ("gsky_prefetch_total", (("outcome", "wasted"),))] == 3.0
+        ratio = fams["gsky_ingest_overlap_ratio"]
+        assert ratio["type"] == "gauge"
+        got = ratio["samples"][("gsky_ingest_overlap_ratio", ())]
+        # 0.1 of 0.3 read-seconds overlapped a dispatch
+        assert got == pytest.approx(0.1 / 0.3, rel=1e-4)
+    finally:
+        ingest_stats.reset()

@@ -95,6 +95,19 @@ adjustment, at least one cancellation released capacity, and /metrics
 exposes the overload families through the strict parser.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario overload --seconds 20
+
+``--scenario ingest``: cloud-native ingest (docs/INGEST.md).  A
+deterministic pan+zoom walk — two west-east tile rows stepped one tile
+at a time, then two zoom-in halvings — replayed against three fresh
+servers: a baseline with ingest off (``GSKY_INGEST=0``, whole-scene
+decode), a ranged leg with window routing on (chunk-granular reads,
+prefetch off) and a prefetch leg (planner on, residency warming).
+Passes only when every response across all legs is a 200 PNG (zero
+bare 5xx), the ranged leg reads strictly fewer bytes than the
+baseline, the planner's hit rate on the walk is >= 50%, and /metrics
+exposes the ingest families through the strict parser.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario ingest --seconds 20
 """
 
 from __future__ import annotations
@@ -161,7 +174,7 @@ def main(argv=None):
     ap.add_argument("--max-rss-growth-mb", type=float, default=256.0)
     ap.add_argument("--scenario",
                     choices=("churn", "hot", "wcs", "chaos", "burst",
-                             "fleet", "overload"),
+                             "fleet", "overload", "ingest"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -286,6 +299,8 @@ def main(argv=None):
         return run_fleet(args, watcher, mas_client, merc, boot)
     if args.scenario == "overload":
         return run_overload(args, watcher, mas_client, merc, boot)
+    if args.scenario == "ingest":
+        return run_ingest(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -1483,6 +1498,170 @@ def run_wcs(args, watcher, mas_client, merc, boot) -> int:
           and ep.get("decode_s", 0) > 0
           and ep.get("warp_s", 0) > 0
           and ep.get("encode_s", 0) > 0)
+    print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+    return 0 if ok else 1
+
+
+def run_ingest(args, watcher, mas_client, merc, boot) -> int:
+    """Cloud-native ingest: pan+zoom walk x three legs (docs/INGEST.md).
+
+    The walk is deterministic so the planner's hit rate is a property
+    of the predictor, not the load generator: two west-east rows
+    stepped exactly one tile extent per request (the pan-continuation
+    rule must fire), then two in-place halvings of the final tile (the
+    zoom-in rule must fire on the second).  Each leg gets a FRESH
+    server (fresh scene caches) and a reset ingest ledger, so the byte
+    counters compare decode work, not cache luck."""
+    from gsky_tpu.ingest import (reset_sources, reset_staging_pool,
+                                 stats as ingest_stats)
+    from gsky_tpu.ingest.prefetch import (default_planner,
+                                          reset_default_planner)
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    # finer-than-bench tiles (1/16 of the extent): a pan step touches a
+    # few 256px chunks of each scene, so the ranged leg's byte count is
+    # the sparse-access story the whole-file baseline can't tell
+    grid = 16
+    tw, th = merc.width / grid, merc.height / grid
+    j = grid // 2
+    boxes = []
+    for i in range(4, 12):                 # pan: one row, one visit/tile
+        x0, y0 = merc.xmin + i * tw, merc.ymin + j * th
+        boxes.append((x0, y0, x0 + tw, y0 + th))
+    x0, y0, x1, y1 = boxes[-1]
+    for _ in range(2):                     # zoom: halve in place twice
+        cx, cy = (x0 + x1) / 2, (y0 + y1) / 2
+        w, h = (x1 - x0) / 2, (y1 - y0) / 2
+        x0, y0 = cx - w / 2, cy - h / 2
+        x1, y1 = cx + w / 2, cy + h / 2
+        boxes.append((x0, y0, x1, y1))
+    # pacing: three legs must fit --seconds, but each step needs enough
+    # air for the background warm to land before the next observation
+    pause = min(0.35, max(0.1, args.seconds / (3.0 * len(boxes) * 2.0)))
+
+    _KEYS = ("GSKY_INGEST", "GSKY_PREFETCH", "GSKY_INGEST_WINDOW_FRAC",
+             "GSKY_INGEST_WINDOW_PROMOTE")
+
+    def leg(env, prefetch_on=False, scrape_ingest=False):
+        from gsky_tpu.pipeline.scene_cache import default_scene_cache
+        saved = {k: os.environ.get(k) for k in _KEYS}
+        os.environ.update(env)
+        try:
+            ingest_stats.reset()
+            reset_sources()
+            reset_staging_pool()
+            reset_default_planner()
+            # the scene cache is a process-wide singleton: drop leg N-1's
+            # residency or leg N measures cache luck, not decode bytes
+            default_scene_cache.clear()
+            server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                               metrics=MetricsLogger(), gateway=None)
+            host = boot(server)
+
+            def url_of(bb):
+                # temporal-range mosaic: the walk touches EVERY scene,
+                # so the whole-file baseline pays full residency for
+                # each while the ranged leg reads only touched chunks
+                return (f"http://{host}/ows?service=WMS&request=GetMap"
+                        f"&version=1.3.0&layers=landsat&crs=EPSG:3857"
+                        f"&bbox={bb[0]},{bb[1]},{bb[2]},{bb[3]}"
+                        f"&width=256&height=256&format=image/png"
+                        f"&time=2020-01-09T00:00:00.000Z,"
+                        f"2020-01-15T00:00:00.000Z")
+
+            if prefetch_on:
+                # priming lap: make the scenes resident before the timed
+                # walk so background warms race the client's NEXT tile,
+                # not a multi-scene cold decode
+                try:
+                    urllib.request.urlopen(url_of(boxes[0]),
+                                           timeout=120).read()
+                except Exception:
+                    pass
+                time.sleep(min(1.0, pause * 4))
+            statuses = []
+            lats = []
+            for bb in boxes:
+                url = url_of(bb)
+                t0 = time.time()
+                try:
+                    with urllib.request.urlopen(url, timeout=120) as r:
+                        ok = (r.status == 200 and
+                              r.read()[:8] == b"\x89PNG\r\n\x1a\n")
+                        statuses.append(r.status if ok else -r.status)
+                except urllib.error.HTTPError as e:
+                    statuses.append(-e.code)
+                except Exception:
+                    statuses.append(0)
+                lats.append(time.time() - t0)
+                time.sleep(pause)
+            snap = ingest_stats.snapshot()
+            require = ["gsky_requests_total", "gsky_request_seconds"]
+            if scrape_ingest:
+                require += ["gsky_ranged_reads_total",
+                            "gsky_ranged_read_bytes_total",
+                            "gsky_prefetch_total",
+                            "gsky_ingest_overlap_ratio"]
+            metrics = check_metrics(host, require=tuple(require))
+            out = {
+                "requests": len(statuses),
+                "failed": sum(1 for s in statuses if s != 200),
+                "bare_5xx": sum(1 for s in statuses if -600 < s <= -500),
+                "p50_ms": round(sorted(lats)[len(lats) // 2] * 1e3, 1),
+                "bytes_read": int(snap["ranged_read_bytes"]
+                                  + snap["whole_read_bytes"]),
+                "ranged_windows": snap["ranged_windows"],
+                "fallbacks": snap["fallbacks"],
+                "metrics": metrics,
+            }
+            if prefetch_on:
+                ps = default_planner().stats()
+                hits, misses = ps["hit"], ps["miss"]
+                ps["hit_rate"] = round(hits / max(hits + misses, 1), 3)
+                out["planner"] = ps
+            return out
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            reset_default_planner()
+            ingest_stats.reset()
+            reset_sources()
+            reset_staging_pool()
+
+    base = leg({"GSKY_INGEST": "0", "GSKY_PREFETCH": "0",
+                "GSKY_INGEST_WINDOW_FRAC": "0",
+                "GSKY_INGEST_WINDOW_PROMOTE": "0"})
+    ranged = leg({"GSKY_INGEST": "1", "GSKY_PREFETCH": "0",
+                  "GSKY_INGEST_WINDOW_FRAC": "0.5",
+                  "GSKY_INGEST_WINDOW_PROMOTE": "0"})
+    prefetch = leg({"GSKY_INGEST": "1", "GSKY_PREFETCH": "1",
+                    "GSKY_INGEST_WINDOW_FRAC": "0",
+                    "GSKY_INGEST_WINDOW_PROMOTE": "0"},
+                   prefetch_on=True, scrape_ingest=True)
+
+    reduction = (round(1.0 - ranged["bytes_read"]
+                       / max(base["bytes_read"], 1), 3)
+                 if base["bytes_read"] else None)
+    out = {
+        "scenario": "ingest", "walk": len(boxes), "pause_s": pause,
+        "baseline": base, "ranged": ranged, "prefetch": prefetch,
+        "bytes_reduction": reduction,
+    }
+    print(json.dumps(out))
+    ok = (base["failed"] == 0 and ranged["failed"] == 0
+          and prefetch["failed"] == 0
+          and base["bare_5xx"] == 0 and ranged["bare_5xx"] == 0
+          and prefetch["bare_5xx"] == 0
+          and ranged["ranged_windows"] > 0
+          and ranged["bytes_read"] < base["bytes_read"]
+          and prefetch["planner"]["hit_rate"] >= 0.5
+          and not base["metrics"]["missing"]
+          and not ranged["metrics"]["missing"]
+          and not prefetch["metrics"]["missing"])
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
 
