@@ -1,0 +1,194 @@
+"""Page-residency journal: the durable half of warm pool recovery.
+
+The HBM page pool (pipeline/pages.py) is the state every serving-loop
+optimisation leans on — and a device incident throws all of it away.
+This journal records *which pages were resident and how hot they were*
+so a rebuilt pool can re-stage its working set from scenes still in the
+host-side scene cache instead of cold-starting into a miss storm.
+
+The format deliberately mirrors the kernel race ledger
+(ops/kernel_ledger.py): one JSONL file (``GSKY_POOL_JOURNAL``, default
+under the metrics log dir when the server configures one, else the
+system tmp dir), records appended atomically (O_APPEND, one line per
+event, kept under PIPE_BUF), corrupt or newer-schema lines skipped on
+replay, delete the file to forget everything.
+
+Event schema (one JSON object per line)::
+
+    {"v": 1, "op": "stage", "serial": 12, "pi": 0, "pj": 3,
+     "ts": 1754000000.0, "pid": 42}
+    {"v": 1, "op": "heat",  "serial": 12, "pi": 0, "pj": 3, "hits": 17, ...}
+    {"v": 1, "op": "drop",  "serial": 12, ...}
+
+``stage`` is appended when a page is first staged (cold path only, so
+the write rate tracks decode churn, not the hit rate).  ``heat`` lines
+are dumped by ``PagePool.teardown()`` — the supervisor tears the pool
+down with the host process alive, so the exact pre-incident hot set
+with in-memory hit counts is available and journaled.  ``drop`` voids
+all earlier events for a scene serial (scene-cache eviction: those
+pages can no longer be re-staged).
+
+``replay()`` merges the log into a hottest-first page list; staleness
+(a serial no longer resident in the scene cache) is the *caller's*
+check — replay only orders.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_ENV = "GSKY_POOL_JOURNAL"
+_DEFAULT_NAME = "gsky_pool_journal.jsonl"
+
+SCHEMA_VERSION = 1
+
+_OPS = ("stage", "heat", "drop")
+
+_lock = threading.Lock()
+# set by the server from its metrics -log_dir; env always wins
+_default_dir: Optional[str] = None
+
+
+def set_default_dir(path: str) -> None:
+    """Point the default journal location at the metrics log dir
+    (called by server startup; GSKY_POOL_JOURNAL still overrides)."""
+    global _default_dir
+    _default_dir = path or None
+
+
+def journal_enabled() -> bool:
+    """``GSKY_POOL_JOURNAL=0`` disables journaling (and therefore warm
+    recovery) without touching the rest of the device guard."""
+    return os.environ.get(_ENV, "") != "0"
+
+
+def journal_path() -> str:
+    p = os.environ.get(_ENV)
+    if p and p != "0":
+        return p
+    if _default_dir:
+        return os.path.join(_default_dir, _DEFAULT_NAME)
+    return os.path.join(tempfile.gettempdir(), _DEFAULT_NAME)
+
+
+def _append(doc: Dict) -> None:
+    """Append one event atomically.  Never raises — the journal is an
+    optimisation; a lost line only costs one page of warmth."""
+    try:
+        doc = {"v": SCHEMA_VERSION, **doc,
+               "ts": round(time.time(), 3), "pid": os.getpid()}
+        data = (json.dumps(doc, separators=(",", ":")) + "\n").encode()
+        if len(data) > 4096:    # PIPE_BUF floor: stay atomic or stay out
+            return
+        path = journal_path()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with _lock:
+            fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                         0o644)
+            try:
+                os.write(fd, data)
+            finally:
+                os.close(fd)
+    except Exception:   # noqa: BLE001 - never fail staging over IO
+        pass
+
+
+def record_stage(serial: int, pi: int, pj: int) -> None:
+    if journal_enabled():
+        _append({"op": "stage", "serial": int(serial),
+                 "pi": int(pi), "pj": int(pj)})
+
+
+def record_heat(serial: int, pi: int, pj: int, hits: int) -> None:
+    if journal_enabled():
+        _append({"op": "heat", "serial": int(serial),
+                 "pi": int(pi), "pj": int(pj), "hits": int(hits)})
+
+
+def record_drop(serial: int) -> None:
+    if journal_enabled():
+        _append({"op": "drop", "serial": int(serial)})
+
+
+def replay() -> List[Tuple[int, int, int]]:
+    """Merge the journal into a hottest-first ``[(serial, pi, pj)]``.
+
+    Priority is (accumulated heat + stage count, recency): a page the
+    pool dumped with 17 hits outranks a page staged once and never
+    shared.  Corrupt lines, unknown ops, newer-schema lines, and events
+    voided by a later ``drop`` are all skipped — a torn write or a
+    stale file must never poison a rebuild.
+    """
+    if not journal_enabled():
+        return []
+    score: Dict[Tuple[int, int, int], float] = {}
+    last: Dict[Tuple[int, int, int], int] = {}
+    try:
+        with open(journal_path(), "r", encoding="utf-8",
+                  errors="replace") as fp:
+            for idx, line in enumerate(fp):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(doc, dict):
+                    continue
+                v = doc.get("v", 1)
+                if not isinstance(v, int) or v > SCHEMA_VERSION:
+                    continue
+                op = doc.get("op")
+                if op not in _OPS:
+                    continue
+                try:
+                    serial = int(doc["serial"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if op == "drop":
+                    for k in [k for k in score if k[0] == serial]:
+                        score.pop(k, None)
+                        last.pop(k, None)
+                    continue
+                try:
+                    key = (serial, int(doc["pi"]), int(doc["pj"]))
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if key[1] < 0 or key[2] < 0:
+                    continue
+                w = 1.0
+                if op == "heat":
+                    try:
+                        w += max(0, int(doc.get("hits", 0)))
+                    except (TypeError, ValueError):
+                        pass
+                score[key] = score.get(key, 0.0) + w
+                last[key] = idx
+    except OSError:
+        return []
+    return sorted(score, key=lambda k: (-score[k], -last[k]))
+
+
+def clear() -> None:
+    """Forget the recorded residency (test hook / operator reset) —
+    the delete-the-file knob, same as the kernel ledger."""
+    try:
+        os.remove(journal_path())
+    except OSError:
+        pass
+
+
+def stats() -> Dict:
+    path = journal_path()
+    doc: Dict = {"path": path, "enabled": journal_enabled(),
+                 "present": os.path.exists(path)}
+    doc["entries"] = len(replay())
+    return doc

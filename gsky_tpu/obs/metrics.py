@@ -353,9 +353,42 @@ def _collect_ingest():
     return out
 
 
+def _collect_device():
+    """Device-guard surfaces (docs/RESILIENCE.md "Device failures"):
+    the supervisor's state machine position, incident counters, and the
+    warm-recovery (journal rehydration) volume."""
+    out: List = []
+    try:
+        from ..device_guard import default_supervisor
+        st = default_supervisor().stats()
+        out.append(_g("gsky_device_state",
+                      "Device supervisor state (0 healthy, 1 suspect, "
+                      "2 reinitializing, 3 dead).",
+                      [({}, float(st.get("state_code", 0)))]))
+        out.append(_c("gsky_device_reinits_total",
+                      "Device teardown+rebuild cycles.",
+                      [({}, float(st.get("reinits", 0)))]))
+        out.append(_c("gsky_device_hangs_total",
+                      "Dispatches abandoned by the hang watchdog.",
+                      [({}, float(st.get("hangs", 0)))]))
+        out.append(_c("gsky_device_incidents_total",
+                      "Device incidents by kind.",
+                      [({"kind": "crash"}, float(st.get("crashes", 0))),
+                       ({"kind": "oom"}, float(st.get("ooms", 0))),
+                       ({"kind": "corrupt"},
+                        float(st.get("corruptions", 0)))]))
+        out.append(_c("gsky_pool_rehydrated_pages_total",
+                      "Hot pages re-staged into a rebuilt page pool "
+                      "from the residency journal.",
+                      [({}, float(st.get("rehydrated_pages", 0)))]))
+    except Exception:
+        pass
+    return out
+
+
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
             _collect_runtime, _collect_batcher, _collect_overload,
-            _collect_ingest):
+            _collect_ingest, _collect_device):
     _REG.register_collector(_fn)
 
 

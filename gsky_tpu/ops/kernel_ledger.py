@@ -93,15 +93,21 @@ def ledger_path() -> str:
 
 def record(kernel: str, token, verdict: str,
            t_pallas_ms: Optional[float] = None,
-           t_xla_ms: Optional[float] = None) -> None:
+           t_xla_ms: Optional[float] = None,
+           reason: Optional[str] = None) -> None:
     """Append one verdict atomically.  Never raises — durability is an
-    optimisation; losing a record only costs one future re-race."""
+    optimisation; losing a record only costs one future re-race.
+    ``reason`` distinguishes a ``failed`` written because the compile
+    RAISED ("compile") from other failure shapes; loaders that don't
+    know the field ignore it."""
     if verdict not in VERDICTS:
         return
     try:
         doc = {"v": SCHEMA_VERSION, "kernel": str(kernel),
                "token": repr(token), "verdict": verdict,
                "ts": round(time.time(), 3), "pid": os.getpid()}
+        if reason is not None:
+            doc["reason"] = str(reason)
         if t_pallas_ms is not None:
             doc["t_pallas_ms"] = round(float(t_pallas_ms), 3)
         if t_xla_ms is not None:
@@ -180,6 +186,7 @@ def stats() -> Dict:
         k[rec["verdict"]] += 1
         k["entries"].append({
             "token": tok, "verdict": rec["verdict"],
+            "reason": rec.get("reason"),
             "t_pallas_ms": rec.get("t_pallas_ms"),
             "t_xla_ms": rec.get("t_xla_ms"), "ts": rec.get("ts")})
     try:

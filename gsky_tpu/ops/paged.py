@@ -322,6 +322,18 @@ def render_byte_paged(pool, tables, params, ctrls, sps,
                                          colour_scale))(canv, best, sps)
 
 
+@jax.jit
+def pool_inf_counts(pool):
+    """Per-slot ±inf population of the page pool: (capacity,) int32.
+
+    One on-device reduction + a capacity-sized readback — the cheap
+    first pass of the pool integrity audit (pipeline/pages.py).  NaN is
+    the legal validity encoding and saturates off-scene padding; inf is
+    written by nothing in the staging path, so a nonzero count convicts
+    the slot without reading its 256 KiB back."""
+    return jnp.isinf(pool).sum(axis=(1, 2)).astype(jnp.int32)
+
+
 def _paged_token(pool, tables, method, n_ns, out_hw, step, extra=()):
     """Versioned race token: leads with PAGED_TOKEN_VERSION so ledger
     replay can skip verdicts from other token schemes

@@ -129,14 +129,30 @@ def _timed_best(thunk, n=2):
     return r, best
 
 
-def _ledger_record(name, token, verdict, tp_ms=None, tx_ms=None):
+def _ledger_record(name, token, verdict, tp_ms=None, tx_ms=None,
+                   reason=None):
     """Durable verdict append — guarded: the ledger is an optimisation
     and must never fail a dispatch."""
     try:
         from . import kernel_ledger
-        kernel_ledger.record(name, token, verdict, tp_ms, tx_ms)
+        kernel_ledger.record(name, token, verdict, tp_ms, tx_ms,
+                             reason=reason)
     except Exception:  # noqa: BLE001
         pass
+
+
+def _device_incident(e) -> bool:
+    """True when an exception out of a pallas thunk convicts the DEVICE
+    (OOM / runtime crash / hang), not the kernel.  Such failures must
+    re-raise into the device guard instead of blacklisting the kernel:
+    a ledger ``failed`` verdict written during a device incident would
+    quarantine a perfectly good kernel until an operator deletes the
+    file."""
+    try:
+        from ..device_guard import classify
+        return classify(e) is not None
+    except Exception:  # noqa: BLE001
+        return False
 
 
 def reload_ledger() -> int:
@@ -209,11 +225,17 @@ def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
     if pallas_interpret():
         # parity mode: always run the pallas kernel, materialised so a
         # kernel bug surfaces here (and falls back) instead of
-        # downstream; no race, no ledger writes
+        # downstream; no race and no TIMING ledger writes (interpreter
+        # timings are meaningless) — but a kernel whose compile/lowering
+        # RAISES is quarantined durably, exactly as in race mode: the
+        # verdict is timing-independent and must survive a restart
         try:
             return jax.block_until_ready(pallas_thunk())
         except Exception as e:  # noqa: BLE001
+            if _device_incident(e):
+                raise       # the device guard owns this, not the kernel
             _FAILED.add(name)
+            _ledger_record(name, sync_token, "failed", reason="compile")
             import warnings
             warnings.warn(
                 f"pallas kernel {name!r} failed (interpret); using XLA "
@@ -267,8 +289,11 @@ def run_with_fallback(name, pallas_thunk, xla_thunk, sync_token=None):
             _proven_put(name, sync_token, cnt + 1)
         return r
     except Exception as e:  # noqa: BLE001 - any compile/runtime failure
+        if _device_incident(e):
+            raise           # device incident: classify + recover above,
+            # and never let it masquerade as a kernel compile failure
         _FAILED.add(name)
-        _ledger_record(name, sync_token, "failed")
+        _ledger_record(name, sync_token, "failed", reason="compile")
         import warnings
         warnings.warn(
             f"pallas kernel {name!r} failed; using XLA fallback: "

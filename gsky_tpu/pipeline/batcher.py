@@ -117,6 +117,15 @@ class RenderBatcher:
                     _KNEE_RATIO * min(smaller):
                 self.knee = min(self.knee, max(1, np_size // 2))
 
+    def note_oom(self) -> None:
+        """Device-guard OOM relief hook (device_guard.register_oom_hook):
+        halve the coalesce knee so the post-relief retry — and every
+        later wave — dispatches smaller batches.  Like the latency
+        ratchet this only moves down: a device that has proven it can
+        exhaust HBM at a batch size should not be offered it again."""
+        with self._lock:
+            self.knee = max(1, self.knee // 2)
+
     def stats(self) -> Dict:
         """/debug `gather_window` payload: where the knee sits, the
         evidence (per padded-size per-tile EMA ms) behind it, batch

@@ -228,6 +228,10 @@ class WarpExecutor:
         self.paged_declined = 0
         from .batcher import RenderBatcher
         self._batcher = RenderBatcher()
+        # a device RESOURCE_EXHAUSTED shrinks the coalesce knee before
+        # the guard's one-shot retry (docs/RESILIENCE.md)
+        from ..device_guard import register_oom_hook
+        register_oom_hook(self._batcher.note_oom)
 
     def _note_win(self, win) -> None:
         """Engagement telemetry, recorded at the dispatches that
@@ -549,24 +553,33 @@ class WarpExecutor:
                             win=win, win0=_dev_win0(win0))
                         return c[None], b[None]
 
-                    try:
+                    from .. import device_guard
+
+                    def _dispatch():
                         with pool.locked_pool() as parr:
-                            canvs, bests = warp_scored_paged_raced(
+                            return warp_scored_paged_raced(
                                 parr, jnp.asarray(tables[None]),
                                 jnp.asarray(params16), ctrl_dev[None],
                                 method, n_pad, (height, width), step,
                                 _xla)
+
+                    try:
+                        canvs, bests = device_guard.run(
+                            "dispatch.paged", _dispatch)
                     finally:
                         pool.unpin(tables)
                     return canvs[0], bests[0] > -jnp.inf
                 self._note_paged(False)
             self._count("scene_mosaic", (stack.shape, win))
             self._note_win(win)
-            canv, best = warp_scored_raced(stack, ctrl_dev,
-                                           jnp.asarray(params), method,
-                                           n_pad, (height, width), step,
-                                           win=win,
-                                           win0_dev=_dev_win0(win0))
+            from .. import device_guard
+            canv, best = device_guard.run(
+                "dispatch.bucketed",
+                lambda: warp_scored_raced(stack, ctrl_dev,
+                                          jnp.asarray(params), method,
+                                          n_pad, (height, width), step,
+                                          win=win,
+                                          win0_dev=_dev_win0(win0)))
             return canv, best > -jnp.inf
         # multi-CRS granule set (e.g. scenes across UTM zones): one
         # scored dispatch per source-CRS group, then a per-pixel
@@ -638,12 +651,17 @@ class WarpExecutor:
                         jnp.asarray(sp), *statics, win=win,
                         win0=_dev_win0(win0))[None]
 
-                try:
+                from .. import device_guard
+
+                def _dispatch():
                     with pool.locked_pool() as parr:
-                        out = render_byte_paged_raced(
+                        return render_byte_paged_raced(
                             parr, jnp.asarray(tables[None]),
                             jnp.asarray(params16), ctrl_dev[None],
                             jnp.asarray(sp[None]), *statics, _xla)
+
+                try:
+                    out = device_guard.run("dispatch.paged", _dispatch)
                 finally:
                     pool.unpin(tables)
                 return _prefetch(out[0])
@@ -660,9 +678,13 @@ class WarpExecutor:
                                         statics, win_raw=win_raw)
         self._count("render_byte", (stack.shape, win))
         self._note_win(win)
-        out = render_byte_raced(stack, ctrl_dev, jnp.asarray(params),
-                                jnp.asarray(sp), *statics, win=win,
-                                win0_dev=_dev_win0(win0))
+        from .. import device_guard
+        out = device_guard.run(
+            "dispatch.bucketed",
+            lambda: render_byte_raced(stack, ctrl_dev,
+                                      jnp.asarray(params),
+                                      jnp.asarray(sp), *statics,
+                                      win=win, win0_dev=_dev_win0(win0)))
         return _prefetch(out)
 
     def render_bands_byte(self, granules, ns_ids: Sequence[int],

@@ -42,6 +42,7 @@ import functools
 import os
 import threading
 import warnings
+import zlib
 from collections import OrderedDict
 from typing import Dict
 
@@ -101,11 +102,21 @@ class PagePool:
         self._slots = OrderedDict()  # (serial, pi, pj) -> slot, LRU
         self._free = list(range(self.capacity - 1, 0, -1))
         self._pins: Dict[int, int] = {}   # slot -> pin count
+        self._heat: Dict[tuple, int] = {}  # key -> hits since staged
+        # stage-time page CRCs, kept only under GSKY_POOL_AUDIT=1
+        self._checksums: Dict[tuple, int] = {}
+        # audited-poisoned slots still pinned by an in-flight dispatch:
+        # unpin() returns them to the free list once the pin drops
+        self._quarantine_pins: set = set()
         # stats (under lock)
         self.staged = 0
         self.hits = 0
         self.evictions = 0
         self.declined = 0
+        self.teardowns = 0
+        self.trimmed = 0
+        self.rehydrated = 0
+        self.quarantined = 0
 
     # -- internals (hold self.lock) -----------------------------------
 
@@ -125,6 +136,8 @@ class PagePool:
             if self._pins.get(slot):
                 continue
             del self._slots[key]
+            self._heat.pop(key, None)
+            self._checksums.pop(key, None)
             self.evictions += 1
             return slot
         return None                 # everything pinned: caller declines
@@ -135,6 +148,7 @@ class PagePool:
         if slot is not None:
             self._slots.move_to_end(key)
             self.hits += 1
+            self._heat[key] = self._heat.get(key, 0) + 1
             return slot
         slot = self._take_slot()
         if slot is None:
@@ -149,6 +163,18 @@ class PagePool:
                                 jnp.int32(slot))
         self._slots[key] = slot
         self.staged += 1
+        from ..device_guard import (guard_enabled, journal,
+                                    pool_audit_enabled)
+        if guard_enabled():
+            # warm-recovery breadcrumb: cold stages only, so the write
+            # rate tracks decode churn, not the (much hotter) hit rate
+            journal.record_stage(*key)
+            if pool_audit_enabled():
+                # stage-time CRC for the corruption audit: one page
+                # readback per cold stage — the documented cost of
+                # GSKY_POOL_AUDIT=1
+                self._checksums[key] = zlib.crc32(
+                    np.asarray(self._pool[slot]).tobytes())
         return slot
 
     # -- public --------------------------------------------------------
@@ -161,11 +187,14 @@ class PagePool:
         back to the bucketed path; partial pins are rolled back).  The
         caller owns the pins and must `unpin` the returned slots once
         its dispatch is enqueued (or abandoned)."""
+        from ..device_guard import staging_ok
         from ..resilience.pressure import staging_allowed
-        if not staging_allowed():
-            # critical memory pressure: growing HBM residency now risks
-            # the whole process — decline and let the caller fall back
-            # to the bucketed dispatch path
+        if not staging_allowed() or not staging_ok():
+            # critical memory pressure, or the device supervisor is
+            # anything but healthy: growing HBM residency now risks the
+            # whole process (or stages into a pool about to be torn
+            # down) — decline and let the caller fall back to the
+            # bucketed dispatch path
             with self.lock:
                 self.declined += 1
             return None
@@ -208,6 +237,11 @@ class PagePool:
                     self._pins[int(s)] = n
                 else:
                     self._pins.pop(int(s), None)
+                    if int(s) in self._quarantine_pins:
+                        # audited-poisoned while a dispatch held it:
+                        # now that the pin is gone, recycle the slot
+                        self._quarantine_pins.discard(int(s))
+                        self._free.append(int(s))
 
     @contextlib.contextmanager
     def locked_pool(self):
@@ -227,6 +261,133 @@ class PagePool:
                     if k[0] == int(serial) and not self._pins.get(s)]
             for k in dead:
                 self._free.append(self._slots.pop(k))
+                self._heat.pop(k, None)
+                self._checksums.pop(k, None)
+        from ..device_guard import guard_enabled, journal
+        if guard_enabled():
+            # void the scene's journal entries: its pages can no longer
+            # be re-staged, so a rebuild must not chase them
+            journal.record_drop(serial)
+
+    # -- device-guard lifecycle (docs/RESILIENCE.md) -------------------
+
+    def teardown(self) -> None:
+        """Device-incident teardown: journal the hot set, then drop the
+        device array and every piece of residency bookkeeping.
+
+        The supervisor runs this with the *host* process alive — only
+        the device state is suspect — so the exact pre-incident hot set
+        with in-memory hit counts is available and dumped as ``heat``
+        journal lines for :meth:`rehydrate`.  Pins are cleared: every
+        dispatch that held one has already failed through the
+        supervisor by the time a teardown runs."""
+        from ..device_guard import guard_enabled, journal
+        with self.lock:
+            if guard_enabled():
+                for key in self._slots:
+                    journal.record_heat(*key, hits=self._heat.get(key, 0))
+            self._pool = None
+            self._slots.clear()
+            self._pins.clear()
+            self._heat.clear()
+            self._checksums.clear()
+            self._quarantine_pins.clear()
+            self._free = list(range(self.capacity - 1, 0, -1))
+            self.teardowns += 1
+
+    def rehydrate(self) -> int:
+        """Warm recovery: re-stage the journal's hottest pages from
+        scenes still resident in the host scene cache, hottest first,
+        until the journal or the pool runs out.  Entries whose serial
+        is no longer resident (or whose page coordinates fall outside
+        the scene's page grid — a stale journal against a reloaded
+        world) are skipped.  Returns the number of pages restored."""
+        from ..device_guard import journal
+        entries = journal.replay()
+        if not entries:
+            return 0
+        try:
+            from .scene_cache import default_scene_cache as sc
+            with sc._lock:
+                scenes = {s.serial: s.dev for s in sc._scenes.values()}
+        except Exception:
+            return 0
+        restored = 0
+        for serial, pi, pj in entries:
+            dev = scenes.get(serial)
+            if dev is None:
+                continue            # stale: scene evicted since
+            gh = -(-int(dev.shape[0]) // self.page_rows)
+            gw = -(-int(dev.shape[1]) // self.page_cols)
+            if pi >= gh or pj >= gw:
+                continue            # stale: outside the scene's grid
+            with self.lock:
+                if not self._free and (serial, pi, pj) not in self._slots:
+                    break   # pool full: never LRU-evict warmth we just
+                    # restored to make room for colder journal entries
+                if self._stage_locked(dev, serial, pi, pj) is not None:
+                    restored += 1
+        with self.lock:
+            self.rehydrated += restored
+        return restored
+
+    def trim(self, frac: float = 0.5) -> int:
+        """OOM relief: release the coldest ``frac`` of unpinned pages
+        so staging churn stops competing for HBM while the pressure
+        monitor's cache relief frees the real bytes.  Returns the
+        number of pages released."""
+        with self.lock:
+            victims = [k for k in self._slots
+                       if not self._pins.get(self._slots[k])]
+            victims = victims[:int(len(victims) * max(0.0, min(1.0, frac)))]
+            for k in victims:
+                self._free.append(self._slots.pop(k))
+                self._heat.pop(k, None)
+                self._checksums.pop(k, None)
+            self.trimmed += len(victims)
+            return len(victims)
+
+    def audit(self) -> int:
+        """Integrity audit: convict and quarantine poisoned resident
+        pages.  Two passes — a cheap on-device ±inf scan
+        (`ops.paged.pool_inf_counts`; inf is written by nothing in the
+        staging path), then, under ``GSKY_POOL_AUDIT=1``, a CRC sweep
+        against stage-time checksums.  Quarantined slots leave the page
+        table immediately (future lookups miss and re-stage from the
+        scene cache); a quarantined slot still pinned by an in-flight
+        dispatch is recycled when its pin drops.  Returns the number of
+        pages quarantined."""
+        from ..ops.paged import pool_inf_counts
+        with self.lock:
+            if self._pool is None or not self._slots:
+                return 0
+            bad = []
+            try:
+                infs = np.asarray(pool_inf_counts(self._pool))
+            except Exception:
+                infs = None
+            host = None
+            if self._checksums:
+                host = np.asarray(self._pool)
+            for key, slot in list(self._slots.items()):
+                poisoned = bool(infs is not None and infs[slot] > 0)
+                if not poisoned and host is not None:
+                    want = self._checksums.get(key)
+                    if want is not None and \
+                            zlib.crc32(host[slot].tobytes()) != want:
+                        poisoned = True
+                if not poisoned:
+                    continue
+                bad.append(key)
+                self._slots.pop(key)
+                self._heat.pop(key, None)
+                self._checksums.pop(key, None)
+                if self._pins.get(slot):
+                    self._quarantine_pins.add(slot)
+                else:
+                    self._free.append(slot)
+            self.quarantined += len(bad)
+            return len(bad)
 
     def stats(self):
         with self.lock:
@@ -239,6 +400,10 @@ class PagePool:
                 "hits": self.hits,
                 "evictions": self.evictions,
                 "declined": self.declined,
+                "teardowns": self.teardowns,
+                "trimmed": self.trimmed,
+                "rehydrated": self.rehydrated,
+                "quarantined": self.quarantined,
                 "pool_bytes": (self.capacity * self.page_rows
                                * self.page_cols * 4),
             }

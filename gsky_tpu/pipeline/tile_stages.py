@@ -218,11 +218,16 @@ def _dispatch_stage(dispatch, spans: Dict):
 def _readback(dev, spans: Dict) -> np.ndarray:
     """Complete the in-flight device->host copy.  No gate: the transfer
     was started under the dispatch gate; this just blocks until the
-    bytes land, which is exactly the overlap window other requests use."""
+    bytes land, which is exactly the overlap window other requests use.
+    The sync runs under the device guard: hang watchdog
+    (GSKY_DEVICE_HANG_S), incident classification, and the output
+    integrity probe (docs/RESILIENCE.md "Device failures")."""
     check_cancel("readback")
     t0 = time.perf_counter()
     with obs_span("tile.readback") as sp:
-        arr = np.asarray(dev)
+        from .. import device_guard
+        arr = device_guard.guarded_readback(
+            "tile.readback", lambda: np.asarray(dev))
         sp.set(bytes=int(arr.nbytes))
     spans["readback_s"] = spans.get("readback_s", 0.0) \
         + time.perf_counter() - t0

@@ -34,9 +34,15 @@ __all__ = [
 
 def reset() -> None:
     """Test hook: clear counters, shared breakers, fault plans, the
-    cancellation ledger and the pressure monitor."""
+    cancellation ledger, the pressure monitor and the device
+    supervisor."""
     registry.reset()
     reset_breakers()
     faults.reset()
     reset_cancel_stats()
     default_monitor().reset()
+    try:
+        from .. import device_guard
+        device_guard.reset()
+    except Exception:
+        pass

@@ -27,6 +27,21 @@ Each comma-separated clause is ``site:kind:arg``:
 ``kill:RATE``
     ``os._exit`` the whole process with probability ``RATE`` — the
     worker node dies mid-RPC exactly the way SIGKILL would take it.
+``crash:RATE``
+    raise :class:`InjectedDeviceFault` with an ``INTERNAL:`` status
+    message — the shape a jaxlib ``XlaRuntimeError`` device crash
+    presents.  The device-guard classifier matches the *message*, not
+    the type, so the injection rides the real supervisor path
+    (suspect -> reinitialize -> warm rehydrate).
+``oom:RATE``
+    raise :class:`InjectedDeviceFault` with a ``RESOURCE_EXHAUSTED:``
+    status message — exercises the guard's trim + escalate + retry
+    protocol.
+``corrupt:RATE``
+    no raise; callers that produce data query :func:`flag` and poison
+    their own output — exercises the readback integrity probe and the
+    ``GSKY_POOL_AUDIT`` quarantine.  The wired site is ``device``
+    (``device_guard.guarded_readback``).
 
 Outcomes are drawn from a per-site ``random.Random`` seeded from
 ``GSKY_FAULTS_SEED`` (default 0) xor a CRC of the site name, so a given
@@ -62,6 +77,26 @@ class InjectedFault(ConnectionError):
     def __init__(self, site: str, kind: str = "error"):
         super().__init__(f"injected {kind} fault at {site!r}")
         self.site = site
+
+
+class InjectedDeviceFault(RuntimeError):
+    """A synthetic device-runtime failure (kinds ``crash`` / ``oom``).
+
+    Deliberately NOT a jaxlib type and NOT special-cased anywhere: the
+    message mirrors the XLA status strings (``INTERNAL:`` /
+    ``RESOURCE_EXHAUSTED:``) that ``device_guard.classify`` matches on,
+    so injected incidents exercise exactly the string classification a
+    real ``XlaRuntimeError`` would.
+    """
+
+    retryable = True
+
+    def __init__(self, site: str, kind: str):
+        status = ("RESOURCE_EXHAUSTED" if kind == "oom" else "INTERNAL")
+        super().__init__(
+            f"{status}: injected device {kind} fault at {site!r}")
+        self.site = site
+        self.kind = kind
 
 
 class _Rule:
@@ -103,10 +138,8 @@ def parse_spec(spec: str) -> Dict[str, List[_Rule]]:
             raise ValueError(f"bad fault clause {clause!r} "
                              "(want site:kind:arg)")
         site, kind = parts[0].strip(), parts[1].strip()
-        if kind == "error":
-            rule = _Rule("error", float(parts[2]))
-        elif kind == "kill":
-            rule = _Rule("kill", float(parts[2]))
+        if kind in ("error", "kill", "crash", "oom", "corrupt"):
+            rule = _Rule(kind, float(parts[2]))
         elif kind in ("latency", "slow", "hang"):
             rate = float(parts[3]) if len(parts) > 3 else 1.0
             rule = _Rule(kind, rate, _duration(parts[2]))
@@ -160,14 +193,19 @@ def inject(site: str) -> None:
         return
     delay = 0.0
     die = False
-    boom: Optional[InjectedFault] = None
+    boom: Optional[Exception] = None
     with st.lock:
         for rule in st.rules:
+            if rule.kind == "corrupt":
+                continue    # data-poisoning rules fire via flag()
             if rule.rate >= 1.0 or st.rng.random() < rule.rate:
                 if rule.kind in ("latency", "slow", "hang"):
                     delay += rule.latency_s
                 elif rule.kind == "kill":
                     die = True
+                    break
+                elif rule.kind in ("crash", "oom"):
+                    boom = InjectedDeviceFault(site, rule.kind)
                     break
                 else:
                     boom = InjectedFault(site)
@@ -184,6 +222,32 @@ def inject(site: str) -> None:
         from .registry import registry
         registry.count_fault(site)
         raise boom
+
+
+def flag(site: str, kind: str) -> bool:
+    """Roll the ``kind`` rules for ``site`` and report whether one
+    fired — for faults that cannot be expressed as a raise or a sleep
+    (``corrupt``: the caller poisons its own data).  Draws from the
+    same per-site RNG stream as :func:`inject`, so (spec, seed) replay
+    stays deterministic."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    st = plan.get(site)
+    if st is None:
+        return False
+    hit = False
+    with st.lock:
+        for rule in st.rules:
+            if rule.kind != kind:
+                continue
+            if rule.rate >= 1.0 or st.rng.random() < rule.rate:
+                hit = True
+                break
+    if hit:
+        from .registry import registry
+        registry.count_fault(site)
+    return hit
 
 
 # honour the environment at import so every process (server, workers,

@@ -90,8 +90,11 @@ class PressureMonitor:
         self._forced: Optional[int] = None
         self._last_check = -1e9
         self._clear_since: Optional[float] = None
+        self._floor_state = 0
+        self._floor_until = -1e9
         self.transitions = 0
         self.trims = 0
+        self.escalations = 0
         self._last_avail: Optional[int] = None
         self._last_pool: Optional[float] = None
 
@@ -157,39 +160,56 @@ class PressureMonitor:
         if relieve:
             self._relieve()
 
+    def escalate(self, level: int = 1,
+                 hold_s: Optional[float] = None) -> None:
+        """One-shot escalation from the device guard's OOM relief
+        protocol: floor the reported state at ``level`` for ``hold_s``
+        (default the CLEAR window) and run the cache relief *now*.
+        Unlike :meth:`force` this does not pin measurement — a genuine
+        critical reading still wins, and the floor expires on its own."""
+        hold = _env_float("GSKY_PRESSURE_CLEAR_S", 3.0) \
+            if hold_s is None else hold_s
+        with self._lock:
+            self.escalations += 1
+            self._floor_state = max(1, min(2, int(level)))
+            self._floor_until = self.clock() + max(0.0, hold)
+        self._relieve()
+
     def state(self) -> int:
         if not self._enabled():
             return 0
+        step_to_crit = False
         with self._lock:
             if self._forced is not None:
                 return self._forced
             now = self.clock()
-            if now - self._last_check < _env_float(
+            if now - self._last_check >= _env_float(
                     "GSKY_PRESSURE_POLL_S", 0.5):
-                return self._state
-            self._last_check = now
-            raw = self._raw_state()
-            prev = self._state
-            if raw >= prev:
-                # rising (or holding): apply immediately
-                if raw > prev:
-                    self._state = raw
-                    self.transitions += 1
-                self._clear_since = None
-                step_to_crit = raw >= 2 > prev
-            else:
-                # falling: require a sustained clear window
-                step_to_crit = False
-                if self._clear_since is None:
-                    self._clear_since = now
-                elif now - self._clear_since >= _env_float(
-                        "GSKY_PRESSURE_CLEAR_S", 3.0):
-                    self._state = raw
-                    self.transitions += 1
+                self._last_check = now
+                raw = self._raw_state()
+                prev = self._state
+                if raw >= prev:
+                    # rising (or holding): apply immediately
+                    if raw > prev:
+                        self._state = raw
+                        self.transitions += 1
                     self._clear_since = None
+                    step_to_crit = raw >= 2 > prev
+                else:
+                    # falling: require a sustained clear window
+                    if self._clear_since is None:
+                        self._clear_since = now
+                    elif now - self._clear_since >= _env_float(
+                            "GSKY_PRESSURE_CLEAR_S", 3.0):
+                        self._state = raw
+                        self.transitions += 1
+                        self._clear_since = None
+            out = self._state
+            if self._floor_state and now < self._floor_until:
+                out = max(out, self._floor_state)
         if step_to_crit:
             self._relieve()
-        return self._state
+        return out
 
     def stats(self) -> Dict:
         with self._lock:
@@ -202,6 +222,7 @@ class PressureMonitor:
                 else round(self._last_pool, 3),
                 "transitions": self.transitions,
                 "trims": self.trims,
+                "escalations": self.escalations,
             }
 
     def reset(self) -> None:
@@ -210,8 +231,11 @@ class PressureMonitor:
             self._forced = None
             self._last_check = -1e9
             self._clear_since = None
+            self._floor_state = 0
+            self._floor_until = -1e9
             self.transitions = 0
             self.trims = 0
+            self.escalations = 0
             self._last_avail = None
             self._last_pool = None
 

@@ -80,6 +80,10 @@ def main(argv=None):
         from ..ops import kernel_ledger, pallas_tpu
         kernel_ledger.set_default_dir(args.log_dir)
         pallas_tpu.reload_ledger()
+        # the page-residency journal (warm pool recovery) lives there
+        # too; GSKY_POOL_JOURNAL still overrides
+        from ..device_guard import journal
+        journal.set_default_dir(args.log_dir)
 
     # persistent compilation cache + shape-bucket prewarm: every
     # bucketed render program the configured layers can dispatch is

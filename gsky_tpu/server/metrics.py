@@ -334,6 +334,16 @@ class MetricsLogger:
         except Exception:   # observability must never fail a request
             pass
         try:
+            # device supervisor state machine + page-residency journal:
+            # the "is the accelerator healthy, and how warm would a
+            # rebuilt pool come back" block (docs/RESILIENCE.md)
+            from .. import device_guard
+            dev = device_guard.default_supervisor().stats()
+            dev["journal"] = device_guard.journal.stats()
+            out["device"] = dev
+        except Exception:   # observability must never fail a request
+            pass
+        try:
             # per-node health states, routed/hedged/re-routed counts,
             # ring generation — one entry per live fleet router
             from ..fleet import fleet_stats

@@ -57,7 +57,7 @@ from ..pipeline.extent import compute_reprojection_extent
 from ..pipeline.feature_info import get_feature_info
 from ..pipeline.tile_stages import render_staged, tile_pipeline_enabled
 from ..pipeline.types import AxisSelector, MaskSpec
-from .. import obs
+from .. import device_guard, obs
 from ..resilience import (BackendUnavailable, Deadline, DeadlineExceeded,
                           TooManyFailures, brownout_level, cancel_scope,
                           cancel_stats, current_token, deadline_scope,
@@ -979,7 +979,11 @@ class OWSServer:
                     rgba = None
                     if isinstance(sb, tuple):  # tagged RGB-ladder result
                         kind, dev = sb
-                        arr = np.asarray(dev)   # the one device pull
+                        # the one device pull, under the device guard
+                        # (hang watchdog + integrity probe)
+                        arr = device_guard.guarded_readback(
+                            "tile.readback", lambda dev=dev:
+                            np.asarray(dev))
                         if kind == "rgba":
                             rgba = arr          # (H, W, 4)
                             scaled = [arr[..., 0], arr[..., 1],
@@ -987,7 +991,9 @@ class OWSServer:
                         else:                   # "planes": (3, H, W)
                             scaled = list(arr)
                     else:
-                        arr = np.asarray(sb)  # the one device pull
+                        arr = device_guard.guarded_readback(
+                            "tile.readback", lambda sb=sb:
+                            np.asarray(sb))  # the one device pull
                         scaled = [arr] if arr.ndim == 2 else list(arr)
                     collector.info["device"]["duration"] = \
                         int((time.time() - td) * 1e9)
@@ -1028,7 +1034,8 @@ class OWSServer:
                                        clip=style.clip_value,
                                        colour_scale=style.colour_scale,
                                        auto=auto)
-                    scaled.append(np.asarray(sb))
+                    scaled.append(device_guard.guarded_readback(
+                        "tile.readback", lambda sb=sb: np.asarray(sb)))
         collector.info["rpc"]["duration"] = int((time.time() - t0) * 1e9)
         if p.format.lower() in ("image/jpeg", "image/jpg"):
             return web.Response(
@@ -1265,7 +1272,11 @@ class OWSServer:
                                 np.float32)
                 for i, n in enumerate(ns_names):
                     if n in res.data:
-                        d = np.asarray(res.data[n])
+                        # float export pull, under the device guard
+                        # (hang watchdog + output-integrity probe)
+                        d = device_guard.guarded_readback(
+                            "export.readback", lambda n=n:
+                            np.asarray(res.data[n]))
                         v = np.asarray(res.valid[n])
                         block[i] = np.where(v, d, nodata)
                 await asyncio.to_thread(writer.write_region, ox, oy,
@@ -1273,7 +1284,10 @@ class OWSServer:
                 return
             for n in ns_names:
                 if n in res.data:
-                    out[n][oy:oy + th, ox:ox + tw] = np.asarray(res.data[n])
+                    out[n][oy:oy + th, ox:ox + tw] = \
+                        device_guard.guarded_readback(
+                            "export.readback", lambda n=n:
+                            np.asarray(res.data[n]))
                     valid[n][oy:oy + th, ox:ox + tw] = \
                         np.asarray(res.valid[n])
         # OWS-cluster scale-out (`ows.go:835-872,930-995,1094-1150`):

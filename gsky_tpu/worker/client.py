@@ -173,7 +173,11 @@ class WorkerClient:
 
     def _probe(self, node: str):
         """Active health probe: one worker_info RPC.  Returns the
-        DRAINING sentinel when the node answered only to say goodbye."""
+        DRAINING sentinel when the node answered only to say goodbye —
+        or when its device supervisor reports suspect/reinitializing
+        (alive, rebuilding: keep the beat history warm, route nothing
+        new at it until a later probe sees it healthy).  A dead device
+        or a tripped crash-loop breaker is an explicit fatal report."""
         if self._closed:
             return False
         i = self._index[node]
@@ -182,16 +186,33 @@ class WorkerClient:
                                  timeout=5.0)
         except Exception:
             return False
-        return DRAINING if self._draining(res) else True
+        info = self._info(res)
+        dev = info.get("device") or {}
+        crash = (info.get("pool") or {}).get("crash_loop") or {}
+        if dev.get("state") == "dead" or crash.get("tripped"):
+            self.fleet.monitor.record_failure(node, fatal=True)
+            return False
+        if info.get("draining"):
+            return DRAINING
+        if dev.get("state") in ("suspect", "reinitializing"):
+            return DRAINING
+        return True
 
     @staticmethod
-    def _draining(res: pb.Result) -> bool:
+    def _info(res: pb.Result) -> dict:
+        """The worker's free-form info_json envelope (drain handshake +
+        device supervisor + pool crash-loop state), or {}."""
         if not res.info_json:
-            return False
+            return {}
         try:
-            return bool(json.loads(res.info_json).get("draining"))
+            doc = json.loads(res.info_json)
         except (ValueError, AttributeError):
-            return False
+            return {}
+        return doc if isinstance(doc, dict) else {}
+
+    @classmethod
+    def _draining(cls, res: pb.Result) -> bool:
+        return bool(cls._info(res).get("draining"))
 
     @staticmethod
     def _is_fatal(e: Exception) -> bool:
@@ -336,6 +357,22 @@ class WorkerClient:
                 if keyed:
                     self.fleet.record_reroute()
                     _note("reroute", node=node, reason="draining")
+                continue
+            if err.startswith("device:"):
+                # alive, but its device is mid-incident (hang/crash/OOM/
+                # corruption — the supervisor is rebuilding it): no
+                # breaker penalty, route around it like a draining node;
+                # the next healthy worker_info probe restores it
+                br.record_success()
+                self.fleet.node_result(node, ok=True, draining=True)
+                _rpc_observe(op, "device", dt)
+                rsp.set(outcome="device")
+                if pos + 1 < len(order):
+                    registry.count_retry("worker")
+                if keyed:
+                    self.fleet.record_reroute()
+                    _note("reroute", node=node, reason="device")
+                last = RuntimeError(err)
                 continue
             # a real answer (success or semantic error): the node lives
             br.record_success()

@@ -51,11 +51,18 @@ class OOMMonitor:
     def __init__(self, child_pids: Callable[[], List[int]],
                  threshold_bytes: int = 1536 << 20,
                  meminfo_path: str = "/proc/meminfo",
-                 kill: Callable[[int], None] = None):
+                 kill: Callable[[int], None] = None,
+                 on_kill: Callable[[int], None] = None):
         self.child_pids = child_pids
         self.threshold = threshold_bytes
         self.meminfo_path = meminfo_path
         self.kill = kill or (lambda pid: os.kill(pid, signal.SIGKILL))
+        # fired after a successful defensive kill: the server wires this
+        # to the device supervisor (count the OOM) and the pressure
+        # monitor (escalate + shed caches) so the whole node backs off,
+        # not just the one replaced child
+        self.on_kill = on_kill
+        self.kills = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_avail: Optional[int] = None
@@ -97,6 +104,12 @@ class OOMMonitor:
             self.kill(pid)
         except OSError:
             return None
+        self.kills += 1
+        if self.on_kill is not None:
+            try:
+                self.on_kill(pid)
+            except Exception:   # the defence must outlive its observers
+                log.exception("on_kill callback failed")
         return pid
 
     def _run(self):

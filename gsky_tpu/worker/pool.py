@@ -38,6 +38,74 @@ log = logging.getLogger("gsky.worker.pool")
 MAX_RETRIES = 5
 QUEUE_CAP_PER_PROCESS = 200
 
+# consecutive-spawn-failure backoff: exponential with full jitter so a
+# pool of slots all failing against the same broken dependency doesn't
+# hammer it in lockstep
+RESPAWN_BACKOFF_BASE_S = 0.5
+RESPAWN_BACKOFF_CAP_S = 15.0
+
+# crash-loop breaker: this many unexpected respawns (crashes or spawn
+# failures — NOT planned max_tasks recycles) inside the sliding window
+# and the node stops pretending restarts will fix it
+CRASH_LOOP_MAX = 5
+CRASH_LOOP_WINDOW_S = 60.0
+
+
+def _respawn_backoff(failures: int, rand=random.random) -> float:
+    """Delay before the next spawn attempt after `failures` consecutive
+    failures: min(cap, base * 2^failures) with full jitter."""
+    raw = min(RESPAWN_BACKOFF_CAP_S,
+              RESPAWN_BACKOFF_BASE_S * (2 ** min(failures, 16)))
+    return raw * (0.5 + rand())
+
+
+class CrashLoopBreaker:
+    """Sliding-window respawn counter that latches `tripped`.
+
+    A subprocess crash is survivable — the supervisor replaces it and
+    retries the task.  A CRASH LOOP is not: N unexpected respawns inside
+    the window means something environmental (bad install, exhausted
+    node, poisoned input wedging every child) that one more restart
+    won't fix.  Tripping doesn't stop the pool — it keeps limping, which
+    still beats refusing everything — but the state is folded into the
+    worker's info block so the fleet health monitor marks the node fatal
+    and routers stop sending it fresh work (docs/RESILIENCE.md)."""
+
+    def __init__(self, max_crashes: int = CRASH_LOOP_MAX,
+                 window_s: float = CRASH_LOOP_WINDOW_S,
+                 clock=time.monotonic):
+        self.max_crashes = max(1, int(max_crashes))
+        self.window_s = float(window_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._times: List[float] = []
+        self.total = 0
+        self.tripped = False
+
+    def record(self) -> bool:
+        """Count one unexpected respawn; returns the (possibly newly)
+        tripped state."""
+        with self._lock:
+            now = self.clock()
+            self.total += 1
+            self._times.append(now)
+            cutoff = now - self.window_s
+            self._times = [t for t in self._times if t >= cutoff]
+            if len(self._times) >= self.max_crashes and not self.tripped:
+                self.tripped = True
+                log.error(
+                    "crash-loop breaker tripped: %d respawns in %.0fs; "
+                    "reporting node fatal to fleet health",
+                    len(self._times), self.window_s)
+            return self.tripped
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"tripped": self.tripped, "respawns": self.total,
+                    "recent": len(self._times),
+                    "max_crashes": self.max_crashes,
+                    "window_s": self.window_s}
+
 
 def _recycle_threshold(max_tasks: int, size: int,
                        rand=random.randrange) -> int:
@@ -99,6 +167,7 @@ class Process:
         self.max_tasks = _recycle_threshold(pool.max_tasks, pool.size)
         self.proc: Optional[subprocess.Popen] = None
         self.tasks_done = 0
+        self.spawn_failures = 0   # consecutive; drives the backoff
         self.thread = threading.Thread(target=self._run, daemon=True,
                                        name=f"gsky-pool-{idx}")
         self.thread.start()
@@ -150,24 +219,40 @@ class Process:
 
     # -- task loop -----------------------------------------------------------
 
-    def _respawn(self) -> bool:
+    def _respawn(self, crashed: bool = False) -> bool:
         """Spawn with the feeder thread kept alive on failure — a slot
-        that can't start a child keeps retrying instead of dying."""
+        that can't start a child keeps retrying instead of dying.
+        `crashed` marks an UNEXPECTED replacement (child died or wedged,
+        vs a planned max_tasks recycle) and feeds the pool's crash-loop
+        breaker; spawn failures always do.  Consecutive failures back
+        off exponentially with jitter so a broken dependency isn't
+        hammered in lockstep by every slot."""
+        if crashed:
+            self.pool.breaker.record()
         try:
             self._spawn()
+            self.spawn_failures = 0
             return True
         except (RuntimeError, OSError) as e:
             log.error("subprocess %d spawn failed: %s", self.idx, e)
             self._kill()
-            time.sleep(1.0)
+            self.pool.breaker.record()
+            delay = _respawn_backoff(self.spawn_failures)
+            self.spawn_failures += 1
+            deadline = time.monotonic() + delay
+            while time.monotonic() < deadline and not self.pool.closed:
+                time.sleep(0.05)
             return False
 
     def _run(self):
         self._respawn()
         while not self.pool.closed:
             if self.proc is None or self.proc.poll() is not None:
-                # crashed, recycled, or never started: replace it
-                if not self._respawn():
+                # crashed, recycled, or never started: replace it.  A
+                # child that EXITED on its own (proc present, poll set)
+                # counts as a crash; a slot still failing to spawn
+                # (proc None) already counted when the spawn failed.
+                if not self._respawn(crashed=self.proc is not None):
                     continue
             try:
                 item = self.pool.queue.get(timeout=0.2)
@@ -194,7 +279,7 @@ class Process:
                 log.warning("subprocess %d task failed (%s); restarting "
                             "trace=%s", self.idx, e, item.trace_id)
                 self._kill()
-                self._respawn()
+                self._respawn(crashed=True)
                 item.attempts += 1
                 if item.attempts >= MAX_RETRIES:
                     item.result = pb.Result(
@@ -221,6 +306,7 @@ class ProcessPool:
         self.tmp_dir = tmp_dir or tempfile.mkdtemp(prefix="gsky_pool_")
         self.quiet = quiet
         self.closed = False
+        self.breaker = CrashLoopBreaker()
         self.queue: "queue.Queue[Optional[_Task]]" = queue.Queue(
             maxsize=QUEUE_CAP_PER_PROCESS * self.size)
         self.processes: List[Process] = [
@@ -244,6 +330,12 @@ class ProcessPool:
 
     def child_pids(self) -> List[int]:
         return [p.pid for p in self.processes if p.pid is not None]
+
+    def stats(self) -> dict:
+        """Folded into the worker's info block (_worker_info) so the
+        client-side fleet health monitor sees crash-loop state."""
+        return {"size": self.size, "queue_depth": self.queue.qsize(),
+                "crash_loop": self.breaker.stats()}
 
     def close(self):
         self.closed = True

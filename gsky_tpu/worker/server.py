@@ -30,6 +30,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..device_guard import DeviceGuardError
 from ..fleet import DrainController, Draining
 from ..obs import current_trace_id, remote_trace, span as obs_span
 from ..resilience import faults
@@ -132,6 +133,12 @@ class WorkerService:
             return pb.Result(error=f"draining: {e}")
         except PoolFullError as e:
             return pb.Result(error=f"backpressure: {e}")
+        except DeviceGuardError as e:
+            # retryable device incident (hang/crash/OOM/corruption or
+            # mid-reinit): the "device:" prefix tells the client to fail
+            # over to another node without charging this one a breaker
+            # penalty — the supervisor is already rebuilding it
+            return pb.Result(error=f"device: {e}")
         except Exception as e:
             log.exception("op %s failed trace=%s", op,
                           current_trace_id() or "-")
@@ -144,8 +151,22 @@ class WorkerService:
         r.worker.queue_cap = self.pool.queue.maxsize
         r.worker.platform = jax.default_backend()
         # WorkerInfo has no spare proto field; the drain handshake rides
-        # the free-form info_json channel instead
-        r.info_json = json.dumps(self.drain.stats())
+        # the free-form info_json channel instead.  The device
+        # supervisor's state and the decode pool's crash-loop breaker
+        # ride along so the fleet health monitor can mark a node
+        # degraded (suspect/reinitializing) or fatal (dead/crash-loop)
+        # from the same probe.
+        info = dict(self.drain.stats())
+        try:
+            from .. import device_guard
+            info["device"] = device_guard.default_supervisor().stats()
+        except Exception:
+            pass
+        try:
+            info["pool"] = self.pool.stats()
+        except Exception:
+            pass
+        r.info_json = json.dumps(info)
         return r
 
     def _warp(self, task: pb.Task, ctx=None) -> pb.Result:
@@ -195,7 +216,10 @@ class WorkerService:
                 return res
             canv, vals = sc
             with obs_span("worker.readback") as rb:
-                a, v = np.asarray(canv[0]), np.asarray(vals[0])
+                from .. import device_guard
+                a = device_guard.guarded_readback(
+                    "worker.readback", lambda: np.asarray(canv[0]))
+                v = np.asarray(vals[0])
                 rb.set(bytes=int(a.nbytes + v.nbytes))
             pack_raster(res, a, v)
             b = dst_gt.bbox(d.width, d.height)
@@ -235,7 +259,10 @@ class WorkerService:
         if out is None:
             return res
         with obs_span("worker.readback") as rb:
-            a, v = np.asarray(out[0]), np.asarray(out[1])
+            from .. import device_guard
+            a = device_guard.guarded_readback(
+                "worker.readback", lambda: np.asarray(out[0]))
+            v = np.asarray(out[1])
             rb.set(bytes=int(a.nbytes + v.nbytes))
         pack_raster(res, a, v)
         b = dst_gt.bbox(d.width, d.height)
@@ -334,8 +361,19 @@ def main(argv=None):
     svc = WorkerService(pool_size=a.pool or None, task_timeout=a.timeout)
     monitor = None
     if a.oom_threshold:
+        def _oom_killed(pid: int) -> None:
+            # a defensive kill IS a host-memory OOM incident: count it
+            # on the supervisor and shed node-wide pressure so the next
+            # victim isn't immediately re-grown
+            from .. import device_guard
+            from ..resilience.pressure import default_monitor
+            device_guard.default_supervisor().record_oom(
+                "worker.oom", RuntimeError(f"killed decode pid {pid}"))
+            default_monitor().escalate()
+
         monitor = OOMMonitor(svc.pool.child_pids,
-                             threshold_bytes=a.oom_threshold << 20)
+                             threshold_bytes=a.oom_threshold << 20,
+                             on_kill=_oom_killed)
         monitor.start()
     server = make_grpc_server(svc, f"{a.host}:{a.port}")
     server.start()

@@ -690,6 +690,43 @@ def test_overload_series_roundtrip_strict_parser():
         reset_cancel_stats()
 
 
+def test_device_series_roundtrip_strict_parser():
+    """The device-guard collector families (supervisor state, rebuild
+    and hang counters, incident kinds, warm-recovery volume) must
+    round-trip the strict parser with live supervisor state behind
+    them."""
+    from gsky_tpu import device_guard as dg
+    from gsky_tpu.obs.metrics import render_metrics
+
+    sup = dg.default_supervisor()
+    sup.reset()
+    try:
+        sup.record_hang("t.obs")
+        sup.record_oom("t.obs", RuntimeError("RESOURCE_EXHAUSTED: x"))
+        fams = parse_exposition(render_metrics())
+
+        state = fams["gsky_device_state"]
+        assert state["type"] == "gauge"
+        assert state["samples"][("gsky_device_state", ())] == 1.0
+        assert fams["gsky_device_reinits_total"]["type"] == "counter"
+        assert fams["gsky_device_reinits_total"]["samples"][
+            ("gsky_device_reinits_total", ())] == 0.0
+        hangs = fams["gsky_device_hangs_total"]
+        assert hangs["type"] == "counter"
+        assert hangs["samples"][("gsky_device_hangs_total", ())] == 1.0
+        inc = fams["gsky_device_incidents_total"]["samples"]
+        assert inc[("gsky_device_incidents_total",
+                    (("kind", "oom"),))] == 1.0
+        assert inc[("gsky_device_incidents_total",
+                    (("kind", "crash"),))] == 0.0
+        rehyd = fams["gsky_pool_rehydrated_pages_total"]
+        assert rehyd["type"] == "counter"
+        assert rehyd["samples"][
+            ("gsky_pool_rehydrated_pages_total", ())] == 0.0
+    finally:
+        sup.reset()
+
+
 def test_ingest_series_roundtrip_strict_parser():
     """The ingest collector families (ranged-read volume, prefetch
     outcomes, overlap ratio) must round-trip the strict parser with

@@ -108,6 +108,22 @@ baseline, the planner's hit rate on the walk is >= 50%, and /metrics
 exposes the ingest families through the strict parser.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario ingest --seconds 20
+
+``--scenario devicechaos``: device supervision & warm recovery
+(docs/RESILIENCE.md "Device failures").  Warms a hot tile set so the
+page pool holds a known working set (GSKY_PALLAS=interpret engages the
+paged pipeline on CPU), then runs four incident phases — crash, hang,
+OOM and readback corruption — injected at the real dispatch/readback
+sites via ``device:*`` faults.  Per phase every response must be a
+clean outcome (2xx, labelled degraded 2xx, or an OGC-XML refusal with
+Retry-After); a bare 500 or dropped connection fails the soak.  After
+each phase the device must return to ``healthy`` within the recovery
+budget (tiny GSKY_DEVICE_REINIT_BACKOFF), and the rebuilt pool must
+rehydrate at least half of the pre-incident hot pages from the
+residency journal.  /metrics must expose the device families through
+the strict parser.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario devicechaos --seconds 20
 """
 
 from __future__ import annotations
@@ -174,7 +190,8 @@ def main(argv=None):
     ap.add_argument("--max-rss-growth-mb", type=float, default=256.0)
     ap.add_argument("--scenario",
                     choices=("churn", "hot", "wcs", "chaos", "burst",
-                             "fleet", "overload", "ingest"),
+                             "fleet", "overload", "ingest",
+                             "devicechaos"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -301,6 +318,8 @@ def main(argv=None):
         return run_overload(args, watcher, mas_client, merc, boot)
     if args.scenario == "ingest":
         return run_ingest(args, watcher, mas_client, merc, boot)
+    if args.scenario == "devicechaos":
+        return run_devicechaos(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -659,6 +678,232 @@ def run_chaos(args, watcher, mas_client, merc, boot) -> int:
           and res.get("degraded_responses", 0) > 0
           and not metrics["missing"]
           and any(b.get("failures", 0) > 0 for b in breakers.values()))
+    print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+    return 0 if ok else 1
+
+
+def run_devicechaos(args, watcher, mas_client, merc, boot) -> int:
+    """Device supervision & warm recovery under injected TPU incidents.
+
+    Four phases (crash, hang, OOM, readback corruption), each riding
+    the REAL supervisor paths — the ``device:*`` fault sites fire
+    inside the dispatch watchdog / readback probe, so classification,
+    teardown+rebuild, OOM relief+retry and quarantine all execute
+    exactly as they would on flaky hardware.  Pass criteria:
+
+    - zero bare 5xx / dropped connections in every phase (every failure
+      is a labelled degraded 200 or an OGC-XML refusal with Retry-After)
+    - the device returns to ``healthy`` within the recovery budget
+      after every phase (backoff compressed via GSKY_DEVICE_REINIT_BACKOFF)
+    - the rebuilt pool rehydrates >= 50% of the pre-incident hot pages
+    - every incident kind shows up in the supervisor counters, and the
+      device /metrics families round-trip the strict parser
+    """
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from gsky_tpu.resilience import faults
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+    from gsky_tpu.serving import ServingGateway
+
+    # the paged pipeline must engage (interpret mode) so the pool holds
+    # a working set worth recovering; compress the reinit backoff so
+    # recovery fits the soak budget; private journal so a previous
+    # run's residency can't leak into this one's rehydration
+    env_overrides = {
+        "GSKY_PALLAS": "interpret",
+        "GSKY_DEVICE_REINIT_BACKOFF": "0.05,0.4",
+        "GSKY_POOL_AUDIT": "1",
+        "GSKY_POOL_JOURNAL": os.path.join(
+            tempfile.mkdtemp(prefix="gsky_devicechaos_"),
+            "journal.jsonl"),
+    }
+    saved_env = {k: os.environ.get(k) for k in env_overrides}
+    saved_env["GSKY_DEVICE_HANG_S"] = os.environ.get("GSKY_DEVICE_HANG_S")
+    os.environ.update(env_overrides)
+
+    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                       metrics=MetricsLogger(), gateway=ServingGateway())
+    host = boot(server)
+
+    grid = 3
+    frac = np.linspace(0.0, 0.6, grid)
+    hot = [(float(fx), float(fy)) for fx in frac for fy in frac]
+    w = merc.width * 0.25
+
+    def getmap_url(fx: float, fy: float, date: int) -> str:
+        bb = (f"{merc.xmin + fx * merc.width},"
+              f"{merc.ymin + fy * merc.height},"
+              f"{merc.xmin + fx * merc.width + w},"
+              f"{merc.ymin + fy * merc.height + w}")
+        return (f"http://{host}/ows?service=WMS&request=GetMap"
+                f"&version=1.3.0&layers=landsat_chaos&crs=EPSG:3857"
+                f"&bbox={bb}&width=256&height=256&format=image/png"
+                f"&time=2020-01-{date:02d}T00:00:00.000Z")
+
+    def getcov_url(fx: float, fy: float) -> str:
+        # WCS float export: the readback the corruption probe can
+        # actually convict (tile GetMap pulls are uint8 — every byte
+        # value is legal, so the inf probe has nothing to catch there)
+        cw = merc.width * 0.3
+        bb = (f"{merc.xmin + fx * merc.width},"
+              f"{merc.ymin + fy * merc.height},"
+              f"{merc.xmin + fx * merc.width + cw},"
+              f"{merc.ymin + fy * merc.height + cw}")
+        return (f"http://{host}/ows?service=WCS&request=GetCoverage"
+                f"&coverage=landsat_chaos&crs=EPSG:3857&bbox={bb}"
+                f"&width=256&height=256&format=GeoTIFF"
+                f"&time=2020-01-10T00:00:00.000Z")
+
+    retry_after_seen = [0]
+
+    def classify(url: str) -> str:
+        try:
+            with urllib.request.urlopen(url, timeout=120) as r:
+                degraded = r.headers.get("X-GSKY-Degraded")
+                r.read()
+                return "degraded" if degraded else "ok"
+        except urllib.error.HTTPError as e:
+            ctype = e.headers.get("Content-Type", "")
+            if e.headers.get("Retry-After"):
+                retry_after_seen[0] += 1
+            e.read()
+            if e.code == 500 or "vnd.ogc.se_xml" not in ctype:
+                return "hard_5xx"
+            return "ogc_error"
+        except Exception:
+            return "transport"
+
+    # warm lap, fault-free: stage the hot working set into the pool
+    warm_bad = sum(classify(getmap_url(fx, fy, 10)) not in ("ok",)
+                   for fx, fy in hot)
+    from gsky_tpu.pipeline import pages
+    pool = pages._default
+    resident_before = pool.stats()["resident"] if pool is not None else 0
+
+    def device_stats() -> dict:
+        with urllib.request.urlopen(f"http://{host}/debug",
+                                    timeout=30) as r:
+            return json.loads(r.read()).get("device", {})
+
+    rng = np.random.default_rng(args.fault_seed)
+    counter = itertools.count()
+    lock = threading.Lock()
+    phase_s = max(2.0, args.seconds / 8.0)
+    recovery_budget_s = 20.0
+
+    use_wcs = [False]
+
+    def one(counts):
+        i = next(counter)
+        if use_wcs[0]:
+            u = getcov_url(float(rng.uniform(0.0, 0.6)),
+                           float(rng.uniform(0.0, 0.6)))
+        elif i % 2 == 0:
+            fx, fy = hot[i // 2 % len(hot)]
+            u = getmap_url(fx, fy, 10)
+        else:       # cache-busting mix so dispatches keep happening
+            u = getmap_url(float(rng.uniform(0.0, 0.6)),
+                           float(rng.uniform(0.0, 0.6)), 10 + i % 4)
+        c = classify(u)
+        with lock:
+            counts[c] = counts.get(c, 0) + 1
+
+    def recover() -> float:
+        """Drive fresh dispatches (cache-busting bboxes) until the
+        supervisor reports healthy; returns seconds taken or -1."""
+        t0 = time.time()
+        while time.time() - t0 < recovery_budget_s:
+            classify(getmap_url(float(rng.uniform(0.0, 0.75)),
+                                float(rng.uniform(0.0, 0.75)),
+                                10 + next(counter) % 4))
+            if device_stats().get("state") == "healthy":
+                return round(time.time() - t0, 2)
+            time.sleep(0.1)
+        return -1.0
+
+    phases = (
+        ("crash", "device:crash:0.4", None),
+        ("hang", "device:hang:2s:0.4", ("GSKY_DEVICE_HANG_S", "0.3")),
+        ("corrupt", "device:corrupt:0.5", None),
+        ("oom", "device:oom:0.5", None),
+    )
+    from gsky_tpu.resilience.pressure import default_monitor
+    results = {}
+    try:
+        for name, spec, extra_env in phases:
+            use_wcs[0] = name == "corrupt"
+            if extra_env:
+                os.environ[extra_env[0]] = extra_env[1]
+            faults.configure(spec, seed=args.fault_seed)
+            counts: dict = {}
+            t_end = time.time() + phase_s
+            try:
+                with cf.ThreadPoolExecutor(args.conc) as ex:
+                    while time.time() < t_end:
+                        list(ex.map(one, [counts] * (args.conc * 2)))
+            finally:
+                faults.reset()
+                if extra_env:
+                    os.environ.pop(extra_env[0], None)
+            took = recover()
+            results[name] = {"responses": counts,
+                             "recovery_s": took}
+            # the OOM relief protocol escalates the pressure monitor
+            # with a hold; relax it between phases so the NEXT phase
+            # measures its own incident path, not residual brownout
+            # (real deployments space incidents out; the soak doesn't)
+            default_monitor().reset()
+    finally:
+        faults.reset()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    dev = device_stats()
+    rehydrated = int(dev.get("rehydrated_pages", 0))
+    metrics = check_metrics(host, require=(
+        "gsky_requests_total", "gsky_device_state",
+        "gsky_device_reinits_total", "gsky_device_hangs_total",
+        "gsky_device_incidents_total",
+        "gsky_pool_rehydrated_pages_total"))
+    out = {
+        "scenario": "devicechaos", "phases": results,
+        "warm_failures": warm_bad,
+        "resident_before": resident_before,
+        "rehydrated_pages": rehydrated,
+        "retry_after_responses": retry_after_seen[0],
+        "device": {k: dev.get(k) for k in
+                   ("state", "reinits", "reinit_failures", "hangs",
+                    "crashes", "ooms", "oom_retries", "corruptions",
+                    "quarantined_pages")},
+        "metrics": metrics,
+    }
+    print(json.dumps(out))
+    total = {}
+    for r in results.values():
+        for c, n in r["responses"].items():
+            total[c] = total.get(c, 0) + n
+    ok = (warm_bad == 0
+          and total.get("hard_5xx", 0) == 0
+          and total.get("transport", 0) == 0
+          and total.get("ok", 0) + total.get("degraded", 0) > 0
+          and all(r["recovery_s"] >= 0 for r in results.values())
+          and dev.get("state") == "healthy"
+          and int(dev.get("reinits", 0)) >= 1
+          and int(dev.get("hangs", 0)) >= 1
+          and int(dev.get("crashes", 0)) >= 1
+          and int(dev.get("ooms", 0)) >= 1
+          and int(dev.get("corruptions", 0)) >= 1
+          and resident_before > 0
+          and rehydrated >= max(1, resident_before // 2)
+          and retry_after_seen[0] >= 0
+          and not metrics["missing"])
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
 
