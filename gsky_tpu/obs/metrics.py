@@ -42,6 +42,18 @@ ENCODE_SECONDS = _REG.histogram(
 BATCH_FLUSHES = _REG.counter(
     "gsky_batch_flushes_total", "Render-batcher flushes by trigger.",
     ["kind"])
+WAVE_DISPATCHES = _REG.counter(
+    "gsky_wave_dispatches_total",
+    "Wave-scheduler device program invocations by result kind.",
+    ["kind"])
+WAVE_OCCUPANCY = _REG.histogram(
+    "gsky_wave_occupancy",
+    "Requests coalesced per wave dispatch.",
+    buckets=[1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0])
+WAVE_ASSEMBLY_MS = _REG.histogram(
+    "gsky_wave_assembly_ms",
+    "Wave assembly + dispatch-enqueue time (milliseconds).",
+    buckets=log_buckets(0.01, 100.0))
 TRACE_EVENTS = _REG.counter(
     "gsky_trace_events_total",
     "Cross-cutting events (retry, breaker_open, hedge, reroute, shed).",
@@ -386,9 +398,40 @@ def _collect_device():
     return out
 
 
+def _collect_waves():
+    """Wave-scheduler surfaces (docs/PERF.md "Wave-level serving"):
+    readback-queue level plus the counters already kept on the live
+    scheduler object — collected at scrape time, never a second copy.
+    The dispatch/occupancy/assembly distributions are the module-level
+    families above, observed at the dispatch site itself."""
+    out: List = []
+    try:
+        from ..pipeline import waves
+        if waves._default is not None:   # don't boot threads to report
+            st = waves._default.stats()
+            out.append(_g("gsky_wave_readback_queue_depth",
+                          "Wave result blocks awaiting async readback.",
+                          [({}, float(st.get("readback_queue_depth",
+                                             0)))]))
+            out.append(_c("gsky_wave_requests_total",
+                          "Requests submitted to the wave scheduler.",
+                          [({}, float(st.get("requests", 0)))]))
+            out.append(_c("gsky_wave_fallbacks_total",
+                          "Wave entries served via their per-call leg "
+                          "after a device incident.",
+                          [({}, float(st.get("fallbacks", 0)))]))
+            out.append(_c("gsky_wave_cancelled_total",
+                          "Wave entries dropped at assembly or "
+                          "readback for request cancellation.",
+                          [({}, float(st.get("cancelled", 0)))]))
+    except Exception:
+        pass
+    return out
+
+
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
             _collect_runtime, _collect_batcher, _collect_overload,
-            _collect_ingest, _collect_device):
+            _collect_ingest, _collect_device, _collect_waves):
     _REG.register_collector(_fn)
 
 

@@ -379,3 +379,117 @@ def render_byte_paged_raced(pool, tables, params, ctrls, sps, method,
                          extra=(bool(auto), int(colour_scale)))
     return run_with_fallback("warp_render_paged", _pallas, xla_thunk,
                              sync_token=token)
+
+
+# ---------------------------------------------------------------------------
+# wave-level serving: output ring + stacked drill reduction
+# ---------------------------------------------------------------------------
+#
+# The wave dispatcher (pipeline/waves.py) coalesces every eligible
+# request of a scheduler tick into ONE paged program invocation.  Two
+# device-side pieces live here next to the kernels they feed:
+#
+# - `OutputRing`: a persistent on-device output buffer per result lane
+#   ((h, w) uint8 tiles, (n_ns, h, w) f32 canvases, ...).  Each wave's
+#   result block is written into the ring with a DONATED
+#   dynamic_update_slice (the previous ring buffer's storage is reused
+#   in place, so steady-state waves allocate nothing), and the rows
+#   just written are sliced back out as the device handle the readback
+#   queue drains asynchronously.  Ordering is safe without host
+#   synchronisation because take(k) enqueues on the same device stream
+#   BEFORE the next put: by the time a later wave's donated write
+#   lands, the slice that reads the old rows has already executed.
+# - `wave_drill_stats`: the drill reduction over a stacked (K, B, N)
+#   wave — per-row independent (axis=-1 masked mean), so a wave of K
+#   drill requests is bit-identical to K per-call dispatches.
+
+
+def wave_ring_rows() -> int:
+    """Output-ring capacity in result rows (GSKY_WAVE_RING, default
+    64): must cover at least one max-size wave; blocks larger than the
+    ring bypass it (fresh allocation, correct but unamortised)."""
+    try:
+        r = int(os.environ.get("GSKY_WAVE_RING", "64"))
+    except ValueError:
+        r = 64
+    return max(2, min(1024, r))
+
+
+@functools.lru_cache(maxsize=1)
+def _ring_put_fn():
+    """Donated ring write: buf[base:base+n] = blk, reusing buf's
+    storage in place.  Donation is skipped on the CPU backend (XLA:CPU
+    ignores aliasing hints and warns on every call)."""
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(
+        lambda buf, blk, base: jax.lax.dynamic_update_slice_in_dim(
+            buf, blk, base, axis=0),
+        donate_argnums=donate)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _ring_take(buf, base, n: int):
+    """Slice the n rows just written back out of the ring — enqueued
+    on the device stream before any later put, so the donated
+    overwrite can never clobber rows a reader still needs."""
+    return jax.lax.dynamic_slice_in_dim(buf, base, n, axis=0)
+
+
+class OutputRing:
+    """Per-lane on-device output ring for wave results.
+
+    A lane is one (tail shape, dtype) — e.g. every (256, 256) uint8
+    tile wave shares a lane regardless of wave size.  `put(block)`
+    writes block's rows at the cursor (wrapping to 0 when the block
+    would run off the end — rows are never split) and returns the
+    device slice holding exactly those rows.  Thread-safe; the wave
+    scheduler calls it from the ticker thread only, but `stats()` is
+    read from scrape threads."""
+
+    def __init__(self, rows: int | None = None):
+        self.rows = int(rows) if rows else wave_ring_rows()
+        self._bufs = {}      # (tail_shape, dtype str) -> device buf
+        self._cursor = {}    # same key -> next free row
+        self._lock = __import__("threading").Lock()
+        self.writes = 0
+        self.bypassed = 0
+
+    def put(self, block):
+        """block (n, ...) on device -> device array of the same shape,
+        backed by ring storage (or block itself when n > rows)."""
+        n = int(block.shape[0])
+        tail = tuple(int(d) for d in block.shape[1:])
+        key = (tail, str(block.dtype))
+        with self._lock:
+            if n > self.rows:
+                self.bypassed += 1
+                return block
+            buf = self._bufs.get(key)
+            if buf is None:
+                buf = jnp.zeros((self.rows,) + tail, block.dtype)
+                self._cursor[key] = 0
+            base = self._cursor[key]
+            if base + n > self.rows:
+                base = 0
+            self._cursor[key] = base + n
+            out = _ring_put_fn()(buf, block, jnp.int32(base))
+            self._bufs[key] = out
+            self.writes += 1
+            return _ring_take(out, jnp.int32(base), n)
+
+    def stats(self):
+        with self._lock:
+            return {"rows": self.rows, "lanes": len(self._bufs),
+                    "writes": self.writes, "bypassed": self.bypassed}
+
+
+@functools.partial(jax.jit, static_argnames=("pixel_count",))
+def wave_drill_stats(data, valid, clip_lower=-3.0e38, clip_upper=3.0e38,
+                     pixel_count: bool = False):
+    """Stacked drill reduction: data/valid (K, B, N) -> (vals (K, B)
+    f32, counts (K, B) int32).  The masked mean reduces over axis=-1
+    only, so each wave row is independent and the stacked program is
+    bit-identical to K per-call `masked_mean` dispatches."""
+    from .drill import masked_mean_impl
+    return masked_mean_impl(data, valid, clip_lower, clip_upper,
+                            pixel_count, jnp)

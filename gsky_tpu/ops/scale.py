@@ -52,6 +52,15 @@ def scale_to_byte(data, valid, offset=0.0, scale=0.0, clip=0.0,
     data = data.astype(jnp.float32)
     if colour_scale == 1:  # log10 colour scale (ColourLogScale)
         logged = jnp.log10(data)
+        # f32 log10 lands a ulp BELOW exact decades (log10(10) =
+        # 0.99999994), and the byte quantization floors — an exact
+        # decade input would drop a whole byte level.  Snap values
+        # within a few ulp of an integer back onto it; only inputs
+        # already indistinguishable from a decade at f32 move.
+        snapped = jnp.round(logged)
+        logged = jnp.where(jnp.abs(logged - snapped) <= 4.8e-7
+                           * jnp.maximum(1.0, jnp.abs(snapped)),
+                           snapped, logged)
         bad = ~jnp.isfinite(logged)
         data = jnp.where(bad, 0.0, logged)
         valid = valid & ~bad

@@ -290,7 +290,32 @@ class RenderBatcher:
         buckets.  ``tables`` arrives PINNED (executor's
         `_paged_from_group`); the flush unpins after enqueue.
         ``fallback`` is (stack, params11, win, win0) for the race's
-        per-tile bucketed XLA leg."""
+        per-tile bucketed XLA leg.
+
+        Wave subsumption (GSKY_WAVES, pipeline/waves.py): when the
+        wave scheduler is live, batcher flushes are subsumed by wave
+        ticks — the executor routes eligible tiles to the wave path
+        before the batching check, and a direct caller landing here
+        joins the current wave instead of opening a batcher group
+        (same ragged stacking, same unpin contract, plus the wave's
+        cross-KIND coalescing and async readback)."""
+        from .waves import active_waves, waves_enabled
+        w = active_waves() if waves_enabled() else None
+        if w is not None:
+            def _percall():
+                from .. import device_guard
+                from ..ops.warp import render_scenes_ctrl
+                from .executor import _dev_win0    # lazy: avoids cycle
+                stack, bparams, bwin, bwin0 = fallback
+                return np.asarray(device_guard.run(
+                    "dispatch.bucketed",
+                    lambda: render_scenes_ctrl(
+                        stack, jnp.asarray(ctrl), jnp.asarray(bparams),
+                        jnp.asarray(sp), *statics, win=bwin,
+                        win0=_dev_win0(bwin0))))
+
+            return w.render_byte(pool, tables, params16, ctrl, sp,
+                                 statics, fallback, _percall)
         fut: Future = Future()
         item = (pool, tables, params16, ctrl, sp, int(real_pages),
                 fallback, fut)

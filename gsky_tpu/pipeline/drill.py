@@ -584,7 +584,17 @@ def _stats_tail(dataf, validf, req: GeoDrillRequest):
             pixel_count=req.pixel_count)
         return np.asarray(v), np.asarray(c)
 
-    if not req.pixel_count:
+    from .waves import default_waves, waves_enabled
+    if waves_enabled():
+        # wave path: concurrent drills over the same bucketed shape
+        # stack into ONE (K, B, N) device reduction per scheduler tick
+        # (the reduction is per-row independent, so the stacked result
+        # is bit-identical to per-call); the per-call XLA leg is the
+        # incident failover
+        vals, counts = default_waves().drill_stats(
+            dataf, validf, float(req.clip_lower),
+            float(req.clip_upper), bool(req.pixel_count), _via_xla)
+    elif not req.pixel_count:
         # sync_token engages the fallback guard's first-call speed race
         # too: at deep-stack shapes (1000, 16k) the pallas reduction is
         # the prime suspect for the r5 on-chip warm-drill outlier, and

@@ -542,6 +542,34 @@ class WarpExecutor:
                 if made_p is not None:
                     pool, tables, params16, _ = made_p
                     self._note_paged(True)
+                    from .waves import default_waves, waves_enabled
+                    if waves_enabled():
+                        # wave path: enqueue to the tick scheduler —
+                        # this mosaic shares ONE stacked paged program
+                        # with whatever else the wave carries
+                        self._count("scene_mosaic_wave", tables.shape)
+                        ctrl_host = groups[0][1]
+                        from .. import device_guard
+
+                        def _percall():
+                            # incident failover: this request alone,
+                            # through the bucketed per-call leg
+                            c, b = device_guard.run(
+                                "dispatch.bucketed",
+                                lambda: warp_scored_raced(
+                                    stack, ctrl_dev,
+                                    jnp.asarray(params), method,
+                                    n_pad, (height, width), step,
+                                    win=win,
+                                    win0_dev=_dev_win0(win0)))
+                            return (np.asarray(c),
+                                    np.asarray(b) > -np.inf)
+
+                        c, v = default_waves().warp_scored(
+                            pool, tables, params16, ctrl_host,
+                            (method, n_pad, (height, width), step),
+                            (stack, params, win, win0), _percall)
+                        return jnp.asarray(c), jnp.asarray(v)
                     self._count("scene_mosaic_paged", tables.shape)
                     from ..ops.paged import warp_scored_paged_raced
 
@@ -631,6 +659,27 @@ class WarpExecutor:
             if made_p is not None:
                 pool, tables, params16, real_pages = made_p
                 self._note_paged(True)
+                from .waves import default_waves, waves_enabled
+                if waves_enabled():
+                    # wave path: every eligible request of the tick —
+                    # tiles of ANY shape, plus drills — shares the
+                    # dispatch; checked before batching because wave
+                    # ticks subsume the batcher's flush entirely
+                    self._count("render_byte_wave", tables.shape)
+                    from .. import device_guard
+
+                    def _percall():
+                        out = device_guard.run(
+                            "dispatch.bucketed",
+                            lambda: render_byte_raced(
+                                stack, ctrl_dev, jnp.asarray(params),
+                                jnp.asarray(sp), *statics, win=win,
+                                win0_dev=_dev_win0(win0)))
+                        return np.asarray(out)
+
+                    return default_waves().render_byte(
+                        pool, tables, params16, ctrl, sp, statics,
+                        (stack, params, win, win0), _percall)
                 if batching_enabled():
                     # the paged batch key carries NO stack/shape
                     # identity: tiles over different scene sets and

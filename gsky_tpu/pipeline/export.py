@@ -489,4 +489,16 @@ class ExportPipeline:
         refs = self.stats.get("granule_tile_refs", 0)
         self.stats["dedup_saved"] = max(
             0, refs - int(self.stats.get("scenes_warmed", 0)))
+        # wave engagement: export blocks render through the executor,
+        # so under GSKY_WAVES the warp stage's tiles share wave
+        # dispatches with concurrent WMS/drill traffic — surface the
+        # scheduler's amortisation alongside the export's own numbers
+        try:
+            from .waves import wave_stats
+            wst = wave_stats()
+            if wst:
+                self.stats["wave_dispatches"] = wst.get("dispatches", 0)
+                self.stats["wave_requests"] = wst.get("requests", 0)
+        except Exception:
+            pass
         return self.stats

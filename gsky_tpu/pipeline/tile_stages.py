@@ -180,6 +180,7 @@ def _dispatch_stage(dispatch, spans: Dict):
     device->host transfer is already in flight — the next request's
     dispatch overlaps this one's readback."""
     from .batcher import batching_enabled
+    from .waves import waves_enabled
     from ..ingest import stats as ingest_stats
     check_cancel("dispatch")
     t0 = time.perf_counter()
@@ -194,12 +195,14 @@ def _dispatch_stage(dispatch, spans: Dict):
             except Exception:
                 compile_count, c0 = None, 0
             try:
-                if batching_enabled():
-                    # the batcher NEEDS concurrent arrivals to coalesce
-                    # into one vmapped dispatch; a narrow gate here would
-                    # serialize them and defeat it, so batching mode
-                    # keeps its own admission
-                    sp.set(batched=True)
+                if batching_enabled() or waves_enabled():
+                    # the batcher/wave scheduler NEEDS concurrent
+                    # arrivals to coalesce into one dispatch; a narrow
+                    # gate here would serialize them and defeat it, so
+                    # both modes keep their own admission (wave size +
+                    # brownout clamp for waves)
+                    sp.set(batched=batching_enabled(),
+                           waved=waves_enabled())
                     return dispatch()
                 with _gate("dispatch").enter(spans, "dispatch_queue_max"):
                     # re-check AFTER the gate wait: the client may have

@@ -1,0 +1,605 @@
+"""Wave-level device serving: amortise the per-dispatch host tax
+across whole admission waves.
+
+BENCH_r05 (PERF.md) measured the per-dispatch overhead on a real v5e:
+a 256px mosaic tile costs ~78.8 ms synchronous against ~12.8 ms
+pipelined, and a 1000-point drill ~73.4 ms against ~4.7 ms — the
+device is idle most of every request; the ~75 ms is host-side dispatch
+tax (upload enqueue, program launch, sync) paid PER CALL.  The ragged
+paged kernels (ops/paged.py) already serve any tile shape from one
+program, so nothing but the call convention forces tax-per-tile.
+
+This module stops dispatching per tile/drill.  Every scheduler tick,
+everything currently eligible — WMS tile renders, drill reductions,
+WCS export blocks, mixed — is coalesced into one paged program
+invocation per result kind:
+
+- requests enqueue a wave entry (payload + per-request completion
+  future) and block on the future, cancellation-aware;
+- a ticker thread waits ``GSKY_WAVE_TICK_MS`` for companions, then
+  drains up to ``GSKY_WAVE_MAX`` entries (clamped by the brownout
+  level under pressure), drops cancelled entries at assembly, groups
+  by (kind, statics, pool), and dispatches each group as ONE stacked
+  paged program over the PR 8 page pool — page tables and param rows
+  stacked exactly like `RenderBatcher._execute_paged`, padding rows
+  carrying ns_id -1 so every real row is bit-independent of its wave
+  companions;
+- results land in an on-device `OutputRing` (donated in/out buffers,
+  ops/paged.py) and a readback queue drains them asynchronously on a
+  second thread (`device_guard.guarded_readback`), so consumers in
+  `tile_stages` / `export` / `drill` never block the NEXT wave's
+  dispatch;
+- every group dispatch runs under `device_guard.run("dispatch.wave")`
+  supervision; an incident fails the wave's requests over
+  INDIVIDUALLY (each entry re-renders through its per-call bucketed
+  closure), never as a wave.
+
+A tick that carries both tiles and drills dispatches one program per
+(kind, statics) group — the mixed wave amortises the tick, admission
+and readback machinery; kinds cannot share one XLA program without a
+mega-kernel.  ``GSKY_WAVES=0`` restores per-call dispatch
+byte-identically: the wave branch sits strictly above the existing
+entry points, and the stacked kernels are bit-exact per row (nearest)
+against their per-call forms — see tests/test_waves.py.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import CancelledError, Future
+from concurrent.futures import TimeoutError as _FutTimeout
+from queue import Empty, Queue
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import device_guard
+from ..obs.metrics import (WAVE_ASSEMBLY_MS, WAVE_DISPATCHES,
+                           WAVE_OCCUPANCY)
+
+
+def waves_enabled() -> bool:
+    """Wave dispatch gate: on by default wherever the paged kernels
+    serve (GSKY_PAGED + pallas available); GSKY_WAVES=0 restores
+    per-call dispatch byte-identically.  Plain-CPU XLA serving keeps
+    per-call dispatch — the wave stacking rides the paged programs."""
+    from ..ops.paged import paged_enabled
+    return os.environ.get("GSKY_WAVES", "1") != "0" and paged_enabled()
+
+
+def wave_max() -> int:
+    """Hard cap on entries per wave (GSKY_WAVE_MAX, default 16) —
+    bounds the stacked program's memory footprint and the blast radius
+    of one device incident."""
+    try:
+        v = int(os.environ.get("GSKY_WAVE_MAX", "16"))
+    except ValueError:
+        v = 16
+    return max(1, min(64, v))
+
+
+def wave_tick_ms() -> float:
+    """Coalescing window (GSKY_WAVE_TICK_MS, default 2 ms): how long
+    the ticker waits for companions after the first entry arrives.
+    Zero dispatches back-to-back (still coalescing whatever queued
+    while the previous wave ran)."""
+    try:
+        v = float(os.environ.get("GSKY_WAVE_TICK_MS", "2"))
+    except ValueError:
+        v = 2.0
+    return max(0.0, min(100.0, v))
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class _Entry:
+    __slots__ = ("kind", "key", "payload", "fallback", "future",
+                 "token", "cleanup", "_cleaned", "t_enq")
+
+    def __init__(self, kind, key, payload, fallback, token, cleanup):
+        self.kind = kind
+        self.key = key
+        self.payload = payload
+        self.fallback = fallback
+        self.future: Future = Future()
+        self.token = token
+        self.cleanup = cleanup
+        self._cleaned = cleanup is None
+        self.t_enq = time.perf_counter()
+
+    def cleanup_once(self):
+        if not self._cleaned:
+            self._cleaned = True
+            try:
+                self.cleanup()
+            except Exception:   # pragma: no cover - unpin best-effort
+                pass
+
+
+class WaveScheduler:
+    """Tick-based wave assembly over the paged kernels.
+
+    Threads start lazily on first submit (a server that never enables
+    waves never pays for them) and are daemons: process exit never
+    hangs on a drained queue."""
+
+    def __init__(self, max_entries: Optional[int] = None,
+                 tick_ms: Optional[float] = None,
+                 ring_rows: Optional[int] = None):
+        from ..ops.paged import OutputRing
+        self._max = max_entries
+        self._tick_ms = tick_ms
+        self.ring = OutputRing(ring_rows)
+        self._lock = threading.Lock()
+        self._pending: List[_Entry] = []
+        self._kick = threading.Event()
+        self._stop = threading.Event()
+        self._readback_q: Queue = Queue()
+        self._ticker: Optional[threading.Thread] = None
+        self._drainer: Optional[threading.Thread] = None
+        # counters (under _lock)
+        self.dispatches = 0          # device program invocations
+        self.waves = 0               # scheduler ticks that dispatched
+        self.requests = 0            # entries submitted
+        self.fallbacks = 0           # entries served via per-call leg
+        self.cancelled = 0           # entries dropped for cancellation
+        self.occupancy: Dict[int, int] = {}   # group size -> count
+        self.readback_depth_max = 0
+        self.assembly_ms_last = 0.0
+
+    # -- knobs ---------------------------------------------------------
+
+    def _wave_max(self) -> int:
+        return self._max if self._max else wave_max()
+
+    def _tick_s(self) -> float:
+        ms = self._tick_ms if self._tick_ms is not None \
+            else wave_tick_ms()
+        return ms / 1e3
+
+    def _effective_max(self) -> int:
+        """Brownout/pressure clamp: a degraded device gets smaller
+        waves (same shape as the batcher's OOM knee ratchet)."""
+        m = self._wave_max()
+        try:
+            from ..resilience.pressure import brownout_level
+            lv = brownout_level()
+        except Exception:   # pragma: no cover - pressure optional
+            lv = 0
+        if lv >= 2:
+            return max(1, m // 4)
+        if lv == 1:
+            return max(1, m // 2)
+        return m
+
+    # -- submission ----------------------------------------------------
+
+    def _submit(self, entry: _Entry) -> _Entry:
+        self._ensure_threads()
+        with self._lock:
+            self._pending.append(entry)
+            self.requests += 1
+        self._kick.set()
+        return entry
+
+    @staticmethod
+    def _wait(entry: _Entry):
+        """Block on the entry's future, cancellation-aware: a request
+        whose client disconnected stops waiting within one poll tick
+        while its wave still executes for the surviving companions."""
+        while True:
+            try:
+                return entry.future.result(timeout=0.05)
+            except _FutTimeout:
+                if entry.token is not None:
+                    entry.token.check("wave")
+            except CancelledError:
+                if entry.token is not None:
+                    entry.token.check("wave")
+                raise
+
+    # -- threads -------------------------------------------------------
+
+    def _ensure_threads(self):
+        if self._ticker is not None and self._ticker.is_alive():
+            return
+        with self._lock:
+            if self._ticker is None or not self._ticker.is_alive():
+                self._stop.clear()
+                self._ticker = threading.Thread(
+                    target=self._ticker_loop, name="gsky-wave-ticker",
+                    daemon=True)
+                self._ticker.start()
+            if self._drainer is None or not self._drainer.is_alive():
+                self._drainer = threading.Thread(
+                    target=self._drain_loop, name="gsky-wave-readback",
+                    daemon=True)
+                self._drainer.start()
+
+    def _ticker_loop(self):
+        while not self._stop.is_set():
+            self._kick.wait(timeout=0.25)
+            if self._stop.is_set():
+                return
+            with self._lock:
+                if not self._pending:
+                    self._kick.clear()
+                    continue
+            tick = self._tick_s()
+            if tick > 0:
+                time.sleep(tick)
+            try:
+                self.run_wave()
+            except Exception:   # pragma: no cover - keep ticking
+                pass
+
+    def _drain_loop(self):
+        while True:
+            try:
+                item = self._readback_q.get(timeout=0.25)
+            except Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if item is None:
+                return
+            kind, entries, devs = item
+            try:
+                host = device_guard.guarded_readback(
+                    "wave.readback",
+                    lambda: tuple(np.asarray(d) for d in devs))
+            except Exception as exc:
+                self._failover(entries, exc)
+                continue
+            for i, e in enumerate(entries):
+                if e.token is not None and e.token.cancelled():
+                    with self._lock:
+                        self.cancelled += 1
+                    e.future.cancel()
+                    continue
+                res = host[0][i] if len(host) == 1 \
+                    else tuple(h[i] for h in host)
+                if not e.future.cancelled():
+                    e.future.set_result(res)
+
+    # -- wave assembly -------------------------------------------------
+
+    def run_wave(self) -> int:
+        """Assemble and dispatch one wave from the pending queue.
+        Returns the number of entries dispatched (tests call this
+        directly to step the scheduler deterministically)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            cap = self._effective_max()
+            take = self._pending[:cap]
+            del self._pending[:cap]
+            leftover = bool(self._pending)
+        if leftover:
+            self._kick.set()
+        live: List[_Entry] = []
+        for e in take:
+            if e.token is not None and e.token.cancelled():
+                # cancelled at assembly: release its pages NOW — a
+                # dead request must not ride the wave nor hold pins
+                e.cleanup_once()
+                e.future.cancel()
+                with self._lock:
+                    self.cancelled += 1
+            else:
+                live.append(e)
+        if not live:
+            return 0
+        groups: Dict[tuple, List[_Entry]] = {}
+        for e in live:
+            groups.setdefault((e.kind, e.key), []).append(e)
+        dispatched = 0
+        for (kind, _key), es in groups.items():
+            try:
+                devs = device_guard.run(
+                    "dispatch.wave",
+                    lambda k=kind, g=es: self._dispatch_group(k, g))
+            except Exception as exc:
+                # device incident mid-wave: the wave never fails as a
+                # unit — each request re-renders per-call
+                self._failover(es, exc)
+                continue
+            dispatched += len(es)
+            with self._lock:
+                self.dispatches += 1
+                n = len(es)
+                self.occupancy[n] = self.occupancy.get(n, 0) + 1
+            try:
+                WAVE_DISPATCHES.labels(kind=kind).inc()
+                WAVE_OCCUPANCY.observe(float(len(es)))
+            except Exception:
+                pass
+            self._readback_q.put((kind, es, devs))
+            with self._lock:
+                self.readback_depth_max = max(
+                    self.readback_depth_max, self._readback_q.qsize())
+        if dispatched:
+            with self._lock:
+                self.waves += 1
+                self.assembly_ms_last = (time.perf_counter() - t0) * 1e3
+            try:
+                WAVE_ASSEMBLY_MS.observe(
+                    (time.perf_counter() - t0) * 1e3)
+            except Exception:
+                pass
+        return dispatched
+
+    def _failover(self, entries: List[_Entry], exc: Exception):
+        for e in entries:
+            e.cleanup_once()
+            if e.future.cancelled():
+                continue
+            if e.fallback is None:
+                e.future.set_exception(exc)
+                continue
+            with self._lock:
+                self.fallbacks += 1
+            try:
+                e.future.set_result(e.fallback())
+            except Exception as fe:   # pragma: no cover
+                if not e.future.done():
+                    e.future.set_exception(fe)
+
+    # -- per-kind dispatch ---------------------------------------------
+
+    def _dispatch_group(self, kind: str, es: List[_Entry]):
+        if kind == "byte":
+            return self._dispatch_byte(es)
+        if kind == "scored":
+            return self._dispatch_scored(es)
+        if kind == "drill":
+            return self._dispatch_drill(es)
+        raise ValueError(f"unknown wave kind {kind!r}")
+
+    def _stack_tables(self, es: List[_Entry], Np: int):
+        """Shared ragged stacking: granule axis to the wave's LARGEST
+        tile, page slots likewise; padding rows carry ns_id -1 + a
+        null page table, so they gather nothing and every real row is
+        bit-independent of its companions (the parity property the
+        GSKY_WAVES=0 escape hatch is tested against)."""
+        from ..ops.paged import PARAMS_W
+        N = len(es)
+        T = max(e.payload["tables"].shape[0] for e in es)
+        S = max(e.payload["tables"].shape[1] for e in es)
+        tables = np.zeros((Np, T, S), np.int32)
+        params = np.zeros((Np, T, PARAMS_W), np.float32)
+        params[:, :, 10] = -1.0     # ns_id: padding rows
+        for i, e in enumerate(es):
+            ti, si = e.payload["tables"].shape
+            tables[i, :ti, :si] = e.payload["tables"]
+            params[i, :ti] = e.payload["params16"]
+        return (jnp.asarray(tables),
+                jnp.asarray(params.reshape(Np * T, PARAMS_W)))
+
+    def _dispatch_byte(self, es: List[_Entry]):
+        from ..ops.paged import render_byte_paged_raced
+        pool = es[0].payload["pool"]
+        method, n_ns, out_hw, step, auto, colour_scale = es[0].key[0]
+        try:
+            N = len(es)
+            Np = _pow2(N)
+            tables, params = self._stack_tables(es, Np)
+            ctrls = np.stack([e.payload["ctrl"] for e in es]
+                             + [es[0].payload["ctrl"]] * (Np - N))
+            sps = np.stack([e.payload["sp"] for e in es]
+                           + [es[0].payload["sp"]] * (Np - N))
+
+            def _xla():
+                # per-tile bucketed XLA legs stacked to the wave
+                # contract (runs only when racing or demoted)
+                from ..ops.warp import render_scenes_ctrl
+                from .executor import _dev_win0    # lazy: avoids cycle
+                outs = []
+                for e in es:
+                    stack, bparams, bwin, bwin0 = e.payload["xla"]
+                    outs.append(render_scenes_ctrl(
+                        stack, jnp.asarray(e.payload["ctrl"]),
+                        jnp.asarray(bparams),
+                        jnp.asarray(e.payload["sp"]), method, n_ns,
+                        out_hw, step, auto, colour_scale, win=bwin,
+                        win0=_dev_win0(bwin0)))
+                outs += [outs[0]] * (Np - N)
+                return jnp.stack(outs)
+
+            with pool.locked_pool() as parr:
+                dev = render_byte_paged_raced(
+                    parr, tables, params, jnp.asarray(ctrls),
+                    jnp.asarray(sps), method, n_ns, out_hw, step,
+                    auto, colour_scale, _xla)
+            # the wave pad never reaches the ring or the link
+            return (self.ring.put(dev[:N]),)
+        finally:
+            for e in es:
+                e.cleanup_once()
+
+    def _dispatch_scored(self, es: List[_Entry]):
+        from ..ops.paged import warp_scored_paged_raced
+        pool = es[0].payload["pool"]
+        method, n_ns, out_hw, step = es[0].key[0]
+        try:
+            N = len(es)
+            Np = _pow2(N)
+            tables, params = self._stack_tables(es, Np)
+            ctrls = np.stack([e.payload["ctrl"] for e in es]
+                             + [es[0].payload["ctrl"]] * (Np - N))
+
+            def _xla():
+                from ..ops.warp import warp_scenes_ctrl_scored
+                from .executor import _dev_win0    # lazy: avoids cycle
+                cs, bs = [], []
+                for e in es:
+                    stack, bparams, bwin, bwin0 = e.payload["xla"]
+                    c, b = warp_scenes_ctrl_scored(
+                        stack, jnp.asarray(e.payload["ctrl"]),
+                        jnp.asarray(bparams), method, n_ns, out_hw,
+                        step, win=bwin, win0=_dev_win0(bwin0))
+                    cs.append(c)
+                    bs.append(b)
+                cs += [cs[0]] * (Np - N)
+                bs += [bs[0]] * (Np - N)
+                return jnp.stack(cs), jnp.stack(bs)
+
+            with pool.locked_pool() as parr:
+                canv, best = warp_scored_paged_raced(
+                    parr, tables, params, jnp.asarray(ctrls), method,
+                    n_ns, out_hw, step, _xla)
+            # fold best -> validity ON DEVICE: the -inf invalid marker
+            # must not reach guarded_readback (the integrity probe
+            # treats inf as DMA corruption — correctly, everywhere
+            # else), and the consumer only ever wants the mask
+            valid = best > -jnp.inf
+            return (self.ring.put(canv[:N]), self.ring.put(valid[:N]))
+        finally:
+            for e in es:
+                e.cleanup_once()
+
+    def _dispatch_drill(self, es: List[_Entry]):
+        from ..ops.paged import wave_drill_stats
+        clip_lo, clip_hi, pix = es[0].key[1:]
+        K = len(es)
+        Kp = _pow2(K)
+        # jnp.stack keeps device-resident drill windows on device —
+        # the stacked reduction never pulls pixels to host
+        data = jnp.stack([jnp.asarray(e.payload["data"]) for e in es]
+                         + [jnp.asarray(es[0].payload["data"])]
+                         * (Kp - K))
+        valid = jnp.stack([jnp.asarray(e.payload["valid"])
+                           for e in es]
+                          + [jnp.asarray(es[0].payload["valid"])]
+                          * (Kp - K))
+        vals, counts = wave_drill_stats(data, valid, clip_lo, clip_hi,
+                                        pixel_count=pix)
+        return (self.ring.put(vals[:K]), self.ring.put(counts[:K]))
+
+    # -- public enqueue API --------------------------------------------
+
+    def render_byte(self, pool, tables, params16, ctrl, sp,
+                    statics: tuple, xla_item, percall) -> np.ndarray:
+        """Submit one byte-tile render (windows already staged in the
+        page pool, ``tables`` PINNED — the wave unpins after enqueue).
+        ``xla_item`` is (stack, params11, win, win0) for the race's
+        stacked bucketed leg; ``percall`` re-renders this tile alone
+        (incident failover).  Blocks; returns host uint8 (H, W)."""
+        from ..resilience import current_token
+        e = _Entry("byte", (tuple(statics), id(pool)),
+                   {"pool": pool, "tables": np.asarray(tables),
+                    "params16": np.asarray(params16),
+                    "ctrl": np.asarray(ctrl), "sp": np.asarray(sp),
+                    "xla": xla_item},
+                   percall, current_token(),
+                   cleanup=lambda: pool.unpin(tables))
+        return self._wait(self._submit(e))
+
+    def warp_scored(self, pool, tables, params16, ctrl,
+                    statics: tuple, xla_item, percall):
+        """Submit one scored mosaic (the warp_mosaic_scenes paged
+        contract).  Blocks; returns host (canv (n_ns, h, w) f32,
+        valid (n_ns, h, w) bool) — the -inf best plane is folded to
+        its validity mask on device before readback."""
+        from ..resilience import current_token
+        e = _Entry("scored", (tuple(statics), id(pool)),
+                   {"pool": pool, "tables": np.asarray(tables),
+                    "params16": np.asarray(params16),
+                    "ctrl": np.asarray(ctrl), "xla": xla_item},
+                   percall, current_token(),
+                   cleanup=lambda: pool.unpin(tables))
+        return self._wait(self._submit(e))
+
+    def drill_stats(self, data, valid, clip_lower: float,
+                    clip_upper: float, pixel_count: bool, percall):
+        """Submit one drill reduction: data/valid (B, N).  Requests
+        sharing (shape, clips, mode) stack into one (K, B, N) device
+        reduction.  Blocks; returns (vals (B,) f32, counts (B,))."""
+        from ..resilience import current_token
+        e = _Entry("drill",
+                   (tuple(int(d) for d in data.shape),
+                    float(clip_lower), float(clip_upper),
+                    bool(pixel_count)),
+                   {"data": data, "valid": valid},
+                   percall, current_token(), cleanup=None)
+        return self._wait(self._submit(e))
+
+    # -- lifecycle / introspection -------------------------------------
+
+    def shutdown(self):
+        """Stop the threads; leftover pending entries fail over to
+        their per-call legs so no request is stranded."""
+        with self._lock:
+            leftover = self._pending[:]
+            self._pending.clear()
+        if leftover:
+            self._failover(leftover,
+                           RuntimeError("wave scheduler shut down"))
+        self._stop.set()
+        self._kick.set()
+        self._readback_q.put(None)
+        for t in (self._ticker, self._drainer):
+            if t is not None and t.is_alive():
+                t.join(timeout=2.0)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            occ = dict(sorted(self.occupancy.items()))
+            return {"enabled": True,
+                    "wave_max": self._wave_max(),
+                    "tick_ms": self._tick_ms if self._tick_ms
+                    is not None else wave_tick_ms(),
+                    "dispatches": self.dispatches,
+                    "waves": self.waves,
+                    "requests": self.requests,
+                    "fallbacks": self.fallbacks,
+                    "cancelled": self.cancelled,
+                    "occupancy": occ,
+                    "assembly_ms_last": round(self.assembly_ms_last,
+                                              3),
+                    "readback_queue_depth": self._readback_q.qsize(),
+                    "readback_depth_max": self.readback_depth_max,
+                    "ring": self.ring.stats()}
+
+
+# -- module singleton ---------------------------------------------------
+
+_default: Optional[WaveScheduler] = None
+_default_lock = threading.Lock()
+
+
+def default_waves() -> WaveScheduler:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = WaveScheduler()
+    return _default
+
+
+def active_waves() -> Optional[WaveScheduler]:
+    """The live scheduler or None — never instantiates (collectors and
+    the batcher's delegation probe must not boot threads)."""
+    return _default
+
+
+def wave_stats() -> Dict:
+    """Scrape-safe stats: {} until the first wave request."""
+    return {} if _default is None else _default.stats()
+
+
+def reset_waves():
+    """Tear down the singleton (tests / config reload)."""
+    global _default
+    with _default_lock:
+        w = _default
+        _default = None
+    if w is not None:
+        w.shutdown()

@@ -344,6 +344,17 @@ class MetricsLogger:
         except Exception:   # observability must never fail a request
             pass
         try:
+            # wave-dispatch coalescing: requests vs device programs,
+            # occupancy histogram, readback queue depth — the "is the
+            # ~75 ms dispatch tax actually being amortised" block
+            # (docs/PERF.md); {} until the first wave request
+            from ..pipeline.waves import wave_stats
+            ws = wave_stats()
+            if ws:
+                out["waves"] = ws
+        except Exception:   # observability must never fail a request
+            pass
+        try:
             # per-node health states, routed/hedged/re-routed counts,
             # ring generation — one entry per live fleet router
             from ..fleet import fleet_stats

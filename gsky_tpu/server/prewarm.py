@@ -27,20 +27,32 @@ zero-recompile assertions in tests/test_tile_pipeline.py and
 
 Under paged serving (GSKY_PAGED on a pallas-capable backend,
 ops/paged.py) the single-band sweep collapses: instead of one program
-per (batch-pow2 x window-bucket) point, prewarm compiles the handful
-of ragged paged variants — (method, granule-pow2, page-slot-pow2) —
-and those programs serve EVERY tile/window shape, which is what lets
-`tools/soak.py --scenario burst` hold fresh compiles to a small
-constant under a heterogeneous-shape storm (docs/PERF.md).
+per (batch-pow2 x window-bucket) point, prewarm compiles the ragged
+paged lattice — (method, granule-pow2, page-slot-pow2, wave-size-pow2)
+— and those programs serve EVERY tile/window shape, which is what
+lets `tools/soak.py --scenario burst` hold fresh compiles to a small
+constant under a heterogeneous-shape storm (docs/PERF.md).  The
+wave-size axis covers the stacked programs the wave scheduler
+(pipeline/waves.py) dispatches: each wave of N tiles pads N to pow2
+and that pad IS the leading compile dim, so sweeping pow2 wave sizes
+up to GSKY_WAVE_MAX means the first mosaic storm after a deploy rides
+warm programs at every occupancy the scheduler can assemble.
 
 Knobs: GSKY_PREWARM=0 disables; GSKY_PREWARM_SIZES (tile edges,
 default "256"), GSKY_PREWARM_BUCKET (scene bucket edge, default 512),
-GSKY_PREWARM_MAX_SCENES (largest batched scene count, pow2, default 2).
+GSKY_PREWARM_MAX_SCENES (largest batched scene count, pow2, default 2),
+GSKY_PREWARM_WAVE_SIZES (wave-size lattice, default the pow2 ladder
+up to GSKY_WAVE_MAX when waves are live, else "1" — cap it to bound
+prewarm time on interpret backends).
 
-Caveat: windowed-gather program shapes are data-dependent (the window
-is bounded per granule set), so prewarm covers the win=None variants —
-exactly what CPU serving and the batched path dispatch; on TPU the
-first windowed request per bucket may still compile once.
+Caveat: on the BUCKETED path windowed-gather program shapes are
+data-dependent (the window is bounded per granule set), so prewarm
+covers the win=None variants — exactly what CPU serving and the
+batched path dispatch; on TPU the first windowed bucketed request per
+bucket may still compile once.  Paged serving has no such hole: the
+page-table contract erases the window axis from the compile key, so
+the lattice sweep below is COMPLETE — wave-stacked or per-call, the
+first storm hits only warm programs.
 """
 
 from __future__ import annotations
@@ -127,6 +139,28 @@ def _env_list(name: str, default: str) -> List[int]:
                 out.append(int(tok))
             except ValueError:
                 pass
+    return out
+
+
+def wave_size_lattice() -> List[int]:
+    """Pow2 wave sizes the paged sweep covers (the leading compile dim
+    of every stacked wave program).  GSKY_PREWARM_WAVE_SIZES overrides
+    (comma list, clamped to [1, 64]); default is the full pow2 ladder
+    up to `wave_max()` when wave dispatch is live, else just 1 — the
+    per-call leading dim the executor uses without waves."""
+    env = os.environ.get("GSKY_PREWARM_WAVE_SIZES", "")
+    if env:
+        sizes = sorted({max(1, min(64, v))
+                        for v in _env_list("GSKY_PREWARM_WAVE_SIZES",
+                                           "")})
+        return sizes or [1]
+    from ..pipeline.waves import wave_max, waves_enabled
+    if not waves_enabled():
+        return [1]
+    out, w = [], 1
+    while w <= wave_max():
+        out.append(w)
+        w *= 2
     return out
 
 
@@ -236,14 +270,18 @@ def prewarm(configs: Dict,
                               for b in range(1, max_scenes + 1)})
             if n_exprs == 1 and paged_enabled():
                 # paged serving collapses the shape sweep: one program
-                # per (statics, granule-pow2 T, page-slot-pow2 S) point
-                # serves EVERY tile/window shape (ops/paged.py), so the
-                # sweep is a handful of ragged-pad lattice points
-                # instead of a bucket zoo.  Tables stay all-null (slot
-                # 0): the gather walks real NaN pages, so both race
-                # legs do representative work.  The pool must be the
-                # RUNTIME singleton — its (capacity, PR, PC) shape is
-                # part of the compiled program.
+                # per (statics, granule-pow2 T, page-slot-pow2 S,
+                # wave-size-pow2 W) point serves EVERY tile/window
+                # shape (ops/paged.py), so the sweep is a ragged-pad
+                # lattice instead of a bucket zoo.  The leading dim W
+                # is what the wave scheduler (pipeline/waves.py) pads
+                # each wave to, so covering the pow2 ladder here means
+                # no occupancy the ticker can assemble compiles on the
+                # request path.  Tables stay all-null (slot 0): the
+                # gather walks real NaN pages, so both race legs do
+                # representative work.  The pool must be the RUNTIME
+                # singleton — its (capacity, PR, PC) shape is part of
+                # the compiled program.
                 from ..pipeline.pages import default_page_pool
                 n_pad = _bucket_pow2(1)
                 pool = default_page_pool()
@@ -252,36 +290,47 @@ def prewarm(configs: Dict,
                 slot_sweep = [s for s in (1, 2, 4, 8)
                               if s <= scap and paged_vmem_ok(s, n_pad,
                                                              pr, pc)]
+                waves = wave_size_lattice()
                 for B in batches:
                     stack = jnp.full((B, bh, bw), jnp.nan, jnp.float32)
                     params = jnp.asarray(_params(B, bh, bw))
-
-                    def _xla_byte(stack=stack, params=params):
-                        return render_scenes_ctrl(
-                            stack, ctrl, params, sp, method, n_pad,
-                            (hw, hw), step, auto, colour_scale)[None]
-
-                    def _xla_scored(stack=stack, params=params):
-                        c, b = warp_scenes_ctrl_scored(
-                            stack, ctrl, params, method, n_pad,
-                            (hw, hw), step)
-                        return c[None], b[None]
-
                     for S in slot_sweep:
-                        tables = jnp.zeros((1, B, S), jnp.int32)
                         p16 = np.zeros((B, 16), np.float32)
                         p16[:, :11] = np.asarray(_params(B, bh, bw))
                         p16[:, 13] = pr     # 1-page window extents:
                         p16[:, 14] = pc     # real gather work over the
                         p16[:, 15] = 1.0    # null page
-                        with pool.locked_pool() as parr:
-                            run(render_byte_paged_raced, parr, tables,
-                                jnp.asarray(p16), ctrl[None], sp[None],
-                                method, n_pad, (hw, hw), step, auto,
-                                colour_scale, _xla_byte)
-                            run(warp_scored_paged_raced, parr, tables,
-                                jnp.asarray(p16), ctrl[None], method,
-                                n_pad, (hw, hw), step, _xla_scored)
+                        for W in waves:
+                            tables = jnp.zeros((W, B, S), jnp.int32)
+                            p16w = jnp.asarray(np.tile(p16, (W, 1)))
+                            ctrls = jnp.stack([ctrl] * W)
+                            sps = jnp.stack([sp] * W)
+
+                            def _xla_byte(stack=stack, params=params,
+                                          W=W):
+                                one = render_scenes_ctrl(
+                                    stack, ctrl, params, sp, method,
+                                    n_pad, (hw, hw), step, auto,
+                                    colour_scale)
+                                return jnp.stack([one] * W)
+
+                            def _xla_scored(stack=stack,
+                                            params=params, W=W):
+                                c, b = warp_scenes_ctrl_scored(
+                                    stack, ctrl, params, method,
+                                    n_pad, (hw, hw), step)
+                                return (jnp.stack([c] * W),
+                                        jnp.stack([b] * W))
+
+                            with pool.locked_pool() as parr:
+                                run(render_byte_paged_raced, parr,
+                                    tables, p16w, ctrls, sps, method,
+                                    n_pad, (hw, hw), step, auto,
+                                    colour_scale, _xla_byte)
+                                run(warp_scored_paged_raced, parr,
+                                    tables, p16w, ctrls, method,
+                                    n_pad, (hw, hw), step,
+                                    _xla_scored)
             elif n_exprs == 1:
                 n_pad = _bucket_pow2(1)
                 for B in batches:

@@ -180,6 +180,59 @@ def test_default_registry_renders_parseable():
     assert "gsky_stage_seconds" in fams
 
 
+def test_wave_families_render_parse_roundtrip():
+    """The wave-scheduler families — kind-labelled dispatch counter,
+    occupancy/assembly histograms, and the collector-backed queue
+    depth + totals that only report while a scheduler is live — must
+    round-trip the strict parser with correct types and values."""
+    from gsky_tpu.obs.metrics import (WAVE_ASSEMBLY_MS, WAVE_DISPATCHES,
+                                      WAVE_OCCUPANCY, render_metrics)
+    from gsky_tpu.pipeline import waves
+    waves.reset_waves()
+    # module families accumulate for the process: assert on deltas
+    base = parse_exposition(render_metrics())
+    assert "gsky_wave_readback_queue_depth" not in base  # no scheduler
+
+    def val(fams, fam, name, labels=()):
+        if fam not in fams:
+            return 0.0
+        return fams[fam]["samples"].get((name, labels), 0.0)
+
+    WAVE_DISPATCHES.labels(kind="byte").inc()
+    WAVE_DISPATCHES.labels(kind="drill").inc(2)
+    WAVE_OCCUPANCY.observe(3.0)
+    WAVE_ASSEMBLY_MS.observe(0.5)
+    try:
+        waves.default_waves()    # threads stay down until a submit
+        fams = parse_exposition(render_metrics())
+    finally:
+        waves.reset_waves()
+    disp = "gsky_wave_dispatches_total"
+    assert fams[disp]["type"] == "counter"
+    assert val(fams, disp, disp, (("kind", "byte"),)) \
+        - val(base, disp, disp, (("kind", "byte"),)) == 1.0
+    assert val(fams, disp, disp, (("kind", "drill"),)) \
+        - val(base, disp, disp, (("kind", "drill"),)) == 2.0
+    occ = "gsky_wave_occupancy"
+    assert fams[occ]["type"] == "histogram"
+    # 3.0 lands in le=4 (cumulative) but not le=2
+    for le, d in (("2", 0.0), ("4", 1.0), ("+Inf", 1.0)):
+        key = (occ + "_bucket", (("le", le),))
+        assert val(fams, occ, *key) - val(base, occ, *key) == d
+    asm = "gsky_wave_assembly_ms"
+    assert fams[asm]["type"] == "histogram"
+    assert val(fams, asm, asm + "_count") \
+        - val(base, asm, asm + "_count") == 1.0
+    assert fams["gsky_wave_readback_queue_depth"]["type"] == "gauge"
+    assert fams["gsky_wave_readback_queue_depth"]["samples"][
+        ("gsky_wave_readback_queue_depth", ())] == 0.0
+    # the fresh scheduler's lifetime counters all scrape as zero
+    for fam in ("gsky_wave_requests_total", "gsky_wave_fallbacks_total",
+                "gsky_wave_cancelled_total"):
+        assert fams[fam]["type"] == "counter"
+        assert fams[fam]["samples"][(fam, ())] == 0.0
+
+
 # ---------------------------------------------------------------------------
 # trace context
 

@@ -525,6 +525,10 @@ class TestBatcherPaged:
         own per-tile XLA-reference byte tile back, pins release, and
         the pad-waste ledger sees the padded pages."""
         monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        # pin waves off: this test exercises the batcher's OWN flush;
+        # with a live wave scheduler render_paged delegates to it
+        # (pipeline/waves.py) and no batcher flush would happen
+        monkeypatch.setenv("GSKY_WAVES", "0")
         from gsky_tpu.pipeline.batcher import RenderBatcher
         pool = _pool(cap=64)
         b = RenderBatcher(max_batch=4, max_wait_s=10.0)

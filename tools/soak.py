@@ -124,6 +124,20 @@ residency journal.  /metrics must expose the device families through
 the strict parser.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario devicechaos --seconds 20
+
+``--scenario wave``: wave-level device serving (docs/PERF.md "Wave
+dispatch").  ``GSKY_PALLAS=interpret`` engages the paged+wave pipeline
+on CPU; a mixed storm of concurrent GetMaps (single-product fused byte
+path) and WPS geometryDrill reductions must COALESCE: the wave
+scheduler has to show device dispatches well under request count
+(>= 3x amortisation) with at least one multi-entry wave, every
+response must be a clean 200 (zero bare 5xx), a client-disconnect
+volley must drop at least one entry from its wave (the ``cancelled``
+counter) while the surviving companions complete, the page pool must
+end with ZERO pinned pages, and /metrics must expose the wave
+families through the strict parser.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario wave --seconds 20
 """
 
 from __future__ import annotations
@@ -191,7 +205,7 @@ def main(argv=None):
     ap.add_argument("--scenario",
                     choices=("churn", "hot", "wcs", "chaos", "burst",
                              "fleet", "overload", "ingest",
-                             "devicechaos"),
+                             "devicechaos", "wave"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -264,6 +278,18 @@ def main(argv=None):
                 "wcs_max_width": 4096, "wcs_max_height": 4096,
                 "wcs_max_tile_width": 256,
                 "wcs_max_tile_height": 256}],
+            # wave scenario: WPS geometryDrill gives the storm a second
+            # result KIND, so drill reductions ride the same scheduler
+            # ticks as the tile renders (one stacked dispatch per kind)
+            "processes": [{
+                "identifier": "geometryDrill",
+                "title": "Geometry drill",
+                "max_area": 10000,
+                "data_sources": [{
+                    "data_source": root,
+                    "rgb_products": [f"LC08_20200{110 + k}_T1"
+                                     for k in range(B.N_SCENES)]}],
+                "approx": False}],
         }, fp)
     watcher = ConfigWatcher(conf_dir, mas_factory=lambda a: mas_client,
                             install_signal=False)
@@ -320,6 +346,8 @@ def main(argv=None):
         return run_ingest(args, watcher, mas_client, merc, boot)
     if args.scenario == "devicechaos":
         return run_devicechaos(args, watcher, mas_client, merc, boot)
+    if args.scenario == "wave":
+        return run_wave(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -929,6 +957,14 @@ def run_burst(args, watcher, mas_client, merc, boot) -> int:
     # the scenario *is* the staged path — don't let an inherited
     # escape-hatch setting silently soak the serial path instead
     os.environ.pop("GSKY_TILE_PIPELINE", None)
+    # waves OFF: wave occupancy is runtime-nondeterministic and
+    # multiplies the paged compile key (pow2-occupancy x granule x
+    # page-slot), so a waves-on storm could blow the small compile
+    # budget below on lattice points prewarm cannot enumerate ahead of
+    # time.  This scenario's zero-compile claim is about the PER-CALL
+    # paged path; wave-path coverage lives in ``--scenario wave``.
+    os.environ["GSKY_WAVES"] = "0"
+    os.environ["GSKY_PREWARM_WAVE_SIZES"] = "1"
     install_compile_probe()
     # gateway off: a response-cache hit would bypass the pipeline and
     # the zero-compile claim would be about the cache, not the prewarm
@@ -1909,6 +1945,250 @@ def run_ingest(args, watcher, mas_client, merc, boot) -> int:
           and not prefetch["metrics"]["missing"])
     print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
     return 0 if ok else 1
+
+
+def run_wave(args, watcher, mas_client, merc, boot) -> int:
+    """Wave-level device serving: a mixed GetMap + WPS-drill storm
+    whose per-request device programs must coalesce into shared wave
+    dispatches, with a client-disconnect volley dropping entries from
+    their wave (see module docstring for the pass criteria)."""
+    import socket
+    import threading
+    import urllib.parse
+
+    import numpy as np
+
+    from gsky_tpu.geo.crs import EPSG3857, EPSG4326
+    from gsky_tpu.geo.transform import transform_bbox
+    from gsky_tpu.pipeline.waves import wave_stats
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    # interpret mode engages the paged+wave pipeline on CPU; a wide
+    # tick gives concurrent requests a real coalescing window at soak
+    # concurrency, and a modest wave cap bounds the pow2-occupancy
+    # program lattice the interpret backend pays cold during the storm
+    env_overrides = {
+        "GSKY_PALLAS": "interpret",
+        "GSKY_WAVES": "1",
+        "GSKY_WAVE_MAX": "8",
+        "GSKY_WAVE_TICK_MS": "100",
+    }
+    saved_env = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        # gateway off: a response-cache hit would bypass the pipeline
+        # and the amortisation ratio would measure the cache, not the
+        # wave scheduler
+        server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                           metrics=MetricsLogger(), gateway=None)
+        host = boot(server)
+
+        # distinct bboxes at ONE pixel shape / layer / timestamp:
+        # every tile stages its own page tables but shares the wave
+        # statics, so concurrent renders are eligible for the same
+        # byte-wave group; the y grid starts high enough to stay on
+        # data (the scene footprint anchors at ymax, see run_burst)
+        grid = 6
+        frac = np.linspace(0.0, 0.6, grid)
+        frac_y = np.linspace(0.1, 0.6, grid)
+        tiles = [(float(fx), float(fy)) for fx in frac for fy in frac_y]
+        w = merc.width * 0.2
+
+        def getmap_url(fx: float, fy: float) -> str:
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + w},"
+                  f"{merc.ymin + fy * merc.height + w}")
+            return (f"http://{host}/ows?service=WMS&request=GetMap"
+                    f"&version=1.3.0&layers=landsat_burst"
+                    f"&crs=EPSG:3857&bbox={bb}"
+                    f"&width=256&height=256&format=image/png"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        # one small drill polygon over the scene footprint (lon/lat):
+        # the drill band axis is pow2-padded and the window bucketed,
+        # so every concurrent drill lands in the same reduction shape
+        # and stacks into a single (K, B, N) wave group
+        ll = transform_bbox(merc, EPSG3857, EPSG4326)
+        d = 0.03
+        x0 = ll.xmin + 0.35 * (ll.xmax - ll.xmin)
+        y0 = ll.ymax - 0.25 * (ll.ymax - ll.ymin)
+        geom = json.dumps({
+            "type": "FeatureCollection", "features": [{
+                "type": "Feature", "geometry": {
+                    "type": "Polygon", "coordinates": [[
+                        [x0, y0], [x0 + d, y0], [x0 + d, y0 + d],
+                        [x0, y0 + d], [x0, y0]]]}}]})
+        drill_q = urllib.parse.quote(geom)
+
+        def drill_url(i: int) -> str:
+            return (f"http://{host}/ows?service=WPS&request=Execute"
+                    f"&identifier=geometryDrill"
+                    f"&datainputs=geometry={drill_q}")
+
+        lock = threading.Lock()
+        counter = itertools.count()
+        errors: list = []
+
+        def fetch(url: str, kind: str) -> bool:
+            # no faults are injected in this scenario, so every
+            # response must be a flat 200 with the right body — any
+            # error (incl. a clean OGC refusal) fails the soak
+            try:
+                with urllib.request.urlopen(url, timeout=180) as r:
+                    body = r.read()
+                    if r.status != 200:
+                        return False
+                    if kind == "map":
+                        return body[:8] == b"\x89PNG\r\n\x1a\n"
+                    return b"ProcessSucceeded" in body
+            except Exception as exc:   # noqa: BLE001 - reported below
+                with lock:
+                    if len(errors) < 5:
+                        errors.append(f"{kind}: {exc!r:.200}")
+                return False
+
+        # warm lap: one serial request per kind pays scene decode and
+        # the occupancy-1 programs; the storm then pays the larger
+        # pow2-occupancy points as bursts actually materialise (this
+        # scenario asserts coalescing, not compile counts — that is
+        # run_burst's claim)
+        warm_ok = (fetch(getmap_url(*tiles[0]), "map")
+                   and fetch(drill_url(0), "wps"))
+
+        bad = [0]
+        n_req = {"map": 0, "wps": 0}
+
+        def one(_):
+            i = next(counter)
+            # drills are a CLUSTERED minority: consecutive counter
+            # values run near-simultaneously, so a burst of three
+            # drills shares one tick and stacks into one (K, B, N)
+            # reduction instead of three single-entry groups
+            if i % 24 < 3:
+                kind, url = "wps", drill_url(i)
+            else:
+                kind, url = "map", getmap_url(*tiles[i % len(tiles)])
+            ok = fetch(url, kind)
+            with lock:
+                n_req[kind] += 1
+                if not ok:
+                    bad[0] += 1
+
+        # concurrency well past the tick rate: per-request latency is
+        # dominated by the host-side stages (decode, staging, encode),
+        # so filling waves needs enough simultaneous arrivals per
+        # coalescing window.  Free-running worker threads, not batched
+        # ex.map laps — a batch barrier leaves its stragglers to ride
+        # single-entry waves at every batch boundary
+        conc = max(args.conc, 16)
+        t_end = time.time() + args.seconds
+
+        def storm_worker():
+            while time.time() < t_end:
+                one(None)
+
+        storm = [threading.Thread(target=storm_worker)
+                 for _ in range(conc)]
+        for t in storm:
+            t.start()
+        for t in storm:
+            t.join()
+
+        # client-disconnect volley: requests aborted mid-flight must
+        # drop out of their wave (assembly skips them and releases
+        # their pins; an in-flight wave discards their lane at
+        # readback) — the scheduler's `cancelled` counter is the
+        # ground truth either way.  Staggered holds cover both the
+        # queued-entry and the mid-wave window; retried because the
+        # race between token fire and wave assembly is real
+        h, _, p = host.partition(":")
+
+        def disconnect_midflight(hold_s: float):
+            i = next(counter)
+            path = getmap_url(*tiles[i % len(tiles)]).split(host, 1)[1]
+            try:
+                s = socket.create_connection((h, int(p)), timeout=10)
+                try:
+                    s.sendall((f"GET {path} HTTP/1.1\r\n"
+                               f"Host: {host}\r\n"
+                               "Connection: close\r\n\r\n").encode())
+                    time.sleep(hold_s)
+                finally:
+                    s.close()
+            except Exception:   # noqa: BLE001 - volley is best-effort
+                pass
+
+        cancelled0 = wave_stats().get("cancelled", 0)
+        cancel_seen = 0
+        volleys = 0
+        deadline = time.time() + 30
+        while time.time() < deadline and cancel_seen < 1:
+            ths = [threading.Thread(target=disconnect_midflight,
+                                    args=(hold,))
+                   for hold in (0.05, 0.1, 0.2, 0.35, 0.5, 0.8)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            volleys += 1
+            time.sleep(1.5)
+            cancel_seen = wave_stats().get("cancelled", 0) - cancelled0
+
+        # every page the storm pinned must be back: cancelled entries
+        # release at assembly, dispatched waves release after readback
+        from gsky_tpu.pipeline import pages
+        pinned = -1
+        t_end = time.time() + 15
+        while time.time() < t_end:
+            pool = pages._default
+            pinned = (pool.stats().get("pinned", -1)
+                      if pool is not None else 0)
+            if pinned == 0:
+                break
+            time.sleep(0.5)
+
+        ws = wave_stats()
+        occ = ws.get("occupancy", {})
+        max_occ = max([int(k) for k in occ] or [0])
+        dispatches = ws.get("dispatches", 0)
+        requests = ws.get("requests", 0)
+        n_done = sum(n_req.values())
+        metrics = check_metrics(host, require=(
+            "gsky_requests_total", "gsky_request_seconds",
+            "gsky_wave_dispatches_total", "gsky_wave_occupancy",
+            "gsky_wave_requests_total"))
+        trace_rep = slowest_trace_report(host)
+
+        out = {
+            "scenario": "wave",
+            "warm_ok": warm_ok,
+            "requests": n_req, "failed": bad[0],
+            "errors": errors,
+            "amortisation_x": round(requests / max(dispatches, 1), 2),
+            "cancellation": {"seen": cancel_seen, "volleys": volleys},
+            "pool_pinned": pinned,
+            "waves": ws,
+            "metrics": metrics,
+            "slowest_trace": trace_rep,
+        }
+        print(json.dumps(out))
+        ok = (warm_ok and n_done > 0 and bad[0] == 0
+              and dispatches >= 1
+              and requests >= 3 * dispatches
+              and max_occ >= 2
+              and cancel_seen >= 1
+              and pinned == 0
+              and not metrics["missing"])
+        print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+        return 0 if ok else 1
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 if __name__ == "__main__":
