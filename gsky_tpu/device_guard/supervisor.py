@@ -182,14 +182,14 @@ class DeviceSupervisor:
         the device is anything but healthy (pages.table_for hook)."""
         return not guard_enabled() or self.state() == HEALTHY
 
-    def _note(self, kind: str, site: str, exc=None) -> None:
+    def _note(self, kind: str, site: str, exc=None) -> None:  # gskylint: holds-lock
         self.incidents.append({
             "kind": kind, "site": site, "t": round(self._clock(), 3),
             "error": str(exc)[:200] if exc is not None else ""})
         if exc is not None:
             self.last_error = f"{type(exc).__name__}: {exc}"[:200]
 
-    def _mark_suspect(self, kind: str) -> None:
+    def _mark_suspect(self, kind: str) -> None:  # gskylint: holds-lock
         # holds self._lock
         if self._state in (DEAD, REINITIALIZING):
             return
@@ -321,7 +321,7 @@ class DeviceSupervisor:
                 # compiled against the pre-incident device state
                 try:
                     jax.clear_caches()
-                except Exception:
+                except Exception:  # cache clear is best-effort on older jax
                     pass
             supervised_sync(
                 "device.probe",
@@ -437,17 +437,17 @@ def _oom_relief() -> None:
         from ..pipeline import pages
         if pages._default is not None:
             pages._default.trim(0.5)
-    except Exception:
+    except Exception:  # no page pool allocated yet - nothing to trim
         pass
     try:
         from ..resilience.pressure import default_monitor
         default_monitor().escalate()
-    except Exception:
+    except Exception:  # pressure monitor absent - relief is best-effort
         pass
     for fn in list(_oom_hooks):
         try:
             fn()
-        except Exception:
+        except Exception:  # one failing OOM hook must not stop the rest
             pass
 
 

@@ -123,7 +123,7 @@ class SharedResponseCache:
                     " LIMIT -1 OFFSET ?)",
                     (self.max_entries + self._TRIM_EVERY,))
             c.commit()
-        except Exception:
+        except Exception:  # sqlite trim is advisory - a locked db must not fail the read
             pass
 
 

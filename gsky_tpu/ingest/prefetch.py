@@ -123,7 +123,7 @@ class PrefetchPlanner:
         try:
             self._observe(layer, bbox, int(width), int(height),
                           str(crs), time_s)
-        except Exception:
+        except Exception:  # prediction is advisory - never fail the admitted request
             pass
 
     def _observe(self, layer, bbox, width, height, crs, time_s) -> None:

@@ -149,7 +149,7 @@ class HTTPRangeSource(ByteSource):
         if broken:
             try:
                 conn.close()
-            except Exception:
+            except Exception:  # broken conn - close is best-effort
                 pass
             return
         with self._lock:
@@ -158,7 +158,7 @@ class HTTPRangeSource(ByteSource):
                 return
         try:
             conn.close()
-        except Exception:
+        except Exception:  # pool full or closed - drop the conn, close errors are moot
             pass
 
     # -- requests -------------------------------------------------------
@@ -230,7 +230,7 @@ class HTTPRangeSource(ByteSource):
         for c in idle:
             try:
                 c.close()
-            except Exception:
+            except Exception:  # teardown - close errors on idle conns are moot
                 pass
 
 
@@ -338,7 +338,7 @@ def source_for(path: str) -> Optional[ByteSource]:
                 old = _sources_order.pop(0)
                 try:
                     _sources.pop(old).close()
-                except Exception:
+                except Exception:  # evicted source may already be closed
                     pass
     if close_later is not None:
         close_later.close()
@@ -354,5 +354,5 @@ def reset_sources() -> None:
     for s in srcs:
         try:
             s.close()
-        except Exception:
+        except Exception:  # teardown - close errors are moot
             pass

@@ -100,8 +100,13 @@ class ImageRaster:
         try:
             self._img.close()
         except Exception:
+            # a handle torn down twice (cache eviction racing a
+            # context-manager exit) is already closed — nothing to free
             pass
-        self._arr = None
+        # under the decode lock: close() can race a decode thread
+        # still inside _array() via the shared handle cache
+        with self._decode_lock:
+            self._arr = None
 
     def __enter__(self):
         return self

@@ -737,20 +737,24 @@ class GeoTIFFWriter:
         self._closed = True
         e = "<"
         fp = self._fp
-        # shared nodata blob for never-written tiles
-        missing = [k for ty in range(self.tiles_y)
-                   for tx in range(self.tiles_x)
-                   if (k := (ty, tx)) not in self._tiles]
-        if missing:
-            blob = self._encode_block(
-                np.full((self.bands, 1, 1),
-                        self.nodata if self.nodata is not None else 0,
-                        self.dtype))
-            off = self._pos
-            fp.write(blob)
-            self._pos += len(blob)
-            for k in missing:
-                self._tiles[k] = (off, len(blob))
+        # shared nodata blob for never-written tiles; under self._lock —
+        # close() can race a straggling write_tile from a cancelled
+        # export's worker still draining
+        with self._lock:
+            missing = [k for ty in range(self.tiles_y)
+                       for tx in range(self.tiles_x)
+                       if (k := (ty, tx)) not in self._tiles]
+            if missing:
+                blob = self._encode_block(
+                    np.full((self.bands, 1, 1),
+                            self.nodata if self.nodata is not None
+                            else 0,
+                            self.dtype))
+                off = self._pos
+                fp.write(blob)
+                self._pos += len(blob)
+                for k in missing:
+                    self._tiles[k] = (off, len(blob))
 
         dt = self.dtype
         gt_ = self.gt
@@ -870,22 +874,27 @@ class GeoTIFFWriter:
                                 None))
             else:
                 entries.append((tag, typ, cnt, None, data_b))
-        ool_pos = self._pos
-        for i, (tag, typ, cnt, inline, data_b) in enumerate(entries):
-            if data_b is not None:
-                entries[i] = (tag, typ, cnt,
-                              struct.pack(e + "I", ool_pos), None)
-                blobs2.append(data_b)
-                ool_pos += len(data_b)
-        ifd_off = ool_pos
-        for b2 in blobs2:
-            fp.write(b2)
-        fp.write(struct.pack(e + "H", len(entries)))
-        for tag, typ, cnt, inline, _ in entries:
-            fp.write(struct.pack(e + "HHI", tag, typ, cnt) + inline)
-        next_ptr = ifd_off + 2 + 12 * len(entries)
-        fp.write(struct.pack(e + "I", 0))
-        self._pos = next_ptr + 4
+        # the file-position bump shares self._pos with write_tile /
+        # append_overview, so it follows the same lock discipline even
+        # though close() is effectively single-threaded
+        with self._lock:
+            ool_pos = self._pos
+            for i, (tag, typ, cnt, inline, data_b) in \
+                    enumerate(entries):
+                if data_b is not None:
+                    entries[i] = (tag, typ, cnt,
+                                  struct.pack(e + "I", ool_pos), None)
+                    blobs2.append(data_b)
+                    ool_pos += len(data_b)
+            ifd_off = ool_pos
+            for b2 in blobs2:
+                fp.write(b2)
+            fp.write(struct.pack(e + "H", len(entries)))
+            for tag, typ, cnt, inline, _ in entries:
+                fp.write(struct.pack(e + "HHI", tag, typ, cnt) + inline)
+            next_ptr = ifd_off + 2 + 12 * len(entries)
+            fp.write(struct.pack(e + "I", 0))
+            self._pos = next_ptr + 4
         return ifd_off, next_ptr
 
     def __enter__(self):

@@ -128,7 +128,7 @@ async def encode_async(fn, *args, spans: Optional[Dict] = None, **kw):
             try:
                 ENCODE_SECONDS.labels(phase="cpu").observe(cpu[0])
                 ENCODE_SECONDS.labels(phase="wait").observe(wait_s)
-            except Exception:
+            except Exception:  # telemetry only - never fail the encode
                 pass
         ok = True
         return out

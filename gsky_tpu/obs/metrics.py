@@ -85,7 +85,7 @@ def _collect_caches():
                           "Cache hits by cache tier.", hits))
             out.append(_c("gsky_cache_misses_total",
                           "Cache misses by cache tier.", misses))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     try:
         from ..serving import default_gateway
@@ -109,7 +109,7 @@ def _collect_caches():
                           "Requests shed at admission.",
                           [({"service": s}, float(c.get("shed", 0)))
                            for s, c in adm.items()]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     return out
 
@@ -147,7 +147,7 @@ def _collect_fleet():
                           rerouted))
             out.append(_c("gsky_fleet_hedges_total",
                           "Hedged RPCs by outcome.", hedge_rows))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     return out
 
@@ -183,7 +183,7 @@ def _collect_resilience():
                           "Circuit breaker trips by site.",
                           [({"site": s}, float((b or {}).get("opens", 0)))
                            for s, b in breakers.items()]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     return out
 
@@ -195,7 +195,7 @@ def _collect_runtime():
         out.append(_c("gsky_compiles_total",
                       "Backend compiles observed by the jax.monitoring "
                       "probe.", [({}, float(compile_count()))]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     try:
         from ..io.png import encode_pool_stats
@@ -212,7 +212,7 @@ def _collect_runtime():
         out.append(_c("gsky_encode_pool_errors_total",
                       "Encode jobs that raised.",
                       [({}, float(st.get("errors", 0)))]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     try:
         from .recorder import default_recorder
@@ -223,7 +223,7 @@ def _collect_runtime():
         out.append(_c("gsky_traces_slo_violations_total",
                       "Traces past the SLO threshold.",
                       [({}, float(st.get("slo_violations", 0)))]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     return out
 
@@ -259,7 +259,7 @@ def _collect_batcher():
                         float(default_executor.paged_engaged)),
                        ({"outcome": "declined"},
                         float(default_executor.paged_declined))]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     try:
         from ..pipeline import pages
@@ -280,7 +280,7 @@ def _collect_batcher():
             out.append(_c("gsky_page_pool_evictions_total",
                           "LRU page evictions.",
                           [({}, float(st.get("evictions", 0)))]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     return out
 
@@ -309,7 +309,7 @@ def _collect_overload():
                           "tenant/service-class pair.",
                           [({"tenant_class": k}, float(v))
                            for k, v in tenants.items()]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     try:
         from ..resilience import cancel_stats
@@ -320,7 +320,7 @@ def _collect_overload():
                           "pipeline stage.",
                           [({"stage": s}, float(v))
                            for s, v in stages.items()]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     try:
         from ..resilience.pressure import default_monitor
@@ -329,7 +329,7 @@ def _collect_overload():
                       "2 critical).",
                       [({}, float(default_monitor().stats()
                                   .get("state", 0)))]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     return out
 
@@ -360,7 +360,7 @@ def _collect_ingest():
                       "Fraction of ranged-read seconds spent while a "
                       "device dispatch was in flight.",
                       [({}, float(st.get("overlap_ratio", 0.0)))]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     return out
 
@@ -393,7 +393,7 @@ def _collect_device():
                       "Hot pages re-staged into a rebuilt page pool "
                       "from the residency journal.",
                       [({}, float(st.get("rehydrated_pages", 0)))]))
-    except Exception:
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
         pass
     return out
 
@@ -424,14 +424,37 @@ def _collect_waves():
                           "Wave entries dropped at assembly or "
                           "readback for request cancellation.",
                           [({}, float(st.get("cancelled", 0)))]))
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
+        pass
+    return out
+
+
+def _collect_tsan():
+    """Lockset race-sanitizer surfaces (docs/ANALYSIS.md): only the
+    race count — a non-zero value fails the GSKY_TSAN=1 CI soak leg,
+    and scraping it keeps the family parser-proven like every other."""
+    out: List = []
+    try:
+        from .tsan import tsan_stats
+        st = tsan_stats()
+        if st.get("installed") or st.get("enabled"):
+            out.append(_c("gsky_tsan_races_total",
+                          "Data races reported by the lockset "
+                          "sanitizer (GSKY_TSAN=1).",
+                          [({}, float(st.get("races", 0)))]))
+            out.append(_g("gsky_tsan_tracked_vars",
+                          "Shared variables under lockset tracking.",
+                          [({}, float(st.get("tracked_vars", 0)))]))
     except Exception:
+        # scrape-time collectors must never break /metrics
         pass
     return out
 
 
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
             _collect_runtime, _collect_batcher, _collect_overload,
-            _collect_ingest, _collect_device, _collect_waves):
+            _collect_ingest, _collect_device, _collect_waves,
+            _collect_tsan):
     _REG.register_collector(_fn)
 
 

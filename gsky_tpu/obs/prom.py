@@ -302,7 +302,7 @@ class Registry:
         for fn in collectors:
             try:
                 families = list(fn())
-            except Exception:
+            except Exception:  # one bad collector must not break the scrape
                 continue
             for name, mtype, help_, samples in families:
                 if name in seen or not _NAME_RE.match(name):

@@ -108,7 +108,7 @@ class FlightRecorder:
             with self._file_lock:
                 with open(self.trace_file, "a", encoding="utf-8") as fh:
                     fh.write(line + "\n")
-        except Exception:
+        except Exception:  # trace file write is best-effort telemetry
             pass
 
     # -- query --------------------------------------------------------

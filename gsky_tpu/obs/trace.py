@@ -228,7 +228,7 @@ def event(name: str, **attrs) -> None:
         return
     try:
         cur[0].root.event(name, **attrs)
-    except Exception:
+    except Exception:  # tracing must never fail the traced request
         pass
 
 
@@ -277,7 +277,7 @@ def record_span(name: str, dur_s: float, t0: Optional[float] = None,
         sp.t0 = float(t0) if t0 is not None else time.time() - float(dur_s)
         sp.dur_s = float(dur_s)
         trace.add(sp)
-    except Exception:
+    except Exception:  # tracing must never fail the traced request
         pass
 
 
@@ -303,7 +303,7 @@ def start_trace(name: str, process: str = "gateway",
         try:
             from .recorder import default_recorder
             default_recorder().record(trace.to_dict())
-        except Exception:
+        except Exception:  # recorder handoff is best-effort telemetry
             pass
 
 
@@ -363,5 +363,5 @@ def adopt_spans(span_dicts: Optional[Sequence[Dict[str, Any]]]) -> None:
         return
     try:
         cur[0].adopt(span_dicts)
-    except Exception:
+    except Exception:  # adopted remote spans are advisory
         pass

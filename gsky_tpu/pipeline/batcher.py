@@ -94,6 +94,11 @@ class RenderBatcher:
         self.knee = min(max_batch, _knee_cap())
         self._tile_ms: Dict[int, float] = {}   # padded size -> EMA ms
         self._tile_n: Dict[int, int] = {}      # samples per size
+        from ..obs import tsan
+        if tsan.enabled():
+            # lockset tracking across flush timers / request threads
+            # (docs/ANALYSIS.md "Race sanitizer")
+            tsan.track(self, "RenderBatcher")
 
     def _observe(self, np_size: int, n_tiles: int, ms: float) -> None:
         """Fold one executed batch's per-tile latency into the EMA for
@@ -255,7 +260,7 @@ class RenderBatcher:
             try:
                 BATCH_FLUSHES.labels(
                     kind="windowed" if win is not None else "full").inc()
-            except Exception:
+            except Exception:  # prom counter is telemetry only
                 pass
             t0 = time.perf_counter()
             # traced only when flushed from a request thread (the timer
@@ -383,7 +388,7 @@ class RenderBatcher:
                 self.pad_waste_bytes += int(waste)
             try:
                 BATCH_FLUSHES.labels(kind="paged").inc()
-            except Exception:
+            except Exception:  # prom counter is telemetry only
                 pass
 
             def _xla():

@@ -81,7 +81,7 @@ class _HandleCache:
                 # under any future insertion path: close the loser
                 try:
                     h.close()
-                except Exception:
+                except Exception:  # loser handle may already be closed
                     pass
                 h = self._handles[path]
             else:
@@ -91,7 +91,7 @@ class _HandleCache:
                     old = self._order.pop(0)
                     try:
                         self._handles.pop(old).close()
-                    except Exception:
+                    except Exception:  # evicted handle may already be closed
                         pass
         ev.set()
         return h

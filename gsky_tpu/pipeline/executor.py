@@ -42,7 +42,7 @@ def _prefetch(x):
     copies in flight)."""
     try:
         x.copy_to_host_async()
-    except Exception:
+    except Exception:  # backend lacks copy_to_host_async (CPU) - sync pull still works
         pass
     return x
 

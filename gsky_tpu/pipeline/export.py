@@ -499,6 +499,6 @@ class ExportPipeline:
             if wst:
                 self.stats["wave_dispatches"] = wst.get("dispatches", 0)
                 self.stats["wave_requests"] = wst.get("requests", 0)
-        except Exception:
+        except Exception:  # wave stats are advisory telemetry
             pass
         return self.stats

@@ -117,10 +117,15 @@ class PagePool:
         self.trimmed = 0
         self.rehydrated = 0
         self.quarantined = 0
+        from ..obs import tsan
+        if tsan.enabled():
+            # lockset tracking across staging / dispatch / teardown
+            # threads (docs/ANALYSIS.md "Race sanitizer")
+            tsan.track(self, "PagePool")
 
     # -- internals (hold self.lock) -----------------------------------
 
-    def _ensure_pool(self):
+    def _ensure_pool(self):  # gskylint: holds-lock
         if self._pool is None:
             # slot 0 (and every unstaged slot) is all-NaN: a tap into
             # an unstaged page is invalid, never stale garbage
@@ -128,7 +133,7 @@ class PagePool:
                 (self.capacity, self.page_rows, self.page_cols),
                 jnp.nan, jnp.float32)
 
-    def _take_slot(self):
+    def _take_slot(self):  # gskylint: holds-lock
         if self._free:
             return self._free.pop()
         for key in self._slots:    # LRU order: oldest first

@@ -167,7 +167,7 @@ def _decode_stage(pipe, req, granules, spans: Dict) -> None:
             try:
                 pipe.executor.warm_scene(g, dst_gt, req.crs,
                                          req.height, req.width)
-            except Exception:
+            except Exception:  # prewarm is advisory - the render path decodes on miss
                 pass
     spans["decode_s"] = spans.get("decode_s", 0.0) \
         + time.perf_counter() - t0

@@ -154,6 +154,11 @@ class WaveScheduler:
         self.occupancy: Dict[int, int] = {}   # group size -> count
         self.readback_depth_max = 0
         self.assembly_ms_last = 0.0
+        from ..obs import tsan
+        if tsan.enabled():
+            # lockset tracking across the ticker/drainer/request
+            # threads (docs/ANALYSIS.md "Race sanitizer")
+            tsan.track(self, "WaveScheduler")
 
     # -- knobs ---------------------------------------------------------
 
@@ -319,7 +324,7 @@ class WaveScheduler:
             try:
                 WAVE_DISPATCHES.labels(kind=kind).inc()
                 WAVE_OCCUPANCY.observe(float(len(es)))
-            except Exception:
+            except Exception:  # prom telemetry only
                 pass
             self._readback_q.put((kind, es, devs))
             with self._lock:
@@ -332,7 +337,7 @@ class WaveScheduler:
             try:
                 WAVE_ASSEMBLY_MS.observe(
                     (time.perf_counter() - t0) * 1e3)
-            except Exception:
+            except Exception:  # prom telemetry only
                 pass
         return dispatched
 

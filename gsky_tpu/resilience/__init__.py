@@ -44,5 +44,5 @@ def reset() -> None:
     try:
         from .. import device_guard
         device_guard.reset()
-    except Exception:
+    except Exception:  # device_guard absent or unbooted - nothing to reset
         pass

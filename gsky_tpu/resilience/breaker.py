@@ -144,7 +144,7 @@ class CircuitBreaker:
         if listeners:
             self._notify(old, new, listeners)
 
-    def _trip(self) -> None:
+    def _trip(self) -> None:  # gskylint: holds-lock
         # caller holds self._lock
         self._state = self.OPEN
         self._opened_at = self._clock()

@@ -107,7 +107,7 @@ class CancelToken:
         for cb in cbs:
             try:
                 cb()
-            except Exception:
+            except Exception:  # one failing cancel callback must not block the rest
                 pass
         return True
 
@@ -124,7 +124,7 @@ class CancelToken:
         if run_now:
             try:
                 cb()
-            except Exception:
+            except Exception:  # callback failure must not mask the cancellation
                 pass
             return lambda: None
 

@@ -159,10 +159,32 @@ def site_rng(site: str, seed: int) -> random.Random:
 # None when no faults are configured -> inject() is a no-op
 _PLAN: Optional[Dict[str, _SiteState]] = None
 
+# GSKY_FAULTS is folded in lazily, on the first inject()/flag()/
+# active() call, NOT at import: a module-level os.environ read latches
+# the value before tests or a SIGHUP reconfigure can change it
+# (gskylint GSKY-ENV).  An explicit configure() supersedes the env.
+_env_folded = False
+_env_lock = threading.Lock()
+
+
+def _ensure_configured() -> None:
+    global _env_folded
+    if _env_folded:
+        return
+    with _env_lock:
+        if _env_folded:
+            return
+        spec = os.environ.get("GSKY_FAULTS") or None
+        seed = int(os.environ.get("GSKY_FAULTS_SEED", "0") or "0")
+        if spec:
+            configure(spec, seed)
+        _env_folded = True
+
 
 def configure(spec: Optional[str], seed: int = 0) -> None:
     """Install (or clear, with a falsy spec) the active fault plan."""
-    global _PLAN
+    global _PLAN, _env_folded
+    _env_folded = True
     if not spec:
         _PLAN = None
         return
@@ -176,6 +198,7 @@ def reset() -> None:
 
 
 def active() -> bool:
+    _ensure_configured()
     return _PLAN is not None
 
 
@@ -183,8 +206,10 @@ def inject(site: str) -> None:
     """Apply any configured faults for ``site``.
 
     May sleep (latency fault) and/or raise :class:`InjectedFault`.
-    With no plan configured this is a single ``is None`` check.
+    With no plan configured this is a bool check plus an ``is None``
+    check.
     """
+    _ensure_configured()
     plan = _PLAN
     if plan is None:
         return
@@ -230,6 +255,7 @@ def flag(site: str, kind: str) -> bool:
     (``corrupt``: the caller poisons its own data).  Draws from the
     same per-site RNG stream as :func:`inject`, so (spec, seed) replay
     stays deterministic."""
+    _ensure_configured()
     plan = _PLAN
     if plan is None:
         return False
@@ -248,9 +274,3 @@ def flag(site: str, kind: str) -> bool:
         from .registry import registry
         registry.count_fault(site)
     return hit
-
-
-# honour the environment at import so every process (server, workers,
-# soak subprocesses) picks the plan up without plumbing
-configure(os.environ.get("GSKY_FAULTS") or None,
-          int(os.environ.get("GSKY_FAULTS_SEED", "0") or "0"))

@@ -104,7 +104,7 @@ class PressureMonitor:
     def _enabled() -> bool:
         return os.environ.get("GSKY_PRESSURE", "1") != "0"
 
-    def _raw_state(self) -> int:
+    def _raw_state(self) -> int:  # gskylint: holds-lock
         avail = self.avail_reader()
         pool = self.pool_reader()
         self._last_avail = avail
@@ -126,22 +126,25 @@ class PressureMonitor:
     def _relieve(self) -> None:
         """Critical transition: drop rebuildable device/host caches NOW
         — a cold cache beats a dead process.  Each sink is best-effort
-        and lazily imported (pressure must never fail a request)."""
-        self.trims += 1
+        and lazily imported (pressure must never fail a request).
+        Runs outside ``self._lock`` (cache clears can be slow), so the
+        counter bump takes it."""
+        with self._lock:
+            self.trims += 1
         try:
             from ..pipeline.scene_cache import default_scene_cache
             default_scene_cache.clear()
-        except Exception:
+        except Exception:  # sink absent - relief is best-effort
             pass
         try:
             from ..pipeline.drill_cache import default_drill_cache
             default_drill_cache.clear()
-        except Exception:
+        except Exception:  # sink absent - relief is best-effort
             pass
         try:
             from ..serving import default_gateway
             default_gateway.cache.clear()
-        except Exception:
+        except Exception:  # sink absent - relief is best-effort
             pass
 
     # -- state ----------------------------------------------------------

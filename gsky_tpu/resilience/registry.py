@@ -27,7 +27,7 @@ def note_event(kind: str, **attrs) -> None:
         from ..obs.metrics import TRACE_EVENTS
         TRACE_EVENTS.labels(kind=kind).inc()
         event(kind, **attrs)
-    except Exception:
+    except Exception:  # fault telemetry never raises into the recovery path
         pass
 
 

@@ -594,7 +594,7 @@ def load_config_tree(root: str, mas_factory=None,
                 if lay.timestamps_load_strategy != "on_demand":
                     try:
                         get_layer_dates(lay, mas)
-                    except Exception:
+                    except Exception:  # timestamp prefetch is advisory - dates load on demand
                         pass
                 for s in lay.styles:
                     s.dates = lay.dates

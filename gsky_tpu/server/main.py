@@ -16,6 +16,11 @@ from .ows import OWSServer
 
 
 def main(argv=None):
+    # GSKY_TSAN=1: patch threading.Lock/RLock BEFORE any server lock
+    # exists so every lock participates in lockset race tracking
+    from ..obs import tsan
+    tsan.maybe_install()
+
     ap = argparse.ArgumentParser(prog="gsky-ows",
                                  description="GSKY-TPU OGC web server")
     ap.add_argument("-port", type=int, default=8080)

@@ -48,21 +48,21 @@ def _resolve_cache_handles():
         handles.append(("scene", lambda m=m: {
             "hits": m.default_scene_cache.hits,
             "misses": m.default_scene_cache.misses}))
-    except Exception:
+    except Exception:  # tier absent in this build - skip its counters
         pass
     try:
         from ..pipeline import drill_cache as m
         handles.append(("drill_stack", lambda m=m: {
             "hits": m.default_drill_cache.hits,
             "misses": m.default_drill_cache.misses}))
-    except Exception:
+    except Exception:  # tier absent in this build - skip its counters
         pass
     try:
         from ..index.store import MASStore as cls
         handles.append(("mas_query", lambda cls=cls: {
             "hits": cls.total_query_hits,
             "misses": cls.total_query_misses}))
-    except Exception:
+    except Exception:  # tier absent in this build - skip its counters
         pass
     try:
         # the serving gateway in front of the pipelines: rendered-
@@ -70,7 +70,7 @@ def _resolve_cache_handles():
         from .. import serving as m
         handles.append(("response",
                         lambda m=m: m.default_gateway.cache_counters()))
-    except Exception:
+    except Exception:  # tier absent in this build - skip its counters
         pass
     return tuple(handles)
 
@@ -93,7 +93,7 @@ def cache_stats() -> Dict:
     for key, fn in handles:
         try:
             out[key] = fn()
-        except Exception:
+        except Exception:  # a failing handle yields no row, not a failed scrape
             pass
     return out
 
@@ -157,7 +157,7 @@ class MetricsCollector:
             try:
                 from ..obs import current_trace_id
                 self.info["trace_id"] = current_trace_id() or ""
-            except Exception:
+            except Exception:  # trace id is optional decoration on the summary
                 pass
         self._logger.record_summary(self.info)
         self._logger.write(self.info)
@@ -320,7 +320,7 @@ class MetricsLogger:
                     from ..pipeline.tile_stages import gate_stats
                     out["tile_stages"]["gates"] = gate_stats()
                     out["tile_stages"]["encode_pool"] = encode_pool_stats()
-                except Exception:
+                except Exception:  # stage gates absent when the tile pipeline is off
                     pass
         out["cache"] = _cache_stats()
         try:
@@ -385,7 +385,7 @@ class MetricsLogger:
             else:
                 try:
                     sys.stdout.flush()
-                except Exception:
+                except Exception:  # stdout may be closed during interpreter shutdown
                     pass
 
     def write(self, info: Dict):
@@ -405,7 +405,7 @@ class MetricsLogger:
             self._fp.write((line + "\n").encode())
             self._size += len(line) + 1
 
-    def _rotate(self):
+    def _rotate(self):  # gskylint: holds-lock
         if self._fp is not None:
             self._fp.close()
             self._gzip_old()

@@ -295,12 +295,12 @@ class OWSServer:
             import jax
             doc["jax"] = {"backend": jax.default_backend(),
                           "devices": len(jax.devices())}
-        except Exception:
+        except Exception:  # jax absent or unbooted - /debug still serves
             pass
         try:
             from ..parallel.spmd import spmd_enabled
             doc["spmd"] = spmd_enabled()
-        except Exception:
+        except Exception:  # spmd module optional in this build
             pass
         try:
             from ..pipeline.drill_cache import default_drill_cache as dc
@@ -334,11 +334,11 @@ class OWSServer:
                 if pages._default is not None:
                     doc["executor"]["paged"]["pool"] = \
                         pages._default.stats()
-            except Exception:
+            except Exception:  # no page pool allocated yet
                 pass
             doc["scene_cache_bytes"] = sc._bytes
             doc["drill_cache_bytes"] = dc._bytes
-        except Exception:
+        except Exception:  # executor tier unbooted - /debug still serves
             pass
         try:
             from ..ingest import stats as ingest_stats
@@ -356,13 +356,15 @@ class OWSServer:
                 doc["ingest"]["prefetch_planner"] = _planner.stats()
             if _staging is not None:
                 doc["ingest"]["staging"] = _staging.stats()
-        except Exception:
+        except Exception:  # ingest disabled - skip its block
             pass
         if self.gateway is not None:
             doc["serving"] = self.gateway.stats()
         doc["drain"] = self.drain.stats()
         doc["cancel"] = cancel_stats()
         doc["pressure"] = _pressure.default_monitor().stats()
+        from ..obs.tsan import tsan_stats
+        doc["tsan"] = tsan_stats()
         return web.json_response(doc)
 
     async def _metrics(self, request: web.Request) -> web.Response:
@@ -469,7 +471,7 @@ class OWSServer:
             if pipe.remote is not None:
                 try:
                     pipe.remote.close()
-                except Exception:
+                except Exception:  # client already closed during an earlier drain
                     pass
 
     # -- dispatch (generalHandler, `ows.go:1444-1530`) ----------------------
@@ -758,7 +760,7 @@ class OWSServer:
                 f"{cfg.service_config.namespace}\x1f{p.layers[0]}",
                 (b.xmin, b.ymin, b.xmax, b.ymax),
                 p.width, p.height, p.crs.name(), t)
-        except Exception:
+        except Exception:  # prefetch observation is advisory
             pass
 
     def _prefetch_warm(self, layer_key: str, qb, width: int, height: int,
@@ -834,7 +836,7 @@ class OWSServer:
             if (i1 - i0 + 1) * (j1 - j0 + 1) > 64:
                 return      # a footprint that large isn't a tile pan
             default_page_pool().prewarm(s.dev, s.serial, i0, i1, j0, j1)
-        except Exception:
+        except Exception:  # pool prewarm is advisory - a miss stages on demand
             pass
 
     async def _getmap(self, cfg: Config, p, collector):
@@ -1397,7 +1399,7 @@ class OWSServer:
             stats = await asyncio.to_thread(engine.run)
             try:
                 self.metrics.record_export(stats)
-            except Exception:
+            except Exception:  # export metrics are telemetry only
                 pass
 
         try:
@@ -1414,7 +1416,7 @@ class OWSServer:
             if writer is not None:
                 try:
                     await asyncio.to_thread(writer.close)
-                except Exception:
+                except Exception:  # writer already closed by a completed engine
                     pass
                 try:
                     os.remove(stream_path)

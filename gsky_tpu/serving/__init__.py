@@ -53,7 +53,7 @@ class ServingGateway:
         latched at import time."""
         try:
             self.admission.reconfigure()
-        except Exception:
+        except Exception:  # reconfigure is best-effort on reload
             pass
         fps = {ns: {layer_fingerprint(l) for l in cfg.layers}
                for ns, cfg in configs.items()}

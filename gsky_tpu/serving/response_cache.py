@@ -212,7 +212,7 @@ class ResponseCache:
                 self.evictions += 1
             return True
 
-    def _drop(self, key: str) -> None:
+    def _drop(self, key: str) -> None:  # gskylint: holds-lock
         ent = self._entries.pop(key, None)
         if ent is not None:
             self._bytes -= len(ent.body)

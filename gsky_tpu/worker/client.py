@@ -62,14 +62,14 @@ def _note(kind: str, **attrs) -> None:
     try:
         TRACE_EVENTS.labels(kind=kind).inc()
         obs_event(kind, **attrs)
-    except Exception:
+    except Exception:  # telemetry must never fail the RPC path
         pass
 
 
 def _rpc_observe(op: str, outcome: str, dur_s: float) -> None:
     try:
         RPC_SECONDS.labels(op=op, outcome=outcome).observe(dur_s)
-    except Exception:
+    except Exception:  # telemetry must never fail the RPC path
         pass
 
 
@@ -224,7 +224,7 @@ class WorkerClient:
             import grpc
             if isinstance(e, grpc.RpcError):
                 return e.code() == grpc.StatusCode.UNAVAILABLE
-        except Exception:
+        except Exception:  # grpc absent - fall through to the socket-error check
             pass
         return isinstance(e, (ConnectionError, OSError))
 
@@ -708,5 +708,5 @@ class WorkerClient:
         for ch in self._channels:
             try:
                 ch.close()
-            except Exception:
+            except Exception:  # channel already closed
                 pass

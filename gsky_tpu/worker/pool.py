@@ -125,7 +125,7 @@ def _set_pdeathsig():
     try:
         libc = ctypes.CDLL("libc.so.6", use_errno=True)
         libc.prctl(_PR_SET_PDEATHSIG, signal.SIGKILL)
-    except Exception:
+    except Exception:  # non-glibc platform - pdeathsig is a linux nicety
         pass
 
 

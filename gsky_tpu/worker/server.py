@@ -63,12 +63,12 @@ def _device_attrs(sp, c0) -> None:
         try:
             from ..server.prewarm import compile_count
             sp.set(fresh_compile=compile_count() > c0)
-        except Exception:
+        except Exception:  # compile probe is best-effort telemetry
             pass
     try:
         from ..ops.pallas_tpu import use_pallas
         sp.set(pallas=bool(use_pallas()))
-    except Exception:
+    except Exception:  # pallas gate probe is best-effort telemetry
         pass
 
 
@@ -108,7 +108,7 @@ class WorkerService:
                 try:
                     res.info_json = json.dumps(
                         {"spans": wtrace.span_dicts()})
-                except Exception:
+                except Exception:  # span attachment is advisory telemetry
                     pass
             return res
 
@@ -160,11 +160,11 @@ class WorkerService:
         try:
             from .. import device_guard
             info["device"] = device_guard.default_supervisor().stats()
-        except Exception:
+        except Exception:  # device guard absent - health still reports drain stats
             pass
         try:
             info["pool"] = self.pool.stats()
-        except Exception:
+        except Exception:  # pool stats optional in the health probe
             pass
         r.info_json = json.dumps(info)
         return r

@@ -1,0 +1,476 @@
+"""gskylint: each check class proven to fire on a seeded fixture tree,
+suppression machinery (inline disable + JSON baseline) proven to
+split findings, the CLI exit-code contract, and the lockset race
+sanitizer (gsky_tpu/obs/tsan.py) detecting a racy counter while
+staying silent on a locked one.
+
+The fixture repo is built in tmp_path — the REAL tree must stay
+finding-free (the tier-1 gate runs `python -m tools.gskylint` against
+it), so violations live here, not in checked-in files.
+"""
+
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+from tools.gskylint import engine
+from tools.gskylint.engine import Finding, lint_paths
+
+
+# -- fixture repo -------------------------------------------------------
+
+def _write(root, relpath, body):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(textwrap.dedent(body))
+    return path
+
+
+@pytest.fixture()
+def repo(tmp_path):
+    """A minimal repo with docs/CONFIG.md and a clean registry."""
+    _write(tmp_path, "docs/CONFIG.md", """\
+        # fixture config
+        | `GSKY_FIXTURE_LATCH` | documented knob |
+        | `GSKY_FIXTURE_SUPPRESSED` | documented knob |
+        | `GSKY_FIXTURE_STALE` | row nothing reads (E2) |
+        """)
+    _write(tmp_path, "gsky_tpu/obs/metrics.py", """\
+        class _Reg:
+            def counter(self, name, help):
+                return name
+
+            def gauge(self, name, help):
+                return name
+
+        _REG = _Reg()
+        OK = _REG.counter("gsky_fixture_ok_total", "fine")
+        """)
+    return tmp_path
+
+
+def _lint(repo, *relpaths):
+    paths = [os.path.join(str(repo), p) for p in relpaths]
+    paths.append(os.path.join(str(repo), "gsky_tpu"))
+    baseline = os.path.join(str(repo), "baseline.json")
+    return lint_paths(paths, root=str(repo), baseline_path=baseline)
+
+
+def _by_code(findings, code):
+    return [f for f in findings if f.code == code]
+
+
+# -- GSKY-ENV -----------------------------------------------------------
+
+def test_env_check_fires(repo):
+    _write(repo, "gsky_tpu/mod_env.py", """\
+        import os
+
+        LATCHED = os.environ.get("GSKY_FIXTURE_LATCH", "0")
+
+
+        def read():
+            return os.environ.get("GSKY_FIXTURE_UNDOC", "1")
+        """)
+    live, suppressed = _lint(repo)
+    env = _by_code(live, "GSKY-ENV")
+    # E1: undocumented knob, at the literal's line
+    e1 = [f for f in env if "GSKY_FIXTURE_UNDOC" in f.message]
+    assert len(e1) == 1
+    assert e1[0].path == "gsky_tpu/mod_env.py" and e1[0].line == 7
+    # E3: module-level read latches the documented knob
+    e3 = [f for f in env if "module-level" in f.message]
+    assert len(e3) == 1 and e3[0].line == 3
+    # E2: the stale CONFIG.md row, anchored in the doc file
+    e2 = [f for f in env if "GSKY_FIXTURE_STALE" in f.message]
+    assert len(e2) == 1 and e2[0].path == "docs/CONFIG.md"
+    assert e2[0].line == 4
+
+
+def test_env_inline_disable_suppresses(repo):
+    _write(repo, "gsky_tpu/mod_env_ok.py", """\
+        import os
+
+
+        def read():
+            # gskylint: disable=GSKY-ENV
+            return os.environ.get("GSKY_FIXTURE_NODOC", "1")
+        """)
+    live, suppressed = _lint(repo)
+    assert not [f for f in _by_code(live, "GSKY-ENV")
+                if "GSKY_FIXTURE_NODOC" in f.message]
+    sup = _by_code(suppressed, "GSKY-ENV")
+    assert len(sup) == 1 and "GSKY_FIXTURE_NODOC" in sup[0].message
+
+
+# -- GSKY-CANCEL --------------------------------------------------------
+
+def test_cancel_check_fires(repo):
+    _write(repo, "gsky_tpu/mod_cancel.py", """\
+        import time
+
+
+        async def handler():
+            time.sleep(1.0)
+
+
+        def waiter(fut):
+            while True:
+                fut.result(timeout=0.05)
+        """)
+    live, _ = _lint(repo)
+    can = _by_code(live, "GSKY-CANCEL")
+    c1 = [f for f in can if "C1" in f.message]
+    assert len(c1) == 1 and c1[0].line == 5
+    c2 = [f for f in can if "C2" in f.message]
+    assert len(c2) == 1 and c2[0].line == 10
+
+
+def test_cancel_gated_loop_is_clean(repo):
+    _write(repo, "gsky_tpu/mod_cancel_ok.py", """\
+        def waiter(fut, token):
+            while True:
+                try:
+                    return fut.result(timeout=0.05)
+                except TimeoutError:
+                    token.check("stage")
+        """)
+    live, _ = _lint(repo)
+    assert not _by_code(live, "GSKY-CANCEL")
+
+
+# -- GSKY-METRICS -------------------------------------------------------
+
+def test_metrics_check_fires(repo):
+    # M2: duplicate registration inside the registry
+    _write(repo, "gsky_tpu/obs/metrics.py", """\
+        class _Reg:
+            def counter(self, name, help):
+                return name
+
+        _REG = _Reg()
+        A = _REG.counter("gsky_fixture_ok_total", "fine")
+        B = _REG.counter("gsky_fixture_dup_total", "one")
+        C = _REG.counter("gsky_fixture_dup_total", "two")
+        """)
+    # M1: family registered outside the registry module
+    _write(repo, "gsky_tpu/mod_metrics.py", """\
+        def setup(reg):
+            return reg.counter("gsky_fixture_orphan_total", "orphan")
+        """)
+    # M3: harness asserts a family that exists nowhere
+    _write(repo, "tools_fix/check_metrics.py", """\
+        WANT = ["gsky_fixture_ok_total", "gsky_fixture_missing_total"]
+        """)
+    live, _ = _lint(repo, "tools_fix")
+    met = _by_code(live, "GSKY-METRICS")
+    m2 = [f for f in met if "registered twice" in f.message]
+    assert len(m2) == 1
+    assert m2[0].path == "gsky_tpu/obs/metrics.py" and m2[0].line == 8
+    # gskylint: disable=GSKY-METRICS
+    m1 = [f for f in met if "gsky_fixture_orphan_total" in f.message]
+    assert len(m1) == 1 and m1[0].path == "gsky_tpu/mod_metrics.py"
+    # gskylint: disable=GSKY-METRICS
+    m3 = [f for f in met if "gsky_fixture_missing_total" in f.message]
+    assert len(m3) == 1 and m3[0].path == "tools_fix/check_metrics.py"
+    # the family that IS registered raises nothing
+    assert not [f for f in met
+                if "'gsky_fixture_ok_total'" in f.message]
+
+
+# -- GSKY-LOCK ----------------------------------------------------------
+
+def test_lock_check_fires(repo):
+    _write(repo, "gsky_tpu/mod_lock.py", """\
+        import threading
+
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def bare_bump(self):
+                self.n += 1
+        """)
+    live, _ = _lint(repo)
+    lk = _by_code(live, "GSKY-LOCK")
+    assert len(lk) == 1
+    assert lk[0].path == "gsky_tpu/mod_lock.py" and lk[0].line == 14
+    assert "Counter.n" in lk[0].message and "bare_bump" in lk[0].message
+
+
+def test_lock_holds_lock_marker_clears(repo):
+    _write(repo, "gsky_tpu/mod_lock_ok.py", """\
+        import threading
+
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def _bump(self):  # gskylint: holds-lock
+                self.n += 1
+
+            def _drop_locked(self):
+                self.n = 0
+        """)
+    live, _ = _lint(repo)
+    assert not _by_code(live, "GSKY-LOCK")
+
+
+# -- GSKY-EXC -----------------------------------------------------------
+
+def test_exc_check_fires(repo):
+    _write(repo, "gsky_tpu/mod_exc.py", """\
+        def f(g):
+            try:
+                g()
+            except Exception:
+                pass
+        """)
+    _write(repo, "gsky_tpu/device_guard/rogue.py", """\
+        class RogueDeviceError(RuntimeError):
+            pass
+        """)
+    live, _ = _lint(repo)
+    exc = _by_code(live, "GSKY-EXC")
+    x1 = [f for f in exc if "X1" in f.message]
+    assert len(x1) == 1
+    assert x1[0].path == "gsky_tpu/mod_exc.py" and x1[0].line == 4
+    x2 = [f for f in exc if "X2" in f.message]
+    assert len(x2) == 1
+    assert x2[0].path == "gsky_tpu/device_guard/rogue.py"
+    assert "RogueDeviceError" in x2[0].message
+
+
+def test_exc_commented_swallow_is_clean(repo):
+    _write(repo, "gsky_tpu/mod_exc_ok.py", """\
+        def f(g):
+            try:
+                g()
+            except Exception:  # fixture: telemetry must not raise
+                pass
+        """)
+    live, _ = _lint(repo)
+    assert not _by_code(live, "GSKY-EXC")
+
+
+def test_exc_baseline_suppresses(repo):
+    _write(repo, "gsky_tpu/mod_exc.py", """\
+        def f(g):
+            try:
+                g()
+            except Exception:
+                pass
+        """)
+    _write(repo, "baseline.json", json.dumps({
+        "version": 1,
+        "suppressions": [{"code": "GSKY-EXC",
+                          "path": "gsky_tpu/mod_exc.py"}],
+    }))
+    live, suppressed = _lint(repo)
+    assert not _by_code(live, "GSKY-EXC")
+    assert len(_by_code(suppressed, "GSKY-EXC")) == 1
+
+
+# -- driver contract ----------------------------------------------------
+
+def test_clean_tree_exits_zero(repo, monkeypatch, capsys):
+    monkeypatch.chdir(repo)
+    assert engine.main(["gsky_tpu"]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_violations_exit_nonzero(repo, monkeypatch, capsys):
+    _write(repo, "gsky_tpu/mod_exc.py", """\
+        def f(g):
+            try:
+                g()
+            except Exception:
+                pass
+        """)
+    monkeypatch.chdir(repo)
+    assert engine.main(["gsky_tpu"]) == 1
+    out = capsys.readouterr().out
+    assert "GSKY-EXC" in out and "mod_exc.py:4" in out
+
+
+def test_parse_error_is_a_finding(repo):
+    _write(repo, "gsky_tpu/broken.py", "def f(:\n")
+    live, _ = _lint(repo)
+    parse = _by_code(live, "GSKY-PARSE")
+    assert len(parse) == 1 and parse[0].path == "gsky_tpu/broken.py"
+
+
+def test_repo_tree_is_clean():
+    """The acceptance invariant: the real tree lints clean with the
+    checked-in (empty) baseline."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    live, _ = lint_paths(
+        [os.path.join(root, "gsky_tpu"), os.path.join(root, "tools")],
+        root=root,
+        baseline_path=os.path.join(root, "tools", "gskylint",
+                                   "baseline.json"))
+    assert live == [], "\n".join(f.render() for f in live)
+
+
+def test_checked_in_baseline_is_empty():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "tools", "gskylint",
+                           "baseline.json")) as fh:
+        assert json.load(fh)["suppressions"] == []
+
+
+# -- tsan: lockset race sanitizer --------------------------------------
+
+@pytest.fixture()
+def tsan_on(monkeypatch):
+    from gsky_tpu.obs import tsan
+    monkeypatch.setenv("GSKY_TSAN", "1")
+    tsan.reset()
+    tsan.install()
+    yield tsan
+    tsan.uninstall()
+    tsan.reset()
+
+
+def _hammer(fn, n=200):
+    t = threading.Thread(target=lambda: [fn() for _ in range(n)])
+    t.start()
+    t.join()
+
+
+def _two_writers(fn):
+    # Two CONCURRENTLY-alive threads: sequential joined threads can
+    # reuse the same get_ident(), which would look thread-confined.
+    a_done = threading.Event()
+    b_done = threading.Event()
+
+    def writer_a():
+        for _ in range(50):
+            fn()
+        a_done.set()
+        b_done.wait(5.0)
+
+    def writer_b():
+        a_done.wait(5.0)
+        for _ in range(50):
+            fn()
+        b_done.set()
+
+    ta = threading.Thread(target=writer_a)
+    tb = threading.Thread(target=writer_b)
+    ta.start()
+    tb.start()
+    ta.join(10.0)
+    tb.join(10.0)
+
+
+def test_tsan_detects_unlocked_counter(tsan_on):
+    tsan = tsan_on
+
+    class RacyBox:
+        def __init__(self):
+            self.n = 0
+
+    box = RacyBox()
+    assert tsan.track(box, "RacyBox")
+
+    def bump():
+        box.n += 1
+
+    _two_writers(bump)     # two writer threads, no common lock -> race
+    races = tsan.races()
+    assert tsan.race_count() == 1
+    assert races[0].name == "RacyBox" and races[0].attr == "n"
+    rep = races[0].render()
+    # both stacks surface in the report
+    assert "previous write" in rep and "current write" in rep
+    assert "RACE on RacyBox.n" in rep
+    assert tsan.report().count("RACE") == 1
+
+
+def test_tsan_silent_on_locked_counter(tsan_on):
+    tsan = tsan_on
+
+    class LockedBox:
+        def __init__(self):
+            self.lock = threading.Lock()   # a TsanLock post-install
+            self.n = 0
+
+    box = LockedBox()
+    assert isinstance(box.lock, tsan.TsanLock)
+    assert tsan.track(box, "LockedBox")
+
+    def bump():
+        with box.lock:
+            box.n += 1
+
+    _two_writers(bump)
+    assert tsan.race_count() == 0
+    assert tsan.report() == "tsan: no races detected"
+
+
+def test_tsan_dedups_and_stats(tsan_on):
+    tsan = tsan_on
+
+    class Box2:
+        def __init__(self):
+            self.a = 0
+
+    box = Box2()
+    tsan.track(box, "Box2")
+
+    def bump():
+        box.a += 1
+
+    for _ in range(2):
+        _two_writers(bump)  # many conflicting writes, one report
+    assert tsan.race_count() == 1
+    st = tsan.tsan_stats()
+    assert st["enabled"] and st["installed"]
+    assert st["races"] == 1 and st["tracked_vars"] >= 1
+
+
+def test_tsan_disabled_is_inert(monkeypatch):
+    from gsky_tpu.obs import tsan
+    monkeypatch.delenv("GSKY_TSAN", raising=False)
+    assert not tsan.enabled()
+    assert tsan.maybe_install() is False
+    assert not tsan.installed()
+    assert threading.Lock is tsan._REAL_LOCK
+
+    class Box3:
+        def __init__(self):
+            self.x = 0
+
+    assert tsan.track(Box3(), "Box3") is False
+
+
+def test_tsan_lock_delegates_protocol(tsan_on):
+    tsan = tsan_on
+    # Condition/Queue interop: the wrapper must satisfy the private
+    # lock protocol (_at_fork_reinit and friends) via delegation
+    lock = threading.Lock()
+    assert isinstance(lock, tsan.TsanLock)
+    assert hasattr(lock, "_at_fork_reinit")
+    cv = threading.Condition(threading.RLock())
+    with cv:
+        cv.notify_all()
+    assert not lock.locked()
+    with lock:
+        assert lock.locked()

@@ -1,0 +1,8 @@
+"""``python -m tools.gskylint`` entry point."""
+
+import sys
+
+from .engine import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

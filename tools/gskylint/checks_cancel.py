@@ -1,0 +1,201 @@
+"""GSKY-CANCEL: cancellation gates and event-loop hygiene.
+
+Two rules:
+
+C1  inside ``async def`` bodies (not nested sync defs — those run in
+    executors), no blocking primitive may be called directly on the
+    event loop: ``time.sleep``, sync ``subprocess`` / ``urllib`` /
+    ``socket`` entry points, lock ``.acquire()`` without a timeout or
+    ``blocking=False``, ``Future.result()`` / ``.join()`` / queue
+    ``.get()`` / ``Event.wait()`` without a timeout.  One stalled
+    handler freezes every in-flight request on the loop.
+
+C2  a ``while`` loop in ``gsky_tpu/`` that polls a blocking wait
+    primitive *with* a timeout (the poll-loop idiom: the timeout
+    exists so the loop can re-check something) must actually re-check
+    something: a cancellation gate (``check_cancel`` /
+    ``token.check`` / ``.cancelled()``) or a stop/shutdown flag
+    (``.is_set()`` / a ``*stop*``/``*shutdown*``/``*closed*`` name).
+    A timeout-poll loop with no gate spins forever for a request
+    whose client is gone — exactly the class PR 9's cancellation
+    tokens exist to kill.
+
+Worker-thread code may block; C1 is scoped to async bodies only.  C2
+is scoped to ``gsky_tpu/`` — tools and tests poll legitimately.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .engine import Finding, RepoContext
+
+CODE = "GSKY-CANCEL"
+
+# call chains that block outright, flagged in async bodies regardless
+# of arguments
+_BLOCKING_CHAINS = {
+    ("time", "sleep"),
+    ("subprocess", "run"), ("subprocess", "call"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+    ("socket", "create_connection"), ("socket", "getaddrinfo"),
+    ("urllib", "request", "urlopen"), ("request", "urlopen"),
+    ("requests", "get"), ("requests", "post"), ("requests", "put"),
+    ("requests", "head"), ("requests", "request"),
+}
+
+# method names that block unless given a timeout / blocking=False
+_WAIT_METHODS = {"acquire", "result", "wait", "join", "get"}
+
+_GATE_CALL_NAMES = {"check_cancel"}
+_GATE_METHOD_NAMES = {"check", "cancelled", "is_set"}
+_GATE_NAME_HINTS = ("stop", "shutdown", "closed", "cancel", "drain")
+
+
+def _dotted(node: ast.AST) -> Optional[tuple]:
+    """`a.b.c` -> ("a","b","c"); None when not a plain name chain."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _has_timeout_arg(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg in ("timeout", "block", "blocking"):
+            return True
+    return bool(call.args)   # positional timeout / blocking flag
+
+
+def _is_str_join(call: ast.Call) -> bool:
+    """``", ".join(...)`` — the one ubiquitous non-blocking .join."""
+    return isinstance(call.func, ast.Attribute) and \
+        call.func.attr == "join" and \
+        isinstance(call.func.value, ast.Constant)
+
+
+def _receiver_hint(call: ast.Call) -> str:
+    """Lowercased name path of the receiver, for filtering `.get()`:
+    only queue-ish receivers count (dict .get() is everywhere)."""
+    if not isinstance(call.func, ast.Attribute):
+        return ""
+    dd = _dotted(call.func.value)
+    return ".".join(dd).lower() if dd else ""
+
+
+def _blocking_in_async(call: ast.Call) -> Optional[str]:
+    dd = _dotted(call.func)
+    if dd is not None:
+        for chain in _BLOCKING_CHAINS:
+            if dd[-len(chain):] == chain:
+                return ".".join(chain)
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in _WAIT_METHODS and not _is_str_join(call):
+        if call.func.attr == "get":
+            hint = _receiver_hint(call)
+            if not any(h in hint for h in ("queue", "_q", "fifo")):
+                return None
+        if not _has_timeout_arg(call):
+            return f".{call.func.attr}() without a timeout"
+    return None
+
+
+class _AsyncVisitor(ast.NodeVisitor):
+    """Walk async function bodies only, skipping nested sync defs."""
+
+    def __init__(self, sf, out: List[Finding]):
+        self.sf = sf
+        self.out = out
+        self.async_depth = 0
+
+    def visit_FunctionDef(self, node):
+        # nested sync def: runs in a thread/executor, blocking is fine
+        pass
+
+    visit_Lambda = visit_FunctionDef
+
+    def visit_AsyncFunctionDef(self, node):
+        self.async_depth += 1
+        for child in node.body:
+            self.visit(child)
+        self.async_depth -= 1
+
+    def visit_Call(self, node):
+        if self.async_depth > 0:
+            why = _blocking_in_async(node)
+            if why is not None:
+                self.out.append(Finding(
+                    CODE, self.sf.path, node.lineno,
+                    f"blocking call {why} inside `async def` body "
+                    f"stalls the event loop (C1) — await an async "
+                    f"equivalent or move it to a thread"))
+        self.generic_visit(node)
+
+
+def _loop_wait_call(loop: ast.While) -> Optional[ast.Call]:
+    """The first timeout-style wait primitive polled by the loop."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("result", "wait", "get", "acquire",
+                                   "join") and \
+                not _is_str_join(node) and _has_timeout_arg(node):
+            # require a literal/named timeout kwarg — positional args
+            # on .get()/.join() are too ambiguous to anchor C2 on
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                return node
+    return None
+
+
+def _loop_has_gate(loop: ast.While) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            dd = _dotted(node.func)
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in _GATE_CALL_NAMES:
+                return True
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _GATE_METHOD_NAMES:
+                return True
+            if dd and any(h in p.lower() for p in dd
+                          for h in _GATE_NAME_HINTS):
+                return True
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            name = node.attr if isinstance(node, ast.Attribute) \
+                else node.id
+            if any(h in name.lower() for h in _GATE_NAME_HINTS):
+                return True
+        elif isinstance(node, (ast.Break, ast.Return, ast.Raise)):
+            continue
+    return False
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        _AsyncVisitor(sf, out).visit(sf.tree)
+        if not sf.path.startswith("gsky_tpu/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.While):
+                continue
+            wait = _loop_wait_call(node)
+            if wait is None:
+                continue
+            if _loop_has_gate(node):
+                continue
+            out.append(Finding(
+                CODE, sf.path, wait.lineno,
+                "timeout-poll loop with no cancellation or stop gate "
+                "(C2): call check_cancel()/token.check() or test a "
+                "stop flag each pass, or the loop outlives its "
+                "request"))
+    return out

@@ -1,0 +1,103 @@
+"""GSKY-ENV: knob/doc parity and the import-time latch ban.
+
+Three rules:
+
+E1  every ``GSKY_*`` string literal in ``gsky_tpu/`` (the knob read
+    vocabulary — reads all go through literal names, directly or via
+    ``_env_int``-style helpers) must appear in ``docs/CONFIG.md``;
+E2  ``docs/CONFIG.md`` must not document a knob that nothing in
+    ``gsky_tpu/`` reads any more (stale row);
+E3  no module-level ``os.environ`` / ``os.getenv`` access in
+    ``gsky_tpu/`` — a knob read at import time is latched for the
+    process lifetime and silently stops honouring SIGHUP reconfigure
+    (the PR 9 admission-latch bug class).
+
+Docstrings are skipped for E1 (prose mentions are not reads), and the
+knob vocabulary is the *exact* literal: dynamic name construction
+would defeat the check and is itself worth flagging, but the tree has
+none — helpers take full literal names.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from .engine import Finding, RepoContext
+
+CODE = "GSKY-ENV"
+_KNOB_RE = re.compile(r"^GSKY_[A-Z0-9_]+$")
+_DOC_KNOB_RE = re.compile(r"GSKY_[A-Z0-9_]+")
+
+
+def _module_level_env_reads(tree: ast.AST) -> List[int]:
+    """Line numbers of os.environ/os.getenv touched outside any
+    function body (class bodies at module level count: they run at
+    import too)."""
+    hits: List[int] = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):   # don't descend
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def visit_Attribute(self, node):
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id == "os" and \
+                    node.attr in ("environ", "getenv"):
+                hits.append(node.lineno)
+            self.generic_visit(node)
+
+        def visit_Name(self, node):
+            # `from os import environ/getenv` style
+            if node.id in ("environ", "getenv") and \
+                    isinstance(node.ctx, ast.Load):
+                hits.append(node.lineno)
+
+    V().visit(tree)
+    return hits
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    out: List[Finding] = []
+    documented = set(_DOC_KNOB_RE.findall(ctx.config_md))
+    read_knobs = {}   # knob -> first (path, line)
+
+    for sf in ctx.files:
+        if sf.tree is None or not sf.path.startswith("gsky_tpu/"):
+            continue
+        doc_ids = sf.docstring_constants()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    id(node) not in doc_ids and \
+                    _KNOB_RE.match(node.value):
+                read_knobs.setdefault(node.value,
+                                      (sf.path, node.lineno))
+                if node.value not in documented:
+                    out.append(Finding(
+                        CODE, sf.path, node.lineno,
+                        f"knob {node.value} is read here but has no "
+                        f"row in {ctx.config_md_path} (E1: every knob "
+                        f"is documented)"))
+        for ln in _module_level_env_reads(sf.tree):
+            out.append(Finding(
+                CODE, sf.path, ln,
+                "module-level os.environ read: the value latches at "
+                "import and stops honouring SIGHUP reconfigure — "
+                "move the read to call time (E3)"))
+
+    # E2: stale doc rows.  Only fires when gsky_tpu/ was actually part
+    # of this run, otherwise every row would look unread.
+    if read_knobs and ctx.config_md:
+        for i, line in enumerate(ctx.config_md.splitlines(), start=1):
+            for knob in set(_DOC_KNOB_RE.findall(line)):
+                if knob not in read_knobs:
+                    out.append(Finding(
+                        CODE, ctx.config_md_path, i,
+                        f"documented knob {knob} is not read anywhere "
+                        f"in gsky_tpu/ — delete or fix the row (E2)"))
+    return out

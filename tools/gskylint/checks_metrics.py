@@ -1,0 +1,145 @@
+"""GSKY-METRICS: one metric registry, no orphan families.
+
+``gsky_tpu/obs/metrics.py`` is the single place ``gsky_*`` families
+are declared — module-level ``_REG.counter/gauge/histogram(...)``
+plus the scrape-time ``_g(...)``/``_c(...)`` collector rows.  The
+strict exposition parser (obs/prom.py) round-trips that registry in
+tier-1, so a family declared there is guaranteed scrapeable.
+
+Rules:
+
+M1  a ``gsky_*`` family registered or emitted by name anywhere else
+    in ``gsky_tpu/`` (a ``.counter/.gauge/.histogram("gsky_...")``
+    call outside obs/metrics.py) must already be declared in
+    obs/metrics.py — otherwise it is an orphan that ``/metrics``
+    never exports.
+M2  registered names must be parser-legal
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``), ``gsky_``-prefixed, and
+    registered exactly once.
+M3  a full ``gsky_*`` family literal asserted in tools/ or tests/
+    (soak and test harnesses grepping ``/metrics``) must exist in
+    the registry or be registered locally in the same file —
+    otherwise the assertion tests a family that cannot exist.
+
+Family literals are recognised by the conventional suffixes
+(``_total``, ``_seconds``, ``_ms``, ``_bytes``, ``_ratio``,
+``_state``, ``_info``, ``_in_use``, ``_queued``, ``_depth``,
+``_occupancy``) so ContextVar names like ``gsky_cancel`` and prose
+fragments never false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from .engine import Finding, RepoContext
+
+CODE = "GSKY-METRICS"
+REGISTRY_PATH = "gsky_tpu/obs/metrics.py"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_FAMILY_RE = re.compile(
+    r"^gsky_[a-z0-9_]*(_total|_seconds|_ms|_bytes|_ratio|_state"
+    r"|_info|_in_use|_queued|_depth|_occupancy)$")
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+_ROW_HELPERS = {"_g", "_c"}
+
+
+def _registration_name(node: ast.Call) -> str:
+    """The family-name literal of a registration-shaped call, else ''."""
+    is_reg = (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _REGISTER_METHODS) or \
+             (isinstance(node.func, ast.Name)
+              and node.func.id in _ROW_HELPERS)
+    if not is_reg or not node.args:
+        return ""
+    first = node.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        return first.value
+    return ""
+
+
+def _collect_registry(ctx: RepoContext) -> Dict[str, int]:
+    reg: Dict[str, int] = {}
+    sf = ctx.file(REGISTRY_PATH)
+    if sf is None or sf.tree is None:
+        return reg
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            name = _registration_name(node)
+            if name:
+                reg.setdefault(name, node.lineno)
+    return reg
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    out: List[Finding] = []
+    registry = _collect_registry(ctx)
+    ctx.registered_metrics = registry
+    reg_sf = ctx.file(REGISTRY_PATH)
+
+    # M2: legality + duplicates, within the registry module
+    if reg_sf is not None and reg_sf.tree is not None:
+        seen_module_level: Set[str] = set()
+        for node in ast.walk(reg_sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _registration_name(node)
+            if not name:
+                continue
+            if not _NAME_RE.match(name):
+                out.append(Finding(
+                    CODE, reg_sf.path, node.lineno,
+                    f"family {name!r} is not a legal exposition name "
+                    f"(M2) — the strict parser will reject the scrape"))
+            elif not name.startswith("gsky_"):
+                out.append(Finding(
+                    CODE, reg_sf.path, node.lineno,
+                    f"family {name!r} missing the gsky_ namespace "
+                    f"prefix (M2)"))
+            # duplicate *static* registration: only module-level
+            # _REG.xxx calls can collide (collector rows are rebuilt
+            # per scrape and may legitimately share a loop)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _REGISTER_METHODS:
+                if name in seen_module_level:
+                    out.append(Finding(
+                        CODE, reg_sf.path, node.lineno,
+                        f"family {name!r} registered twice (M2)"))
+                seen_module_level.add(name)
+
+    for sf in ctx.files:
+        if sf.tree is None or sf.path == REGISTRY_PATH:
+            continue
+        doc_ids = sf.docstring_constants()
+        in_gsky = sf.path.startswith("gsky_tpu/")
+        local_reg: Set[str] = set()
+        if not in_gsky:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    name = _registration_name(node)
+                    if name:
+                        local_reg.add(name)
+        for node in ast.walk(sf.tree):
+            if in_gsky and isinstance(node, ast.Call):
+                name = _registration_name(node)
+                if name.startswith("gsky_") and name not in registry:
+                    out.append(Finding(
+                        CODE, sf.path, node.lineno,
+                        f"family {name!r} registered outside "
+                        f"{REGISTRY_PATH} and not declared there (M1) "
+                        f"— /metrics never exports it"))
+            if not in_gsky and isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    id(node) not in doc_ids and \
+                    _FAMILY_RE.match(node.value):
+                if node.value in registry or node.value in local_reg:
+                    continue
+                out.append(Finding(
+                    CODE, sf.path, node.lineno,
+                    f"family {node.value!r} asserted here but "
+                    f"registered neither in {REGISTRY_PATH} nor in "
+                    f"this file (M3) — the assertion can never pass"))
+    return out

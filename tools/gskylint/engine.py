@@ -1,0 +1,311 @@
+"""gskylint driver: file loading, suppression handling, CLI.
+
+The checks themselves live in ``checks_*.py``; this module owns the
+mechanics every check shares — walking the tree once per file,
+resolving the repo root, inline ``# gskylint: disable=`` comments,
+the JSON suppression baseline, and the exit status contract
+(non-zero iff any unsuppressed finding).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Comment markers -------------------------------------------------------
+
+# `# gskylint: disable=GSKY-ENV[,GSKY-EXC]` on the finding's line or on
+# a standalone comment line directly above it.
+_DISABLE_RE = re.compile(r"#\s*gskylint:\s*disable=([A-Z0-9_,\-\s]+)")
+# `# gskylint: holds-lock` on a `def` line marks a method whose caller
+# contract is "invoked with the owning lock held" (GSKY-LOCK treats its
+# writes as locked).
+_HOLDS_LOCK_RE = re.compile(r"#\s*gskylint:\s*holds-lock")
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules",
+              ".ipynb_checkpoints"}
+_SKIP_SUFFIXES = ("_pb2.py", "_pb2_grpc.py")   # generated code
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str
+    path: str          # repo-root-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class SourceFile:
+    """One parsed file plus the per-line metadata checks share."""
+
+    def __init__(self, root: str, path: str):
+        self.path = os.path.relpath(path, root).replace(os.sep, "/")
+        self.abspath = path
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.text, filename=self.path)
+        except SyntaxError as exc:
+            self.parse_error = exc
+        self._docstring_ids: Optional[Set[int]] = None
+
+    # -- helpers shared by checks --------------------------------------
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def docstring_constants(self) -> Set[int]:
+        """``id()`` of every Constant node that is a docstring, so
+        literal scans can skip prose."""
+        if self._docstring_ids is not None:
+            return self._docstring_ids
+        ids: Set[int] = set()
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.Module, ast.ClassDef,
+                                     ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    body = getattr(node, "body", [])
+                    if body and isinstance(body[0], ast.Expr) and \
+                            isinstance(body[0].value, ast.Constant) and \
+                            isinstance(body[0].value.value, str):
+                        ids.add(id(body[0].value))
+        self._docstring_ids = ids
+        return ids
+
+    def disabled_codes(self, lineno: int) -> Set[str]:
+        """Codes suppressed for ``lineno`` (its own trailing comment or
+        a standalone comment on the line above)."""
+        out: Set[str] = set()
+        for ln in (lineno, lineno - 1):
+            text = self.line_text(ln)
+            if ln != lineno and text.split("#", 1)[0].strip():
+                continue   # line above only counts when comment-only
+            m = _DISABLE_RE.search(text)
+            if m:
+                out.update(c.strip() for c in m.group(1).split(",")
+                           if c.strip())
+        return out
+
+    def holds_lock_marked(self, lineno: int) -> bool:
+        return bool(_HOLDS_LOCK_RE.search(self.line_text(lineno)))
+
+
+@dataclass
+class RepoContext:
+    """Cross-file facts computed once per run."""
+    root: str
+    files: List[SourceFile] = field(default_factory=list)
+    config_md: str = ""            # docs/CONFIG.md text ("" if absent)
+    config_md_path: str = "docs/CONFIG.md"
+    # family name -> first registration line in obs/metrics.py
+    registered_metrics: Dict[str, int] = field(default_factory=dict)
+
+    def file(self, relpath: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.path == relpath:
+                return f
+        return None
+
+
+def _find_root(paths: Sequence[str]) -> str:
+    """Repo root: the nearest ancestor (of cwd, then of this file)
+    holding ``docs/CONFIG.md`` — keeps the doc-parity check working
+    no matter where the linter is launched from."""
+    candidates = [os.getcwd(),
+                  os.path.dirname(os.path.dirname(
+                      os.path.dirname(os.path.abspath(__file__))))]
+    for base in candidates:
+        cur = base
+        while True:
+            if os.path.exists(os.path.join(cur, "docs", "CONFIG.md")):
+                return cur
+            nxt = os.path.dirname(cur)
+            if nxt == cur:
+                break
+            cur = nxt
+    return os.getcwd()
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                if fn.endswith(_SKIP_SUFFIXES):
+                    continue
+                yield os.path.join(dirpath, fn)
+
+
+def build_context(paths: Sequence[str],
+                  root: Optional[str] = None) -> RepoContext:
+    root = root or _find_root(paths)
+    ctx = RepoContext(root=root)
+    seen: Set[str] = set()
+    for fp in iter_py_files(paths):
+        ap = os.path.abspath(fp)
+        if ap in seen:
+            continue
+        seen.add(ap)
+        ctx.files.append(SourceFile(root, ap))
+    cfg = os.path.join(root, "docs", "CONFIG.md")
+    if os.path.exists(cfg):
+        with open(cfg, "r", encoding="utf-8") as fh:
+            ctx.config_md = fh.read()
+    return ctx
+
+
+# -- baseline -----------------------------------------------------------
+
+def load_baseline(path: str) -> List[Dict]:
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return list(data.get("suppressions", []))
+
+
+def _baseline_matches(entry: Dict, f: Finding) -> bool:
+    if entry.get("code") and entry["code"] != f.code:
+        return False
+    if entry.get("path") and entry["path"] != f.path:
+        return False
+    if entry.get("line") and int(entry["line"]) != f.line:
+        return False
+    if entry.get("contains") and entry["contains"] not in f.message:
+        return False
+    return bool(entry.get("code") or entry.get("path"))
+
+
+def apply_suppressions(ctx: RepoContext, findings: List[Finding],
+                       baseline: List[Dict]
+                       ) -> Tuple[List[Finding], List[Finding]]:
+    """Split into (live, suppressed)."""
+    live: List[Finding] = []
+    suppressed: List[Finding] = []
+    by_path = {f.path: f for f in ctx.files}
+    for f in findings:
+        sf = by_path.get(f.path)
+        if sf is not None and f.code in sf.disabled_codes(f.line):
+            suppressed.append(f)
+            continue
+        if any(_baseline_matches(e, f) for e in baseline):
+            suppressed.append(f)
+            continue
+        live.append(f)
+    return live, suppressed
+
+
+# -- running ------------------------------------------------------------
+
+def all_checks():
+    from . import (checks_cancel, checks_env, checks_exc, checks_lock,
+                   checks_metrics)
+    return [checks_env.check, checks_cancel.check, checks_metrics.check,
+            checks_lock.check, checks_exc.check]
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               baseline_path: Optional[str] = None
+               ) -> Tuple[List[Finding], List[Finding]]:
+    """Run every check over ``paths``; returns (live, suppressed)."""
+    ctx = build_context(paths, root=root)
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        if sf.parse_error is not None:
+            findings.append(Finding(
+                "GSKY-PARSE", sf.path,
+                sf.parse_error.lineno or 1,
+                f"file does not parse: {sf.parse_error.msg}"))
+    for check in all_checks():
+        findings.extend(check(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+    if baseline_path is None:
+        baseline_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+    baseline = load_baseline(baseline_path)
+    return apply_suppressions(ctx, findings, baseline)
+
+
+CHECK_DOCS = [
+    ("GSKY-ENV", "GSKY_* knob reads documented in docs/CONFIG.md; no "
+                 "stale doc rows; no module-level os.environ reads"),
+    ("GSKY-CANCEL", "wait loops cancellation/stop-aware; no blocking "
+                    "primitives inside async def bodies"),
+    ("GSKY-METRICS", "every gsky_* metric family registered once in "
+                     "gsky_tpu/obs/metrics.py with a parser-legal name"),
+    ("GSKY-LOCK", "no attribute of a lock-owning class mutated both "
+                  "with and without its lock held"),
+    ("GSKY-EXC", "no unannotated `except Exception: pass`; device "
+                 "errors subclass DeviceGuardError/BackendUnavailable"),
+]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.gskylint",
+        description="gsky-tpu repo-invariant static analysis "
+                    "(docs/ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    default=["gsky_tpu", "tools", "tests"],
+                    help="files/directories to lint "
+                         "(default: gsky_tpu tools tests)")
+    ap.add_argument("--baseline", default=None,
+                    help="suppression baseline JSON "
+                         "(default: tools/gskylint/baseline.json)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list-checks", action="store_true",
+                    help="print the check catalog and exit")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for code, doc in CHECK_DOCS:
+            print(f"{code:14s} {doc}")
+        return 0
+
+    paths = [p for p in args.paths if os.path.exists(p)]
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    for p in missing:
+        print(f"gskylint: no such path {p!r}", file=sys.stderr)
+    if not paths:
+        return 2
+
+    live, suppressed = lint_paths(paths, baseline_path=args.baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in live],
+            "suppressed": [f.__dict__ for f in suppressed],
+        }, indent=2))
+    else:
+        for f in live:
+            print(f.render())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"{f.render()}  [suppressed]")
+        print(f"gskylint: {len(live)} finding(s), "
+              f"{len(suppressed)} suppressed")
+    return 1 if live else 0

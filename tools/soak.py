@@ -198,6 +198,25 @@ def slowest_trace_report(host: str):
 
 
 def main(argv=None):
+    # GSKY_TSAN=1 (CI wave leg): patch threading.Lock/RLock BEFORE the
+    # in-process server builds any lock, run the scenario under lockset
+    # tracking, and fail the soak on any race report — the dynamic
+    # complement to gskylint's static GSKY-LOCK check.
+    from gsky_tpu.obs import tsan
+    tsan.maybe_install()
+    rc = _run(argv)
+    if tsan.installed():
+        stats = tsan.tsan_stats()
+        print(f"tsan: tracked_vars={stats['tracked_vars']} "
+              f"races={stats['races']}", flush=True)
+        if tsan.race_count():
+            print(tsan.report(), file=sys.stderr)
+            print("SOAK FAILED (tsan races)", flush=True)
+            return 1
+    return rc
+
+
+def _run(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=120.0)
     ap.add_argument("--conc", type=int, default=8)
@@ -1446,7 +1465,7 @@ def run_fleet(args, watcher, mas_client, merc, boot) -> int:
         for p, proc in procs.items():
             try:
                 proc.kill()
-            except Exception:
+            except Exception:  # process already exited
                 pass
 
 
@@ -1858,7 +1877,7 @@ def run_ingest(args, watcher, mas_client, merc, boot) -> int:
                 try:
                     urllib.request.urlopen(url_of(boxes[0]),
                                            timeout=120).read()
-                except Exception:
+                except Exception:  # priming failures tolerated - the timed walk decides
                     pass
                 time.sleep(min(1.0, pause * 4))
             statuses = []
