@@ -816,6 +816,221 @@ def bench_cfg_wave():
             os.environ.pop("GSKY_PALLAS", None)
 
 
+def bench_cfg_mesh():
+    """Mesh serving A/B (docs/MESH.md): the cfg_wave mosaic storm
+    dispatched (a) through single-chip waves (GSKY_MESH unset) and
+    (b) through the mesh dispatcher, whose granule layout shards each
+    wave's stacked tables across every chip so ONE device program
+    spans the mesh.  Headlines: Mpix/s per leg, scaling efficiency
+    (mesh Mpix/s over single-chip Mpix/s x chips), and dispatches per
+    1000 tiles per chip — with the mesh, one launch serves n_chips
+    more tiles-per-chip-program than a single-chip wave.  On CPU the
+    8 virtual devices share the same cores, so Mpix/s and efficiency
+    are correctness-exercise numbers; the dispatch amortisation and
+    the byte parity are platform-independent.  Writes the serving-path
+    MULTICHIP_r06.json record (extending the dryrun r01-r05 schema)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gsky_tpu.mesh import dispatch as mesh_dispatch
+    from gsky_tpu.ops import paged
+    from gsky_tpu.pipeline import waves as W
+    from gsky_tpu.pipeline.pages import PagePool
+
+    n_chips = len(jax.devices())
+    interp = jax.devices()[0].platform == "cpu"
+    prev_pallas = os.environ.get("GSKY_PALLAS")
+    prev_mesh = os.environ.get("GSKY_MESH")
+    if interp and not prev_pallas:
+        os.environ["GSKY_PALLAS"] = "interpret"
+
+    n_tiles = GRID * GRID
+    B, S, h, w, step, n_ns = 2, 96, 64, 64, 16, 1
+    wave_cap = 16
+    rng = np.random.default_rng(17)
+    stack = rng.uniform(1.0, 4000.0, (B, S, S)).astype(np.float32)
+    stack[0, 10:20, 10:20] = np.nan
+    params = np.zeros((B, 11), np.float32)
+    for k in range(B):
+        params[k] = [0.4 * k - 0.2, 1.01, 0.02, 0.3 * k, -0.01,
+                     0.99, S, S, -999.0, 100.0 - k, 0.0]
+    sp = np.array([10.0, 250.0, 0.0], np.float32)
+    statics = ("near", n_ns, (h, w), step, True, 0)
+    gh = (h - 1 + step - 1) // step + 1
+
+    def tile_ctrl(i):
+        base = 4.0 + (i % 8) * 1.5
+        lin = np.linspace(base, S - 12.0, gh, dtype=np.float32)
+        return np.stack([lin[None, :].repeat(gh, 0),
+                         lin[:, None].repeat(gh, 1)])
+
+    ctrls = [tile_ctrl(i) for i in range(n_tiles)]
+
+    def stage(pool):
+        tabs = []
+        ni = -(-S // pool.page_rows)
+        nj = -(-S // pool.page_cols)
+        for k in range(B):
+            t = pool.table_for(jnp.asarray(stack[k]), k + 1,
+                               0, ni - 1, 0, nj - 1)
+            tabs.append(t)
+        Ssl = 1
+        while Ssl < max(t.size for t in tabs):
+            Ssl *= 2
+        tables = np.zeros((B, Ssl), np.int32)
+        p16 = np.zeros((B, paged.PARAMS_W), np.float32)
+        p16[:, :11] = params
+        for k, t in enumerate(tabs):
+            tables[k, :t.size] = t
+            p16[k, 13] = ni * pool.page_rows
+            p16[k, 14] = nj * pool.page_cols
+            p16[k, 15] = nj
+        return tables, p16
+
+    def leg(mesh_on):
+        """One storm pass to warm the programs, a second timed — the
+        mesh leg's first wave pays the shard_map compile and that must
+        not masquerade as serving throughput."""
+        if mesh_on:
+            os.environ["GSKY_MESH"] = "1"
+        else:
+            os.environ.pop("GSKY_MESH", None)
+        mesh_dispatch.reset_mesh()
+        pool = PagePool(capacity=64, page_rows=64, page_cols=128)
+        elapsed = None
+        st = mesh_st = None
+        errors = []
+        results = [None] * n_tiles
+        for timed in (False, True):
+            sched = W.WaveScheduler(max_entries=wave_cap,
+                                    tick_ms=5000.0)
+            results = [None] * n_tiles
+
+            def submit(i):
+                tb, p16 = stage(pool)
+
+                def go():
+                    try:
+                        results[i] = sched.render_byte(
+                            pool, tb, p16, ctrls[i], sp, statics,
+                            (jnp.asarray(stack), jnp.asarray(params),
+                             None, None), None)
+                    except Exception as e:   # noqa: BLE001 - reported
+                        errors.append(repr(e))
+                t = threading.Thread(target=go)
+                t.start()
+                return t
+
+            t0 = time.perf_counter()
+            ts = [submit(i) for i in range(n_tiles)]
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                with sched._lock:
+                    if len(sched._pending) >= n_tiles:
+                        break
+                time.sleep(0.002)
+            while sched.run_wave():
+                pass
+            for t in ts:
+                t.join(timeout=300)
+            if timed:
+                elapsed = time.perf_counter() - t0
+                st = sched.stats()
+                mesh_st = mesh_dispatch.mesh_stats()
+            sched.shutdown()
+        return results, elapsed, st, mesh_st, errors, pool
+
+    try:
+        res_1c, s_1c, st_1c, _, err_1c, pool_1c = leg(False)
+        res_m, s_m, st_m, mesh_st, err_m, pool_m = leg(True)
+        mpix = n_tiles * h * w / 1e6
+        mpix_1c = round(mpix / s_1c, 2) if s_1c else None
+        mpix_m = round(mpix / s_m, 2) if s_m else None
+        disp_1c = max(1, st_1c["dispatches"])
+        disp_m = max(1, st_m["dispatches"])
+        parity = (not err_1c and not err_m
+                  and all(r is not None for r in res_1c + res_m)
+                  and all(np.array_equal(a, b)
+                          for a, b in zip(res_1c, res_m)))
+        # one mesh launch spans every chip, so each chip's share of
+        # the storm rides disp_m launches: tiles-per-chip per launch
+        eff = (round(mpix_m / (mpix_1c * n_chips), 3)
+               if mpix_1c and mpix_m else None)
+        out = {
+            "workload": f"{n_tiles} multi-granule mosaic tiles "
+                        f"({B} granules, {h}px) through the wave "
+                        f"scheduler, single-chip vs {n_chips}-chip "
+                        "granule-sharded mesh waves",
+            "unit": "Mpix/s",
+            "value": mpix_m,
+            "chips": n_chips,
+            "single_chip": {
+                "mpix_s": mpix_1c,
+                "dispatches": st_1c["dispatches"],
+                "dispatches_per_1k_tiles":
+                    round(disp_1c / n_tiles * 1e3, 1),
+                "tiles_per_dispatch_per_chip":
+                    round(n_tiles / disp_1c, 2),
+                "elapsed_s": round(s_1c, 3)},
+            "mesh": {
+                "mpix_s": mpix_m,
+                "dispatches": st_m["dispatches"],
+                "dispatches_per_1k_tiles":
+                    round(disp_m / n_tiles * 1e3, 1),
+                "tiles_per_dispatch_per_chip":
+                    round(n_tiles / disp_m / n_chips, 2),
+                "waves_by_layout": mesh_st.get("waves_by_layout"),
+                "skew_ms_last": mesh_st.get("skew_ms_last"),
+                "elapsed_s": round(s_m, 3)},
+            "scaling_efficiency": eff,
+            "parity_bit_exact": parity,
+            "errors": (err_1c + err_m)[:3],
+            "interpret": interp,
+        }
+        if interp:
+            out["note"] = ("the 8 'chips' are XLA host-platform "
+                           "devices sharing one CPU: Mpix/s and "
+                           "efficiency are correctness-exercise "
+                           "numbers; dispatch amortisation and byte "
+                           "parity are platform-independent")
+        try:
+            rec = {"n_devices": n_chips, "rc": 0,
+                   "ok": bool(parity), "skipped": False,
+                   "serving": {
+                       "path": "waves+mesh (pipeline/waves.py -> "
+                               "mesh/dispatch.py)",
+                       "mpix_s": {"single_chip": mpix_1c,
+                                  "mesh": mpix_m},
+                       "scaling_efficiency": eff,
+                       "dispatches_per_1k_tiles": {
+                           "single_chip":
+                               round(disp_1c / n_tiles * 1e3, 1),
+                           "mesh": round(disp_m / n_tiles * 1e3, 1)},
+                       "waves_by_layout":
+                           mesh_st.get("waves_by_layout"),
+                       "interpret": interp},
+                   "tail": f"serving_mesh OK: {n_chips} chips, "
+                           f"layouts={mesh_st.get('waves_by_layout')} "
+                           f"parity={'bit-exact' if parity else 'FAIL'}"
+                           f" amortisation {disp_1c}->{disp_m} "
+                           f"dispatches/{n_tiles} tiles\n"}
+            path = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "MULTICHIP_r06.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(rec, f, indent=2)
+        except OSError:
+            pass
+        return out
+    finally:
+        if prev_mesh is None:
+            os.environ.pop("GSKY_MESH", None)
+        else:
+            os.environ["GSKY_MESH"] = prev_mesh
+        mesh_dispatch.reset_mesh()
+        if interp and not prev_pallas:
+            os.environ.pop("GSKY_PALLAS", None)
+
+
 def bench_cfg_ingest(store, utm, tmp):
     """Config ingest: ranged-vs-whole-file A/B (docs/INGEST.md).
 
@@ -1155,6 +1370,7 @@ def run_all():
         "cfg6_wcs_pipelined": bench_cfg6_wcs_pipelined(store, utm, tmp),
         "cfg_ragged": bench_ragged(),
         "cfg_wave": bench_cfg_wave(),
+        "cfg_mesh": bench_cfg_mesh(),
         "cfg_ingest": bench_cfg_ingest(store, utm, tmp),
     }
 
@@ -1228,6 +1444,22 @@ def main(argv=None):
                     "wave": cw["wave"]["dispatches_per_1k_tiles"]},
                 "occupancy": cw["wave"]["occupancy"],
                 "amortisation_x": cw.get("value")}
+        cm = configs.get("cfg_mesh") or {}
+        if cm.get("mesh"):
+            kernels["mesh_dispatch"] = {
+                "chips": cm.get("chips"),
+                "mpix_s": {"single_chip": cm["single_chip"]["mpix_s"],
+                           "mesh": cm["mesh"]["mpix_s"]},
+                "scaling_efficiency": cm.get("scaling_efficiency"),
+                "dispatches_per_1k_tiles": {
+                    "single_chip":
+                        cm["single_chip"]["dispatches_per_1k_tiles"],
+                    "mesh": cm["mesh"]["dispatches_per_1k_tiles"]},
+                "tiles_per_dispatch_per_chip": {
+                    "single_chip":
+                        cm["single_chip"]["tiles_per_dispatch_per_chip"],
+                    "mesh": cm["mesh"]["tiles_per_dispatch_per_chip"]},
+                "waves_by_layout": cm["mesh"]["waves_by_layout"]}
     except Exception:   # noqa: BLE001 - reporting only
         pass
 

@@ -100,25 +100,39 @@ def _append(doc: Dict) -> None:
         pass
 
 
-def record_stage(serial: int, pi: int, pj: int) -> None:
+def _chip_doc(doc: Dict, chip) -> Dict:
+    """Mesh serving annotates events with the owning chip index; the
+    field is additive — schema v1 `replay()` reads only the keys it
+    knows, so journals mixing chip-tagged and untagged lines replay on
+    either side of an upgrade."""
+    if chip is not None:
+        doc["chip"] = int(chip)
+    return doc
+
+
+def record_stage(serial: int, pi: int, pj: int, chip=None) -> None:
     if journal_enabled():
-        _append({"op": "stage", "serial": int(serial),
-                 "pi": int(pi), "pj": int(pj)})
+        _append(_chip_doc({"op": "stage", "serial": int(serial),
+                           "pi": int(pi), "pj": int(pj)}, chip))
 
 
-def record_heat(serial: int, pi: int, pj: int, hits: int) -> None:
+def record_heat(serial: int, pi: int, pj: int, hits: int,
+                chip=None) -> None:
     if journal_enabled():
-        _append({"op": "heat", "serial": int(serial),
-                 "pi": int(pi), "pj": int(pj), "hits": int(hits)})
+        _append(_chip_doc({"op": "heat", "serial": int(serial),
+                           "pi": int(pi), "pj": int(pj),
+                           "hits": int(hits)}, chip))
 
 
-def record_drop(serial: int) -> None:
+def record_drop(serial: int, chip=None) -> None:
     if journal_enabled():
-        _append({"op": "drop", "serial": int(serial)})
+        _append(_chip_doc({"op": "drop", "serial": int(serial)}, chip))
 
 
-def replay() -> List[Tuple[int, int, int]]:
+def replay(chip_map: Optional[Dict] = None) -> List[Tuple[int, int, int]]:
     """Merge the journal into a hottest-first ``[(serial, pi, pj)]``.
+    ``chip_map`` (optional out-param) collects the per-chip ownership
+    tags mesh serving appends — see :func:`replay_chips`.
 
     Priority is (accumulated heat + stage count, recency): a page the
     pool dumped with 17 hits outranks a page staged once and never
@@ -130,6 +144,7 @@ def replay() -> List[Tuple[int, int, int]]:
         return []
     score: Dict[Tuple[int, int, int], float] = {}
     last: Dict[Tuple[int, int, int], int] = {}
+    chips: Dict[Tuple[int, int, int], int] = {}
     try:
         with open(journal_path(), "r", encoding="utf-8",
                   errors="replace") as fp:
@@ -172,9 +187,25 @@ def replay() -> List[Tuple[int, int, int]]:
                         pass
                 score[key] = score.get(key, 0.0) + w
                 last[key] = idx
+                try:
+                    chips[key] = int(doc["chip"])
+                except (KeyError, TypeError, ValueError):
+                    pass
     except OSError:
         return []
+    if chip_map is not None:
+        chip_map.update(chips)
     return sorted(score, key=lambda k: (-score[k], -last[k]))
+
+
+def replay_chips() -> Tuple[List[Tuple[int, int, int]],
+                            Dict[Tuple[int, int, int], int]]:
+    """`replay()` plus the chip-ownership tags mesh serving journals:
+    (hottest-first page list, {(serial, pi, pj): chip}).  Pages
+    journaled without a chip tag are absent from the map —
+    `MeshPools.rehydrate_all` hashes those to their owner."""
+    chips: Dict[Tuple[int, int, int], int] = {}
+    return replay(chip_map=chips), chips
 
 
 def clear() -> None:

@@ -1,0 +1,29 @@
+"""Multi-chip mesh serving: the `parallel/` dryrun promoted into the
+real request path.
+
+- `rules`    — declarative partition rules: a regex table mapping wave
+  workload descriptors (kind, statics, output shape) to a mesh layout,
+  with a replicated (single-chip) fallback;
+- `pools`    — per-chip page pools and shard-aware scene staging:
+  pages `device_put` directly onto their owning chip instead of
+  uploading to device 0 and letting jit re-shard;
+- `dispatch` — wave integration: a drained wave's stacked tables /
+  params get a `NamedSharding` over the full mesh so one device
+  program spans all chips, plus the `GSKY_SPMD` compat shim.
+
+`GSKY_MESH=1` enables mesh dispatch inside the wave scheduler;
+`GSKY_MESH=0` (the default off state) keeps single-chip waves
+byte-identically — the mesh branch sits strictly above the existing
+dispatch path (see docs/MESH.md).
+"""
+
+from .dispatch import (MeshDispatcher, active_mesh, compat_spmd,
+                       default_mesh, mesh_enabled, mesh_stats,
+                       reset_mesh)
+from .rules import Rule, RuleError, describe, match_rules, parse_rules
+
+__all__ = [
+    "MeshDispatcher", "Rule", "RuleError", "active_mesh", "compat_spmd",
+    "default_mesh", "describe", "match_rules", "mesh_enabled",
+    "mesh_stats", "parse_rules", "reset_mesh",
+]

@@ -1,0 +1,202 @@
+"""Per-chip page pools and shard-aware scene staging.
+
+The single-chip serving path uploads every scene to the default device
+and stages pages into one `PagePool`; on a mesh that means all HBM
+traffic lands on chip 0 and jit re-shards on every dispatch.  Here
+each chip owns a `ChipPagePool` whose backing array is committed to
+that chip, and scenes consistently hash (by scene serial) to an owning
+chip so their pages are `device_put` directly where the layout will
+read them.  The device-guard journal records the owning chip with each
+stage/heat line (additive schema field — old replays ignore it), so
+warm recovery after a per-chip incident re-stages each chip's own hot
+set (`rehydrate_all`).
+
+Placement is gated by ``GSKY_MESH_PLACE=1`` (requires ``GSKY_MESH=1``):
+wave groups key on the pool object, so per-chip placement automatically
+partitions a drained wave into per-chip groups that dispatch
+concurrently on their owning chips.  With placement off (the default)
+mesh serving uses the shared pool replicated across the mesh by the
+wave-axis `NamedSharding` program (mesh/dispatch.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..pipeline.pages import PagePool
+from .dispatch import mesh_enabled
+
+
+def place_enabled() -> bool:
+    """Per-chip page placement gate: GSKY_MESH_PLACE=1 on top of an
+    enabled mesh (more than one device)."""
+    return os.environ.get("GSKY_MESH_PLACE", "0") == "1" \
+        and mesh_enabled()
+
+
+class ChipPagePool(PagePool):
+    """A `PagePool` committed to one chip: the pool array allocates on
+    the owning device and every staged scene page is `device_put`
+    there BEFORE the staging write, so the donated in-place update
+    runs on-chip instead of uploading to device 0 and re-sharding."""
+
+    def __init__(self, device, chip: int, **kw):
+        self.device = device
+        super().__init__(**kw)
+        self.chip = int(chip)
+
+    def _ensure_pool(self):  # gskylint: holds-lock
+        if self._pool is None:
+            self._pool = jax.device_put(
+                jnp.full((self.capacity, self.page_rows, self.page_cols),
+                         jnp.nan, jnp.float32), self.device)
+
+    def _place(self, dev):  # gskylint: holds-lock
+        return jax.device_put(dev, self.device)
+
+    def stats(self):
+        st = super().stats()
+        st["chip"] = self.chip
+        st["device"] = str(self.device)
+        return st
+
+
+class MeshPools:
+    """One `ChipPagePool` per mesh chip + the serial->chip ownership
+    hash.  Thread-safe; the supervisor tears down / rehydrates per
+    chip so one poisoned pool never cold-starts its neighbours."""
+
+    def __init__(self, devices: Optional[List] = None,
+                 capacity: Optional[int] = None):
+        if devices is None:
+            from ..parallel.mesh import make_mesh
+            devices = list(make_mesh().devices.flat)
+        self.devices = list(devices)
+        self.pools = [ChipPagePool(d, i, capacity=capacity)
+                      for i, d in enumerate(self.devices)]
+        self._lock = threading.Lock()
+        from ..obs import tsan
+        if tsan.enabled():
+            # lockset tracking across staging / supervisor threads
+            tsan.track(self, "MeshPools")
+
+    @property
+    def n_chips(self) -> int:
+        return len(self.pools)
+
+    def chip_for(self, serial: int) -> int:
+        """Consistent scene->chip ownership: pages of one scene always
+        co-locate, and the assignment survives restarts (it is a pure
+        function of the serial, which the journal records)."""
+        return int(serial) % len(self.pools)
+
+    def pool_for(self, serial: int) -> ChipPagePool:
+        return self.pools[self.chip_for(serial)]
+
+    def device_for(self, serial: int):
+        return self.devices[self.chip_for(serial)]
+
+    def pinned_total(self) -> int:
+        n = 0
+        for p in self.pools:
+            with p.lock:
+                n += sum(1 for c in p._pins.values() if c)
+        return n
+
+    def teardown_chip(self, chip: int) -> None:
+        """Per-chip incident response: dump the chip's heat lines and
+        drop only ITS pool — the other chips keep serving warm."""
+        self.pools[int(chip)].teardown()
+
+    def rehydrate_all(self) -> Dict[int, int]:
+        """Warm recovery across the mesh: replay the journal once and
+        route each page to the chip that owned it (falling back to the
+        ownership hash for lines journaled before chip tagging).
+        Returns {chip: pages restored}."""
+        from ..device_guard import journal
+        entries, chips = journal.replay_chips()
+        if not entries:
+            return {}
+        try:
+            from ..pipeline.scene_cache import default_scene_cache as sc
+            with sc._lock:
+                scenes = {s.serial: s.dev for s in sc._scenes.values()}
+        except Exception:
+            return {}
+        restored: Dict[int, int] = {}
+        for serial, pi, pj in entries:
+            dev = scenes.get(serial)
+            if dev is None:
+                continue
+            chip = chips.get((serial, pi, pj), self.chip_for(serial))
+            if not 0 <= chip < len(self.pools):
+                continue
+            pool = self.pools[chip]
+            gh = -(-int(dev.shape[0]) // pool.page_rows)
+            gw = -(-int(dev.shape[1]) // pool.page_cols)
+            if pi >= gh or pj >= gw:
+                continue
+            with pool.lock:
+                if not pool._free \
+                        and (serial, pi, pj) not in pool._slots:
+                    continue
+                if pool._stage_locked(dev, serial, pi, pj) is not None:
+                    restored[chip] = restored.get(chip, 0) + 1
+        for chip, n in restored.items():
+            with self.pools[chip].lock:
+                self.pools[chip].rehydrated += n
+        return restored
+
+    def stats(self) -> Dict:
+        return {"chips": len(self.pools),
+                "placement": place_enabled(),
+                "pinned": self.pinned_total(),
+                "pools": [p.stats() for p in self.pools]}
+
+
+# -- module singleton ---------------------------------------------------
+
+_default: Optional[MeshPools] = None
+_default_lock = threading.Lock()
+
+
+def default_mesh_pools() -> MeshPools:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = MeshPools()
+    return _default
+
+
+def active_mesh_pools() -> Optional[MeshPools]:
+    """The live registry or None — scrape collectors must not allocate
+    eight device arrays to report."""
+    return _default
+
+
+def reset_mesh_pools():
+    global _default
+    with _default_lock:
+        _default = None
+
+
+def staging_pool(serial: int) -> Optional[PagePool]:
+    """The owning chip's pool for scene `serial` when per-chip
+    placement is on, else None (callers use the shared default)."""
+    if not place_enabled():
+        return None
+    return default_mesh_pools().pool_for(serial)
+
+
+def staging_device(serial: int):
+    """The owning chip for scene `serial`'s host->device upload when
+    placement is on, else None (scene_cache uses the default device)."""
+    if not place_enabled():
+        return None
+    return default_mesh_pools().device_for(serial)

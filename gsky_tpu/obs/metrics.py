@@ -54,6 +54,18 @@ WAVE_ASSEMBLY_MS = _REG.histogram(
     "gsky_wave_assembly_ms",
     "Wave assembly + dispatch-enqueue time (milliseconds).",
     buckets=log_buckets(0.01, 100.0))
+MESH_WAVES = _REG.counter(
+    "gsky_mesh_waves_total",
+    "Mesh wave dispatches by partition layout.",
+    ["layout"])
+MESH_CHIP_OCCUPANCY = _REG.histogram(
+    "gsky_mesh_chip_occupancy",
+    "Wave entries landing on each chip per mesh dispatch.",
+    buckets=[1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0])
+MESH_SHARD_SKEW_MS = _REG.histogram(
+    "gsky_mesh_shard_skew_ms",
+    "Per-chip readback readiness spread per mesh wave (milliseconds).",
+    buckets=log_buckets(0.01, 1000.0))
 TRACE_EVENTS = _REG.counter(
     "gsky_trace_events_total",
     "Cross-cutting events (retry, breaker_open, hedge, reroute, shed).",
@@ -429,6 +441,32 @@ def _collect_waves():
     return out
 
 
+def _collect_mesh():
+    """Mesh-serving surfaces (docs/MESH.md): chip count and per-layout
+    entry totals from the live dispatcher — collected at scrape time
+    so there is one counter, not two copies to drift.  The per-wave
+    layout/occupancy/skew distributions are the module-level families
+    above, observed at the dispatch site itself."""
+    out: List = []
+    try:
+        from ..mesh.dispatch import active_mesh
+        md = active_mesh()
+        if md is not None:   # don't build a mesh to report
+            st = md.stats()
+            out.append(_g("gsky_mesh_chips",
+                          "Chips in the serving mesh.",
+                          [({}, float(st.get("chips", 0)))]))
+            ent = st.get("entries_by_layout") or {}
+            if ent:
+                out.append(_c("gsky_mesh_entries_total",
+                              "Wave entries dispatched by layout.",
+                              [({"layout": k}, float(v))
+                               for k, v in sorted(ent.items())]))
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
+        pass
+    return out
+
+
 def _collect_tsan():
     """Lockset race-sanitizer surfaces (docs/ANALYSIS.md): only the
     race count — a non-zero value fails the GSKY_TSAN=1 CI soak leg,
@@ -454,7 +492,7 @@ def _collect_tsan():
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
             _collect_runtime, _collect_batcher, _collect_overload,
             _collect_ingest, _collect_device, _collect_waves,
-            _collect_tsan):
+            _collect_mesh, _collect_tsan):
     _REG.register_collector(_fn)
 
 

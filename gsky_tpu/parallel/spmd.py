@@ -295,17 +295,16 @@ class SpmdRenderer:
         return v[:B], c[:B]
 
 
-_default: Optional[SpmdRenderer] = None
-_default_lock = threading.Lock()
-
-
 def default_spmd() -> Optional[SpmdRenderer]:
     """Process-wide renderer over the full device mesh when SPMD is
-    enabled, else None (callers fall back to single-device paths)."""
-    global _default
-    if not spmd_enabled():
-        return None
-    with _default_lock:
-        if _default is None:
-            _default = SpmdRenderer()
-        return _default
+    enabled, else None (callers fall back to single-device paths).
+
+    COMPAT SHIM (PR 14): singleton ownership moved to the mesh
+    subsystem — `gsky_tpu.mesh.dispatch` holds the one `SpmdRenderer`
+    that both the old ``GSKY_SPMD`` direct-dispatch routing and the
+    mesh ``x`` layout share, so exactly one sharded code path (and one
+    program cache) exists.  This alias delegates; new code should call
+    `gsky_tpu.mesh.compat_spmd` (pipeline/executor and pipeline/drill
+    already do)."""
+    from ..mesh.dispatch import compat_spmd
+    return compat_spmd()

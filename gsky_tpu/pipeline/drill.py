@@ -553,12 +553,12 @@ def _stats_tail(dataf, validf, req: GeoDrillRequest):
     inputs reduce in numpy, see `_stats_host`)."""
     if isinstance(dataf, np.ndarray):
         return _stats_host(dataf, validf, req)
-    from ..parallel.spmd import default_spmd
-    spmd = default_spmd()
+    from ..mesh.dispatch import compat_spmd
+    spmd = compat_spmd()
     if spmd is not None and not req.deciles:
-        # mesh path: bands over `granule`, pixels over `x` + psum
-        # (deciles need a global sort — those requests stay single-
-        # device)
+        # mesh path (GSKY_SPMD=1 compat routing): bands over
+        # `granule`, pixels over `x` + psum (deciles need a global
+        # sort — those requests stay single-device)
         v, c = spmd.masked_stats(dataf, validf, req.clip_lower,
                                  req.clip_upper, req.pixel_count)
         return (np.asarray(v), np.asarray(c),

@@ -27,7 +27,7 @@ from ..ops.paged import paged_enabled
 from ..ops.pallas_tpu import render_byte_raced, warp_scored_raced
 from ..ops.warp import (combine_scored, render_scenes_bands_ctrl,
                         warp_gather_batch)
-from ..parallel.spmd import default_spmd
+from ..mesh.dispatch import compat_spmd
 from .decode import DecodedWindow
 
 # padded source-window shape buckets (H and W independently bucketed)
@@ -526,11 +526,11 @@ class WarpExecutor:
         n_pad = _bucket_pow2(n_ns)
         if len(groups) == 1:
             stack, _, params, step, _, ctrl_dev, win, win0, *_ = groups[0]
-            spmd = default_spmd()
+            spmd = compat_spmd()
             if spmd is not None:
-                # mesh path (GSKY_SPMD=1): granule axis over `granule`,
-                # width over `x` — the production fused mosaic on
-                # 1..N chips (SURVEY §2.8 P5/P6 on ICI)
+                # mesh path (GSKY_SPMD=1 compat routing): granule axis
+                # over `granule`, width over `x` — the mesh-owned
+                # fused mosaic on 1..N chips (SURVEY §2.8 P5/P6)
                 self._count("scene_mosaic_spmd", (stack.shape, win))
                 self._note_win(win)
                 canv, best = spmd.mosaic_scored(
@@ -646,7 +646,7 @@ class WarpExecutor:
         sp = np.array([offset, scale, clip], np.float32)
         statics = (method, _bucket_pow2(n_ns), (height, width), step,
                    auto, colour_scale)
-        spmd = default_spmd()
+        spmd = compat_spmd()
         if spmd is not None:
             self._count("render_byte_spmd", (stack.shape, win))
             self._note_win(win)
@@ -884,6 +884,18 @@ class WarpExecutor:
         from .pages import default_page_pool
         (_, ctrl, _, _, _, _, _, _, _, gs, params64) = group
         pool = default_page_pool()
+        if gs:
+            # mesh per-chip placement (GSKY_MESH_PLACE=1): the group's
+            # pages stage into the pool on the chip that owns its lead
+            # scene; wave groups key on the pool object, so per-chip
+            # groups dispatch concurrently on their owning chips
+            try:
+                from ..mesh.pools import staging_pool
+                chip_pool = staging_pool(int(gs[0].serial))
+            except Exception:   # pragma: no cover - mesh optional
+                chip_pool = None
+            if chip_pool is not None:
+                pool = chip_pool
         pr, pc = pool.page_rows, pool.page_cols
         cx = np.asarray(ctrl[0], np.float64)
         cy = np.asarray(ctrl[1], np.float64)

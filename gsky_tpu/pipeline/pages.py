@@ -123,6 +123,10 @@ class PagePool:
             # threads (docs/ANALYSIS.md "Race sanitizer")
             tsan.track(self, "PagePool")
 
+    # owning-chip index (mesh serving): None on the shared pool; a
+    # ChipPagePool (mesh/pools.py) sets it and journal lines carry it
+    chip = None
+
     # -- internals (hold self.lock) -----------------------------------
 
     def _ensure_pool(self):  # gskylint: holds-lock
@@ -132,6 +136,12 @@ class PagePool:
             self._pool = jnp.full(
                 (self.capacity, self.page_rows, self.page_cols),
                 jnp.nan, jnp.float32)
+
+    def _place(self, dev):  # gskylint: holds-lock
+        """Placement hook for the staged scene array: the shared pool
+        leaves uploads wherever the scene cache put them; a per-chip
+        pool overrides this to `device_put` onto its owning chip."""
+        return dev
 
     def _take_slot(self):  # gskylint: holds-lock
         if self._free:
@@ -163,7 +173,7 @@ class PagePool:
             # donating a CPU-backed buffer warns; the fallback copy is
             # still correct, just not in-place
             warnings.simplefilter("ignore")
-            self._pool = _stage(self._pool, dev,
+            self._pool = _stage(self._pool, self._place(dev),
                                 jnp.asarray((pi, pj), jnp.int32),
                                 jnp.int32(slot))
         self._slots[key] = slot
@@ -173,7 +183,7 @@ class PagePool:
         if guard_enabled():
             # warm-recovery breadcrumb: cold stages only, so the write
             # rate tracks decode churn, not the (much hotter) hit rate
-            journal.record_stage(*key)
+            journal.record_stage(*key, chip=self.chip)
             if pool_audit_enabled():
                 # stage-time CRC for the corruption audit: one page
                 # readback per cold stage — the documented cost of
@@ -290,7 +300,8 @@ class PagePool:
         with self.lock:
             if guard_enabled():
                 for key in self._slots:
-                    journal.record_heat(*key, hits=self._heat.get(key, 0))
+                    journal.record_heat(*key, hits=self._heat.get(key, 0),
+                                        chip=self.chip)
             self._pool = None
             self._slots.clear()
             self._pins.clear()

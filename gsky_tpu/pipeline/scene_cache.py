@@ -59,6 +59,22 @@ def _bucket(n: int, step: int = 256) -> int:
     return max(step, (n + step - 1) // step * step)
 
 
+def _put_scene(data, serial: int):
+    """Shard-aware host->device upload: under mesh per-chip placement
+    (GSKY_MESH_PLACE=1) the scene ships straight to its owning chip —
+    the chip whose page pool will stage its pages — instead of to
+    device 0 and letting jit re-shard.  Single-chip / placement-off
+    keeps the plain async `device_put` unchanged."""
+    try:
+        from ..mesh.pools import staging_device
+        dev = staging_device(serial)
+    except Exception:   # pragma: no cover - mesh optional at runtime
+        dev = None
+    if dev is None:
+        return jax.device_put(data)
+    return jax.device_put(data, dev)
+
+
 class SceneCache:
     def __init__(self, max_bytes: int = 2 << 30,
                  max_scene_px: int = 64 << 20):
@@ -328,13 +344,15 @@ class SceneCache:
                 valid = nodata_mask(view, nd)
                 valid &= np.isfinite(view)
                 view[~valid] = np.nan
-            dev = jax.device_put(sbuf)
+            serial = next(_scene_serial)
+            dev = _put_scene(sbuf, serial)
             spool.release(sbuf, dev)
             _istats.record_whole(H * W * h.dtype.itemsize)
             with self._lock:
                 self.staged_loads += 1
             return DeviceScene(dev=dev, height=H, width=W,
-                               nodata=float("nan"), gt=gt, crs=crs)
+                               nodata=float("nan"), gt=gt, crs=crs,
+                               serial=serial)
         _istats.record_whole(data.nbytes)
         true_h, true_w = data.shape
         # NaN-encode ONCE at load: invalid pixels (nodata / non-finite)
@@ -366,9 +384,11 @@ class SceneCache:
         # consumes the scene synchronizes.  nbytes accounting is exact
         # either way: the cache charges bucket dims x itemsize, which
         # is precisely the committed device allocation.
-        dev = jax.device_put(data)
+        serial = next(_scene_serial)
+        dev = _put_scene(data, serial)
         return DeviceScene(dev=dev, height=true_h, width=true_w,
-                           nodata=float("nan"), gt=gt, crs=crs)
+                           nodata=float("nan"), gt=gt, crs=crs,
+                           serial=serial)
 
 
 # module-level default (shared across pipelines/requests)

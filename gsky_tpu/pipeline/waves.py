@@ -256,7 +256,12 @@ class WaveScheduler:
                 continue
             if item is None:
                 return
-            kind, entries, devs = item
+            kind, entries, devs, obs = item
+            if obs is not None:
+                # mesh wave: per-chip shard probe BEFORE the gather —
+                # records readiness skew on this (async) thread so the
+                # ticker never blocks on a straggler chip
+                obs(devs)
             try:
                 host = device_guard.guarded_readback(
                     "wave.readback",
@@ -306,11 +311,25 @@ class WaveScheduler:
         for e in live:
             groups.setdefault((e.kind, e.key), []).append(e)
         dispatched = 0
+        # mesh serving (GSKY_MESH=1): every group consults the
+        # partition rules; disabled, md is None and the single-chip
+        # dispatch below runs byte-identically
+        try:
+            from ..mesh.dispatch import default_mesh
+            md = default_mesh()
+        except Exception:   # pragma: no cover - mesh boot failure
+            md = None
         for (kind, _key), es in groups.items():
             try:
-                devs = device_guard.run(
-                    "dispatch.wave",
-                    lambda k=kind, g=es: self._dispatch_group(k, g))
+                if md is not None:
+                    devs = device_guard.run(
+                        "dispatch.wave",
+                        lambda m=md, k=kind, g=es:
+                        m.dispatch_wave(self, k, g))
+                else:
+                    devs = device_guard.run(
+                        "dispatch.wave",
+                        lambda k=kind, g=es: self._dispatch_group(k, g))
             except Exception as exc:
                 # device incident mid-wave: the wave never fails as a
                 # unit — each request re-renders per-call
@@ -326,7 +345,9 @@ class WaveScheduler:
                 WAVE_OCCUPANCY.observe(float(len(es)))
             except Exception:  # prom telemetry only
                 pass
-            self._readback_q.put((kind, es, devs))
+            self._readback_q.put(
+                (kind, es, devs,
+                 md.observe_shards if md is not None else None))
             with self._lock:
                 self.readback_depth_max = max(
                     self.readback_depth_max, self._readback_q.qsize())

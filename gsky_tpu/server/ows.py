@@ -303,6 +303,15 @@ class OWSServer:
         except Exception:  # spmd module optional in this build
             pass
         try:
+            from ..mesh.dispatch import mesh_stats
+            from ..mesh.pools import active_mesh_pools
+            doc["mesh"] = mesh_stats()
+            mp = active_mesh_pools()
+            if mp is not None:
+                doc["mesh"]["pools"] = mp.stats()
+        except Exception:  # mesh module optional in this build
+            pass
+        try:
             from ..pipeline.drill_cache import default_drill_cache as dc
             from ..pipeline.executor import default_executor as ex
             from ..pipeline.scene_cache import default_scene_cache as sc

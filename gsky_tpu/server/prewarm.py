@@ -36,7 +36,11 @@ wave-size axis covers the stacked programs the wave scheduler
 (pipeline/waves.py) dispatches: each wave of N tiles pads N to pow2
 and that pad IS the leading compile dim, so sweeping pow2 wave sizes
 up to GSKY_WAVE_MAX means the first mosaic storm after a deploy rides
-warm programs at every occupancy the scheduler can assemble.
+warm programs at every occupancy the scheduler can assemble.  When
+mesh serving is live (GSKY_MESH, gsky_tpu/mesh/) the same lattice
+gains the mesh-layout axis: the granule-sharded byte/scored wave
+programs and the time-sharded drill reduction compile here too
+(docs/MESH.md).
 
 Knobs: GSKY_PREWARM=0 disables; GSKY_PREWARM_SIZES (tile edges,
 default "256"), GSKY_PREWARM_BUCKET (scene bucket edge, default 512),
@@ -363,7 +367,39 @@ def prewarm(configs: Dict,
                         (hw, hw), step, auto, colour_scale,
                         win=None, win0=None)
 
+    mesh_programs = 0
+    if paged_enabled():
+        # mesh-layout axis: when GSKY_MESH serving is live, the same
+        # (method, granule, slot, wave-size) lattice also compiles the
+        # granule-sharded wave programs + the time-sharded drill, so
+        # the first multi-chip storm after a deploy rides warm programs
+        try:
+            from ..mesh.dispatch import default_mesh
+            md = default_mesh()
+        except Exception:
+            md = None
+        if md is not None:
+            from ..pipeline.pages import default_page_pool
+            pool = default_page_pool()
+            batches = sorted({_bucket_pow2(b)
+                              for b in range(1, max_scenes + 1)})
+            scap = _bucket_pow2(page_slots())
+            slot_sweep = [s for s in (1, 2, 4, 8)
+                          if s <= scap
+                          and paged_vmem_ok(s, _bucket_pow2(1),
+                                            pool.page_rows,
+                                            pool.page_cols)]
+            try:
+                mesh_programs = md.prewarm_programs(
+                    pool, specs, sizes, batches, slot_sweep,
+                    wave_size_lattice(), step)
+                programs += mesh_programs
+            except Exception as e:
+                failures += 1
+                log.warning("prewarm mesh lattice: %s", e)
+
     out = {"specs": len(specs), "programs": programs,
+           "mesh_programs": mesh_programs,
            "failures": failures, "compiles": compile_count() - c0,
            "seconds": round(time.perf_counter() - t0, 3)}
     log.info("prewarm: %s", out)

@@ -1,0 +1,415 @@
+"""Mesh serving (`gsky_tpu/mesh/`): declarative partition rules
+(precedence, first-match-wins, replicated fallback, loud parse
+errors), mesh-vs-single-chip byte-exact tile parity and drill means
+on the 8 fake host devices, per-chip page-pool placement, journal
+chip tags, and the GSKY_MESH=0 escape hatch."""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+import test_paged
+from gsky_tpu.device_guard import journal
+from gsky_tpu.mesh import dispatch as MD
+from gsky_tpu.mesh import pools as MP
+from gsky_tpu.mesh import rules as MR
+from gsky_tpu.ops.drill import masked_mean_impl
+from gsky_tpu.ops.warp import render_scenes_ctrl
+from gsky_tpu.pipeline import waves as W
+
+
+@pytest.fixture(autouse=True)
+def _tmp_ledger(tmp_path, monkeypatch):
+    """Hermetic race ledger + pool journal per test (same rule as
+    tests/test_waves.py)."""
+    monkeypatch.setenv("GSKY_KERNEL_LEDGER",
+                       str(tmp_path / "ledger.jsonl"))
+    monkeypatch.setenv("GSKY_POOL_JOURNAL",
+                       str(tmp_path / "pool.jsonl"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Isolate every singleton the mesh touches: the wave scheduler,
+    the dispatcher, the per-chip pools — and scrub the mesh knobs so
+    each test opts in explicitly."""
+    for var in ("GSKY_MESH", "GSKY_MESH_RULES", "GSKY_MESH_PLACE",
+                "GSKY_SPMD"):
+        monkeypatch.delenv(var, raising=False)
+    W.reset_waves()
+    MD.reset_mesh()
+    MP.reset_mesh_pools()
+    yield
+    W.reset_waves()
+    MD.reset_mesh()
+    MP.reset_mesh_pools()
+
+
+def _byte_statics(n_ns, h, w, step):
+    return ("near", n_ns, (h, w), step, True, 0)
+
+
+def _await_pending(sched, n, timeout=10.0):
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with sched._lock:
+            if len(sched._pending) >= n:
+                return
+        import time as _t
+        _t.sleep(0.002)
+    raise AssertionError(f"pending never reached {n}")
+
+
+# ---------------------------------------------------------------------
+# rule table
+# ---------------------------------------------------------------------
+
+DRILL_DESC = "kind=drill bands=5 pixels=4096 pixel_count=0 wave=8"
+BYTE_DESC = "kind=byte method=near n_ns=1 h=256 w=256 step=16 wave=12"
+WCS_DESC = "kind=byte method=near n_ns=1 h=256 w=4096 step=16 wave=2"
+
+
+class TestRules:
+    def test_builtin_routing(self):
+        assert MR.match_rules(DRILL_DESC) == "time"
+        assert MR.match_rules(WCS_DESC) == "x"
+        assert MR.match_rules(BYTE_DESC) == "granule"
+        assert MR.match_rules(
+            "kind=scored method=near n_ns=2 h=96 w=96 step=16 wave=3"
+        ) == "granule"
+
+    def test_wide_threshold_is_4k(self):
+        # 3999 px stays granule-sharded; 4000 px splits the width
+        assert MR.match_rules(BYTE_DESC.replace("w=256", "w=3999")) \
+            == "granule"
+        assert MR.match_rules(BYTE_DESC.replace("w=256", "w=4000")) \
+            == "x"
+        assert MR.match_rules(BYTE_DESC.replace("w=256", "w=12000")) \
+            == "x"
+
+    def test_unmatched_falls_back_replicated(self):
+        assert MR.match_rules("kind=mystery wave=1") == "replicated"
+        assert MR.match_rules("") == "replicated"
+
+    def test_first_match_wins(self):
+        rules = (MR.Rule(r"kind=byte", "time"),
+                 MR.Rule(r"kind=byte", "x"))
+        assert MR.match_rules(BYTE_DESC, rules) == "time"
+
+    def test_env_override_shadows_builtin(self, monkeypatch):
+        monkeypatch.setenv("GSKY_MESH_RULES", r"kind=drill=>replicated")
+        assert MR.match_rules(DRILL_DESC) == "replicated"
+        # the built-ins still apply to everything else
+        assert MR.match_rules(BYTE_DESC) == "granule"
+
+    def test_parse_rules_multi_and_blank_entries(self):
+        rules = MR.parse_rules(
+            r" kind=drill=>time ; ; wave=1\b=>replicated;")
+        assert [(r.source, r.layout) for r in rules] == \
+            [("kind=drill", "time"), (r"wave=1\b", "replicated")]
+
+    def test_invalid_regex_raises(self):
+        with pytest.raises(MR.RuleError, match="invalid"):
+            MR.Rule(r"kind=(byte", "granule")
+
+    def test_unknown_layout_raises(self):
+        with pytest.raises(MR.RuleError, match="unknown mesh layout"):
+            MR.Rule(r"kind=byte", "diagonal")
+
+    def test_malformed_entry_raises(self):
+        with pytest.raises(MR.RuleError, match="malformed"):
+            MR.parse_rules("kind=byte granule")
+
+    def test_invalid_env_rules_loud_at_construction(self, monkeypatch):
+        monkeypatch.setenv("GSKY_MESH_RULES", "kind=(=>granule")
+        monkeypatch.setenv("GSKY_MESH", "1")
+        with pytest.raises(MR.RuleError):
+            MD.MeshDispatcher()
+
+    def test_describe_byte_and_drill(self):
+        key = (("near", 2, (64, 64), 16, True, 0), 123)
+        assert MR.describe("byte", key, 3) == \
+            "kind=byte method=near n_ns=2 h=64 w=64 step=16 wave=3"
+        dkey = ((4, 96), -3e38, 3e38, False)
+        assert MR.describe("drill", dkey, 2) == \
+            "kind=drill bands=4 pixels=96 pixel_count=0 wave=2"
+
+
+# ---------------------------------------------------------------------
+# wave parity: mesh vs single chip, byte-exact
+# ---------------------------------------------------------------------
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 2,
+    reason="mesh parity needs the multi-device host platform")
+
+
+def _run_byte_wave(monkeypatch, mesh_on):
+    """Stage two ragged tiles, step one wave, return (results, refs,
+    pool).  Identical inputs either way — the GSKY_MESH bit is the
+    only difference between the two runs."""
+    monkeypatch.setenv("GSKY_PALLAS", "interpret")
+    if mesh_on:
+        monkeypatch.setenv("GSKY_MESH", "1")
+    else:
+        monkeypatch.delenv("GSKY_MESH", raising=False)
+    MD.reset_mesh()
+    pool = test_paged._pool(cap=64)
+    sched = W.WaveScheduler(tick_ms=5000.0)   # stepped manually
+    tiles = [test_paged._inputs(0, B=1, lo=1.0, hi=4000.0),
+             test_paged._inputs(1, B=2, lo=1.0, hi=4000.0)]
+    _, _, _, h, w, step, n_ns = tiles[0]
+    statics = _byte_statics(n_ns, h, w, step)
+    sp = np.array([10.0, 250.0, 0.0], np.float32)
+    staged = [test_paged._stage_full(pool, t[0], t[2],
+                                     serial0=100 * (i + 1))
+              for i, t in enumerate(tiles)]
+    results = [None] * 2
+    errors = [None] * 2
+    ts = []
+    for i, (tile, st) in enumerate(zip(tiles, staged)):
+        stack, ctrl, params, *_ = tile
+        tables, p16 = st
+
+        def go(i=i, tables=tables, p16=p16, ctrl=ctrl, stack=stack,
+               params=params):
+            try:
+                results[i] = sched.render_byte(
+                    pool, tables, p16, np.asarray(ctrl), sp, statics,
+                    (stack, params, None, None), None)
+            except Exception as e:   # noqa: BLE001 - asserted below
+                errors[i] = e
+        t = threading.Thread(target=go)
+        t.start()
+        ts.append(t)
+    _await_pending(sched, 2)
+    assert sched.run_wave() == 2
+    for t in ts:
+        t.join(timeout=60)
+    assert errors == [None, None]
+    refs = [np.asarray(render_scenes_ctrl(
+        stack, ctrl, params, jnp.asarray(sp), *statics))
+        for stack, ctrl, params, *_ in tiles]
+    sched.shutdown()
+    return results, refs, pool
+
+
+def _run_drill_wave(monkeypatch, mesh_on, K=3):
+    monkeypatch.setenv("GSKY_PALLAS", "interpret")
+    if mesh_on:
+        monkeypatch.setenv("GSKY_MESH", "1")
+    else:
+        monkeypatch.delenv("GSKY_MESH", raising=False)
+    MD.reset_mesh()
+    sched = W.WaveScheduler(tick_ms=5000.0)
+    rng = np.random.default_rng(7)
+    drills = [(rng.uniform(0, 9, (4, 96)).astype(np.float32),
+               rng.uniform(size=(4, 96)) > 0.4) for _ in range(K)]
+    results = [None] * K
+    errors = [None] * K
+    ts = []
+    for j, (d, v) in enumerate(drills):
+        def go(j=j, d=d, v=v):
+            try:
+                results[j] = sched.drill_stats(
+                    d, v, -3e38, 3e38, False, None)
+            except Exception as e:   # noqa: BLE001
+                errors[j] = e
+        t = threading.Thread(target=go)
+        t.start()
+        ts.append(t)
+    _await_pending(sched, K)
+    assert sched.run_wave() == K
+    for t in ts:
+        t.join(timeout=60)
+    assert errors == [None] * K
+    sched.shutdown()
+    return drills, results
+
+
+@needs_mesh
+class TestMeshParity:
+    def test_byte_wave_granule_sharded_bit_exact(self, monkeypatch):
+        """One granule-sharded wave program across every chip returns
+        the SAME bytes as the per-call bucketed reference — and the
+        dispatcher counted it under the granule layout."""
+        results, refs, pool = _run_byte_wave(monkeypatch, mesh_on=True)
+        for got, ref in zip(results, refs):
+            np.testing.assert_array_equal(got, ref)
+        st = MD.mesh_stats()
+        assert st["enabled"] and st["chips"] == jax.device_count()
+        assert st["waves_by_layout"].get("granule", 0) >= 1
+        assert st["entries_by_layout"].get("granule", 0) >= 2
+        assert pool.stats()["pinned"] == 0
+
+    def test_mesh_off_byte_identity(self, monkeypatch):
+        """GSKY_MESH=0 restores single-chip waves byte-identically:
+        the escape hatch run and the mesh run return the same bytes,
+        and the off run never instantiates a dispatcher."""
+        off, refs_off, _ = _run_byte_wave(monkeypatch, mesh_on=False)
+        assert MD.active_mesh() is None
+        assert MD.default_mesh() is None
+        W.reset_waves()
+        on, _, _ = _run_byte_wave(monkeypatch, mesh_on=True)
+        for a, b in zip(off, on):
+            np.testing.assert_array_equal(a, b)
+        for a, ref in zip(off, refs_off):
+            np.testing.assert_array_equal(a, ref)
+
+    def test_drill_wave_time_sharded_means(self, monkeypatch):
+        """The time-sharded drill reduction matches the per-call
+        masked mean to <=1e-6 (counts exact) and matches the
+        single-chip wave path bit-for-bit."""
+        drills, got = _run_drill_wave(monkeypatch, mesh_on=True)
+        for (d, v), (vals, counts) in zip(drills, got):
+            rv, rc = masked_mean_impl(d, v, -3e38, 3e38, False, np)
+            np.testing.assert_allclose(vals, rv, rtol=0, atol=1e-6)
+            np.testing.assert_array_equal(counts, rc)
+        st = MD.mesh_stats()
+        assert st["waves_by_layout"].get("time", 0) >= 1
+        W.reset_waves()
+        MD.reset_mesh()
+        _, got_off = _run_drill_wave(monkeypatch, mesh_on=False)
+        for (v1, c1), (v0, c0) in zip(got, got_off):
+            np.testing.assert_array_equal(v1, v0)
+            np.testing.assert_array_equal(c1, c0)
+
+    def test_replicated_rule_keeps_single_chip_path(self, monkeypatch):
+        """An operator rule forcing `replicated` routes the wave back
+        through the scheduler's own single-chip dispatch — the
+        dispatcher books it but runs no sharded program."""
+        monkeypatch.setenv("GSKY_MESH_RULES", "kind=byte=>replicated")
+        results, refs, _ = _run_byte_wave(monkeypatch, mesh_on=True)
+        for got, ref in zip(results, refs):
+            np.testing.assert_array_equal(got, ref)
+        st = MD.mesh_stats()
+        assert st["waves_by_layout"].get("replicated", 0) >= 1
+        assert st["waves_by_layout"].get("granule", 0) == 0
+
+
+# ---------------------------------------------------------------------
+# per-chip placement + journal chip tags
+# ---------------------------------------------------------------------
+
+@needs_mesh
+class TestChipPools:
+    def test_chip_pool_commits_to_owning_device(self, monkeypatch):
+        """A ChipPagePool's backing array AND its staged pages live on
+        the owning chip — staging never bounces through device 0."""
+        monkeypatch.setenv("GSKY_MESH", "1")
+        monkeypatch.setenv("GSKY_MESH_PLACE", "1")
+        MD.reset_mesh()
+        MP.reset_mesh_pools()
+        pools = MP.default_mesh_pools()
+        assert pools.n_chips == jax.device_count()
+        serial = 5
+        chip = pools.chip_for(serial)
+        assert chip == serial % pools.n_chips
+        cp = pools.pool_for(serial)
+        assert cp.chip == chip
+        stack, ctrl, params, *_ = test_paged._inputs(0, B=1)
+        tables, p16 = test_paged._stage_full(cp, stack, params,
+                                             serial0=serial)
+        dev = pools.device_for(serial)
+        with cp.locked_pool() as parr:
+            assert list(parr.devices()) == [dev]
+        cp.unpin(tables)
+        assert cp.stats()["chip"] == chip
+        assert MP.staging_pool(serial) is cp
+        assert MP.staging_device(serial) == dev
+        assert pools.pinned_total() == 0
+
+    def test_placement_gated_off_by_default(self, monkeypatch):
+        monkeypatch.setenv("GSKY_MESH", "1")
+        assert MP.staging_pool(3) is None
+        assert MP.staging_device(3) is None
+
+    def test_journal_chip_roundtrip(self, monkeypatch):
+        """Chip tags ride the stage journal additively: old-style
+        replay ignores them, replay_chips() recovers the ownership
+        map for per-chip rehydration."""
+        journal.record_stage(41, 0, 0, chip=2)
+        journal.record_stage(41, 0, 1, chip=2)
+        journal.record_stage(77, 1, 0)           # untagged (old line)
+        keys = journal.replay()
+        assert set(keys) == {(41, 0, 0), (41, 0, 1), (77, 1, 0)}
+        keys2, chips = journal.replay_chips()
+        assert set(keys2) == set(keys)
+        assert chips == {(41, 0, 0): 2, (41, 0, 1): 2}
+
+    def test_rehydrate_all_restages_per_chip(self, monkeypatch):
+        """Warm recovery lands every journaled page back on its
+        owning chip's pool (hash-owner fallback for untagged lines)."""
+        from gsky_tpu.geo.crs import parse_crs
+        from gsky_tpu.pipeline.scene_cache import DeviceScene, \
+            default_scene_cache as sc
+        from gsky_tpu.geo.transform import GeoTransform
+        monkeypatch.setenv("GSKY_MESH", "1")
+        monkeypatch.setenv("GSKY_MESH_PLACE", "1")
+        MD.reset_mesh()
+        MP.reset_mesh_pools()
+        pools = MP.default_mesh_pools()
+        n = pools.n_chips
+        mk = lambda s: DeviceScene(
+            dev=jnp.zeros((8, 8)), height=8, width=8, nodata=0.0,
+            gt=GeoTransform.from_gdal((0, 1, 0, 0, 0, -1)),
+            crs=parse_crs("EPSG:4326"), serial=s)
+        monkeypatch.setattr(sc, "_scenes",
+                            {("a",): mk(10), ("b",): mk(11)})
+        journal.record_stage(10, 0, 0, chip=10 % n)
+        journal.record_stage(11, 0, 0)           # untagged -> hashed
+        counts = pools.rehydrate_all()
+        assert counts.get(10 % n, 0) >= 1
+        assert counts.get(11 % n, 0) >= 1
+
+
+# ---------------------------------------------------------------------
+# prewarm lattice
+# ---------------------------------------------------------------------
+
+@needs_mesh
+def test_prewarm_compiles_wave_programs(monkeypatch):
+    """The mesh-layout prewarm axis compiles the granule byte/scored
+    wave programs and the time-sharded drill at the lattice points a
+    live wave can hit — a later dispatch at the same key reuses them
+    (no request-path compile)."""
+    monkeypatch.setenv("GSKY_MESH", "1")
+    monkeypatch.setenv("GSKY_PALLAS", "interpret")
+    MD.reset_mesh()
+    md = MD.default_mesh()
+    assert md is not None
+    pool = test_paged._pool(cap=8)
+    specs = {("near", 1, True, 0)}
+    n = md.prewarm_programs(pool, specs, sizes=[32], batches=[1],
+                            slots=[1],
+                            wave_sizes=[md.n_chips], step=16)
+    # 2 wave programs per lattice point + 2 drill variants
+    assert n == 4
+    assert len(md._fns) == 2
+    assert {k[0] for k in md._fns} == {"wave_byte", "wave_scored"}
+
+
+# ---------------------------------------------------------------------
+# compat shim
+# ---------------------------------------------------------------------
+
+class TestCompat:
+    def test_compat_spmd_off_by_default(self):
+        assert MD.compat_spmd() is None
+
+    def test_legacy_default_spmd_delegates(self, monkeypatch):
+        """parallel.spmd.default_spmd is an alias for the mesh-owned
+        singleton — exactly one sharded code path process-wide."""
+        from gsky_tpu.parallel import spmd as PS
+        monkeypatch.setenv("GSKY_SPMD", "1")
+        a = PS.default_spmd()
+        b = MD.compat_spmd()
+        if jax.device_count() < 2:
+            assert a is None and b is None
+        else:
+            assert a is not None and a is b

@@ -233,6 +233,58 @@ def test_wave_families_render_parse_roundtrip():
         assert fams[fam]["samples"][(fam, ())] == 0.0
 
 
+def test_mesh_families_render_parse_roundtrip():
+    """The mesh families — layout-labelled wave counter, chip
+    occupancy / shard skew histograms, and the collector-backed chip
+    gauge + per-layout entry totals that only report while a
+    dispatcher is live — round-trip the strict parser."""
+    from gsky_tpu.mesh import dispatch as MD
+    from gsky_tpu.obs.metrics import (MESH_CHIP_OCCUPANCY,
+                                      MESH_SHARD_SKEW_MS, MESH_WAVES,
+                                      render_metrics)
+    MD.reset_mesh()
+    base = parse_exposition(render_metrics())
+    assert "gsky_mesh_chips" not in base     # no live dispatcher
+
+    def val(fams, fam, name, labels=()):
+        if fam not in fams:
+            return 0.0
+        return fams[fam]["samples"].get((name, labels), 0.0)
+
+    MESH_WAVES.labels(layout="granule").inc()
+    MESH_WAVES.labels(layout="time").inc(2)
+    MESH_CHIP_OCCUPANCY.observe(2.0)
+    MESH_SHARD_SKEW_MS.observe(0.5)
+    try:
+        md = MD._dispatcher()                # collectors come alive
+        md.entries_by_layout["granule"] = 3  # as if one wave ran
+        fams = parse_exposition(render_metrics())
+    finally:
+        MD.reset_mesh()
+    waves = "gsky_mesh_waves_total"
+    assert fams[waves]["type"] == "counter"
+    assert val(fams, waves, waves, (("layout", "granule"),)) \
+        - val(base, waves, waves, (("layout", "granule"),)) == 1.0
+    assert val(fams, waves, waves, (("layout", "time"),)) \
+        - val(base, waves, waves, (("layout", "time"),)) == 2.0
+    occ = "gsky_mesh_chip_occupancy"
+    assert fams[occ]["type"] == "histogram"
+    # 2.0 lands in le=2 (cumulative) but not le=1
+    for le, d in (("1", 0.0), ("2", 1.0), ("+Inf", 1.0)):
+        key = (occ + "_bucket", (("le", le),))
+        assert val(fams, occ, *key) - val(base, occ, *key) == d
+    skew = "gsky_mesh_shard_skew_ms"
+    assert fams[skew]["type"] == "histogram"
+    assert val(fams, skew, skew + "_count") \
+        - val(base, skew, skew + "_count") == 1.0
+    chips = fams["gsky_mesh_chips"]
+    assert chips["type"] == "gauge"
+    assert chips["samples"][("gsky_mesh_chips", ())] >= 1.0
+    ent = "gsky_mesh_entries_total"
+    assert fams[ent]["type"] == "counter"
+    assert fams[ent]["samples"][(ent, (("layout", "granule"),))] == 3.0
+
+
 # ---------------------------------------------------------------------------
 # trace context
 

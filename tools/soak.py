@@ -138,6 +138,21 @@ end with ZERO pinned pages, and /metrics must expose the wave
 families through the strict parser.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario wave --seconds 20
+
+``--scenario mesh``: multi-chip sharded wave dispatch (docs/MESH.md).
+Forces 8 virtual host devices on CPU, enables GSKY_MESH=1 with an
+operator rule routing scored waves to the ``x`` layout, then runs a
+mixed GetMap + WPS-drill + WCS-export storm.  Pass criteria: at least
+one wave dispatched under EVERY configured layout (granule byte
+waves, time-sharded drills, x-sharded export blocks — all spanning
+the full mesh), an injected dispatcher failure leg where every
+request still answers 200 via the per-entry failover (zero bare 5xx,
+``fallbacks`` counter moves), a GSKY_MESH=0 flip that returns the
+SAME PNG bytes for the same tile (escape-hatch byte identity), the
+page pool ending with zero pinned pages, and /metrics exposing the
+``gsky_mesh_*`` families through the strict parser.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario mesh --seconds 20
 """
 
 from __future__ import annotations
@@ -224,7 +239,7 @@ def _run(argv=None):
     ap.add_argument("--scenario",
                     choices=("churn", "hot", "wcs", "chaos", "burst",
                              "fleet", "overload", "ingest",
-                             "devicechaos", "wave"),
+                             "devicechaos", "wave", "mesh"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -237,6 +252,15 @@ def _run(argv=None):
                     help="chaos scenario: GSKY_FAULTS-style spec")
     ap.add_argument("--fault-seed", type=int, default=11)
     args = ap.parse_args(argv)
+
+    if args.scenario == "mesh":
+        # the mesh needs >1 chip BEFORE jax initialises: on CPU force
+        # the virtual host devices (a no-op on real multi-chip parts)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     from gsky_tpu.device import ensure_platform
     ensure_platform(retries=1, timeout_s=45.0)
@@ -367,6 +391,8 @@ def _run(argv=None):
         return run_devicechaos(args, watcher, mas_client, merc, boot)
     if args.scenario == "wave":
         return run_wave(args, watcher, mas_client, merc, boot)
+    if args.scenario == "mesh":
+        return run_mesh(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -2208,6 +2234,253 @@ def run_wave(args, watcher, mas_client, merc, boot) -> int:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+def run_mesh(args, watcher, mas_client, merc, boot) -> int:
+    """Multi-chip sharded wave dispatch: a mixed GetMap + WPS-drill +
+    WCS-export storm where every configured mesh layout must carry at
+    least one wave across the full mesh, the injected-failure leg must
+    answer 200 via per-entry failover, and GSKY_MESH=0 must return
+    byte-identical tiles (see module docstring)."""
+    import threading
+    import urllib.parse
+
+    import jax
+
+    from gsky_tpu.geo.crs import EPSG3857, EPSG4326
+    from gsky_tpu.geo.transform import transform_bbox
+    from gsky_tpu.mesh import dispatch as mesh_dispatch
+    from gsky_tpu.pipeline.waves import wave_stats
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    n_devices = len(jax.devices())
+    if n_devices < 2:
+        print(json.dumps({"scenario": "mesh", "skipped": True,
+                          "reason": f"{n_devices} device(s); the mesh "
+                          "needs >1 (set XLA_FLAGS on CPU)"}))
+        print("SOAK FAILED", flush=True)
+        return 1
+
+    # interpret engages paged+wave serving on CPU; GSKY_MESH routes the
+    # drained waves through the partition rules, and the operator rule
+    # sends scored waves (the WCS export blocks) to the x layout so all
+    # three sharded layouts carry load in one storm
+    env_overrides = {
+        "GSKY_PALLAS": "interpret",
+        "GSKY_WAVES": "1",
+        "GSKY_WAVE_MAX": "8",
+        "GSKY_WAVE_TICK_MS": "100",
+        "GSKY_MESH": "1",
+        "GSKY_MESH_RULES": "kind=scored=>x",
+    }
+    saved_env = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    mesh_dispatch.reset_mesh()
+    try:
+        server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                           metrics=MetricsLogger(), gateway=None)
+        host = boot(server)
+
+        grid = 6
+        import numpy as np
+        frac = np.linspace(0.0, 0.6, grid)
+        frac_y = np.linspace(0.1, 0.6, grid)
+        tiles = [(float(fx), float(fy)) for fx in frac for fy in frac_y]
+        w = merc.width * 0.2
+
+        def getmap_url(fx: float, fy: float) -> str:
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + w},"
+                  f"{merc.ymin + fy * merc.height + w}")
+            return (f"http://{host}/ows?service=WMS&request=GetMap"
+                    f"&version=1.3.0&layers=landsat_burst"
+                    f"&crs=EPSG:3857&bbox={bb}"
+                    f"&width=256&height=256&format=image/png"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        def wcs_url(fx: float, fy: float) -> str:
+            ww = merc.width * 0.4
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + ww},"
+                  f"{merc.ymin + fy * merc.height + ww}")
+            return (f"http://{host}/ows?service=WCS"
+                    f"&request=GetCoverage"
+                    f"&coverage=landsat_burst&crs=EPSG:3857&bbox={bb}"
+                    f"&width=512&height=512&format=GeoTIFF"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        ll = transform_bbox(merc, EPSG3857, EPSG4326)
+        d = 0.03
+        x0 = ll.xmin + 0.35 * (ll.xmax - ll.xmin)
+        y0 = ll.ymax - 0.25 * (ll.ymax - ll.ymin)
+        geom = json.dumps({
+            "type": "FeatureCollection", "features": [{
+                "type": "Feature", "geometry": {
+                    "type": "Polygon", "coordinates": [[
+                        [x0, y0], [x0 + d, y0], [x0 + d, y0 + d],
+                        [x0, y0 + d], [x0, y0]]]}}]})
+        drill_q = urllib.parse.quote(geom)
+        drill_url = (f"http://{host}/ows?service=WPS&request=Execute"
+                     f"&identifier=geometryDrill"
+                     f"&datainputs=geometry={drill_q}")
+
+        lock = threading.Lock()
+        counter = itertools.count()
+        errors: list = []
+
+        def fetch(url: str, kind: str):
+            """(ok, body) — no faults run in the storm, so anything
+            but a clean 200 with the right magic fails the soak."""
+            try:
+                with urllib.request.urlopen(url, timeout=300) as r:
+                    body = r.read()
+                    if r.status != 200:
+                        return False, body
+                    if kind == "map":
+                        return body[:8] == b"\x89PNG\r\n\x1a\n", body
+                    if kind == "wcs":
+                        return body[:4] == b"II*\x00", body
+                    return b"ProcessSucceeded" in body, body
+            except Exception as exc:  # noqa: BLE001 - reported below
+                with lock:
+                    if len(errors) < 5:
+                        errors.append(f"{kind}: {exc!r:.200}")
+                return False, b""
+
+        warm_ok = (fetch(getmap_url(*tiles[0]), "map")[0]
+                   and fetch(drill_url, "wps")[0]
+                   and fetch(wcs_url(0.1, 0.2), "wcs")[0])
+
+        bad = [0]
+        n_req = {"map": 0, "wps": 0, "wcs": 0}
+
+        def one():
+            i = next(counter)
+            # drills and exports are clustered minorities so their
+            # companions share a tick and stack into multi-entry waves
+            m = i % 24
+            if m < 3:
+                kind, url = "wps", drill_url
+            elif m < 6:
+                kind, url = "wcs", wcs_url(*tiles[i % len(tiles)])
+            else:
+                kind, url = "map", getmap_url(*tiles[i % len(tiles)])
+            ok, _ = fetch(url, kind)
+            with lock:
+                n_req[kind] += 1
+                if not ok:
+                    bad[0] += 1
+
+        conc = max(args.conc, 12)
+        t_end = time.time() + args.seconds
+
+        def storm_worker():
+            while time.time() < t_end:
+                one()
+
+        storm = [threading.Thread(target=storm_worker)
+                 for _ in range(conc)]
+        for t in storm:
+            t.start()
+        for t in storm:
+            t.join()
+
+        mesh_st = mesh_dispatch.mesh_stats()
+        layouts = dict(mesh_st.get("waves_by_layout") or {})
+
+        # -- failover leg: the dispatcher itself fails, every request
+        # must still answer 200 through the per-entry percall leg
+        md = mesh_dispatch._dispatcher()
+        fb0 = wave_stats().get("fallbacks", 0)
+        inject = [0]
+
+        def boom(sched, kind, es):
+            inject[0] += 1
+            raise RuntimeError("soak: injected mesh dispatch failure")
+
+        md.dispatch_wave = boom       # instance attr shadows the class
+        failover_bad = [0]
+        try:
+            def failover_one(i):
+                ok, _ = fetch(getmap_url(*tiles[i % len(tiles)]),
+                              "map")
+                if not ok:
+                    with lock:
+                        failover_bad[0] += 1
+            fts = [threading.Thread(target=failover_one, args=(i,))
+                   for i in range(6)]
+            for t in fts:
+                t.start()
+            for t in fts:
+                t.join()
+        finally:
+            del md.dispatch_wave
+        fallbacks = wave_stats().get("fallbacks", 0) - fb0
+
+        # -- escape hatch: the same tile with GSKY_MESH=0 must be
+        # byte-identical (gateway off — no response cache in the loop)
+        url_id = getmap_url(*tiles[1])
+        ok_a, body_a = fetch(url_id, "map")
+        os.environ["GSKY_MESH"] = "0"
+        ok_b, body_b = fetch(url_id, "map")
+        os.environ["GSKY_MESH"] = "1"
+        byte_identical = bool(ok_a and ok_b and body_a == body_b)
+
+        from gsky_tpu.pipeline import pages
+        pinned = -1
+        t_end = time.time() + 15
+        while time.time() < t_end:
+            pool = pages._default
+            pinned = (pool.stats().get("pinned", -1)
+                      if pool is not None else 0)
+            if pinned == 0:
+                break
+            time.sleep(0.5)
+
+        metrics = check_metrics(host, require=(
+            "gsky_requests_total",
+            "gsky_wave_dispatches_total",
+            "gsky_mesh_waves_total", "gsky_mesh_chips",
+            "gsky_mesh_chip_occupancy", "gsky_mesh_shard_skew_ms"))
+
+        n_done = sum(n_req.values())
+        out = {
+            "scenario": "mesh",
+            "devices": n_devices,
+            "warm_ok": warm_ok,
+            "requests": n_req, "failed": bad[0],
+            "errors": errors,
+            "mesh": mesh_st,
+            "layout_waves": layouts,
+            "failover": {"injected": inject[0],
+                         "fallbacks": fallbacks,
+                         "failed": failover_bad[0]},
+            "escape_hatch_byte_identical": byte_identical,
+            "pool_pinned": pinned,
+            "metrics": metrics,
+        }
+        print(json.dumps(out))
+        ok = (warm_ok and n_done > 0 and bad[0] == 0
+              and mesh_st.get("chips") == n_devices
+              and all(layouts.get(lay, 0) >= 1
+                      for lay in ("granule", "time", "x"))
+              and inject[0] >= 1 and fallbacks >= 1
+              and failover_bad[0] == 0
+              and byte_identical
+              and pinned == 0
+              and not metrics["missing"])
+        print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+        return 0 if ok else 1
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        mesh_dispatch.reset_mesh()
 
 
 if __name__ == "__main__":
