@@ -816,6 +816,188 @@ def bench_cfg_wave():
             os.environ.pop("GSKY_PALLAS", None)
 
 
+def bench_cfg_plan():
+    """Dataflow-autoplanner A/B (docs/PERF.md "Dataflow planning"): an
+    overlapping pan-walk — adjacent GetMap tiles sliding one page row
+    at a time over a shared scene — plus a 4K-export-shaped block mix,
+    dispatched through the wave scheduler twice: (a) GSKY_PLAN=0, every
+    lane gathering its own page window (today's independent-window
+    dispatch), and (b) planner on, overlapping windows merged into
+    shared-halo superblocks gathered ONCE.  The headline is gathered
+    HBM bytes (the eager `ops.paged` gather accounting) per leg:
+    acceptance wants >= 30% fewer bytes with BIT-EXACT tile parity
+    between the legs.  Byte counts and superblock counts are platform-
+    independent; on CPU wall times are a correctness exercise."""
+    import jax
+    import jax.numpy as jnp
+
+    from gsky_tpu.ops import paged
+    from gsky_tpu.ops.warp import render_scenes_ctrl
+    from gsky_tpu.pipeline import autoplan
+    from gsky_tpu.pipeline import waves as W
+    from gsky_tpu.pipeline.pages import PagePool
+
+    interp = jax.devices()[0].platform == "cpu"
+    prev_pallas = os.environ.get("GSKY_PALLAS")
+    prev_plan = os.environ.get("GSKY_PLAN")
+    if interp and not prev_pallas:
+        os.environ["GSKY_PALLAS"] = "interpret"
+    try:
+        B, S, h, w, step, n_ns = 2, 256, 64, 64, 16, 1
+        pr, pc = 64, 128
+        npr, npc = S // pr, S // pc          # 4 x 2 page grid
+        n_pan, n_export = 12, 4
+        wave_cap = 16
+        rng = np.random.default_rng(23)
+        stack = rng.uniform(1.0, 4000.0, (B, S, S)).astype(np.float32)
+        stack[0, 30:50, 30:50] = np.nan
+        params = np.zeros((B, 11), np.float32)
+        for k in range(B):
+            params[k] = [0.4 * k - 0.2, 1.01, 0.02, 0.3 * k, -0.01,
+                         0.99, S, S, -999.0, 100.0 - k, 0.0]
+        sp = np.array([10.0, 250.0, 0.0], np.float32)
+        statics = ("near", n_ns, (h, w), step, True, 0)
+        statics4k = ("near", n_ns, (2 * h, 2 * w), step, True, 0)
+
+        def grid_ctrl(hw_out, lo, hi):
+            g = (hw_out - 1 + step - 1) // step + 1
+            lin = np.linspace(lo, hi, g, dtype=np.float32)
+            return np.stack([lin[None, :].repeat(g, 0),
+                             lin[:, None].repeat(g, 1)])
+
+        # pan-walk tiles: tile i samples source rows around page row
+        # i % (npr-1), so consecutive tiles' 2-page-row windows overlap
+        # by one page row — the superblock planner's bread and butter
+        pan = []
+        for i in range(n_pan):
+            ri = i % (npr - 1)
+            lo = ri * pr + 6.0
+            hi = min(S - 10.0, (ri + 2) * pr - 8.0)
+            pan.append((ri, grid_ctrl(h, lo, hi)))
+        # export-shaped blocks: 2x-sized outputs over the full scene
+        exp_ctrls = [grid_ctrl(2 * h, 6.0, S - 10.0)
+                     for _ in range(n_export)]
+
+        def run_leg(pool):
+            def stage(i0, i1):
+                tabs = []
+                for k in range(B):
+                    t = pool.table_for(jnp.asarray(stack[k]), k + 1,
+                                       i0, i1, 0, npc - 1)
+                    tabs.append(t)
+                Ssl = 1
+                while Ssl < max(t.size for t in tabs):
+                    Ssl *= 2
+                tables = np.zeros((B, Ssl), np.int32)
+                p16 = np.zeros((B, paged.PARAMS_W), np.float32)
+                p16[:, :11] = params
+                for k, t in enumerate(tabs):
+                    tables[k, :t.size] = t
+                    p16[k, 11] = i0 * pr
+                    p16[k, 13] = (i1 - i0 + 1) * pr
+                    p16[k, 14] = npc * pc
+                    p16[k, 15] = npc
+                return tables, p16
+
+            sched = W.WaveScheduler(max_entries=wave_cap,
+                                    tick_ms=5000.0)
+            n_tiles = n_pan + n_export
+            results = [None] * n_tiles
+            errors = []
+            ts = []
+
+            def submit(i, st_key, ctrl, win):
+                tb, p16 = stage(*win)
+
+                def go():
+                    try:
+                        results[i] = sched.render_byte(
+                            pool, tb, p16, ctrl, sp, st_key,
+                            (jnp.asarray(stack), jnp.asarray(params),
+                             None, None), None)
+                    except Exception as e:   # noqa: BLE001 - reported
+                        errors.append(repr(e))
+                t = threading.Thread(target=go)
+                t.start()
+                ts.append(t)
+
+            paged.reset_gather_bytes()
+            t0 = time.perf_counter()
+            for i, (ri, ctrl) in enumerate(pan):
+                submit(i, statics, ctrl, (ri, ri + 1))
+            for j, ctrl in enumerate(exp_ctrls):
+                submit(n_pan + j, statics4k, ctrl, (0, npr - 1))
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                with sched._lock:
+                    if len(sched._pending) >= n_tiles:
+                        break
+                time.sleep(0.002)
+            while sched.run_wave():
+                pass
+            for t in ts:
+                t.join(timeout=300)
+            elapsed = time.perf_counter() - t0
+            st = sched.stats()
+            sched.shutdown()
+            return (results, errors, paged.gather_bytes_total(),
+                    elapsed, st)
+
+        os.environ["GSKY_PLAN"] = "0"
+        r_off, err_off, bytes_off, s_off, _ = run_leg(
+            PagePool(capacity=64, page_rows=pr, page_cols=pc))
+        os.environ.pop("GSKY_PLAN", None)
+        autoplan.reset_plan_state()
+        r_on, err_on, bytes_on, s_on, _ = run_leg(
+            PagePool(capacity=64, page_rows=pr, page_cols=pc))
+        pst = autoplan.plan_stats()
+
+        parity = (not err_off and not err_on
+                  and all(a is not None and b is not None
+                          and np.array_equal(a, b)
+                          for a, b in zip(r_off, r_on)))
+        saved = ((bytes_off - bytes_on) / bytes_off
+                 if bytes_off else 0.0)
+        out = {
+            "workload": f"{n_pan} overlapping pan-walk tiles ({h}px, "
+                        f"1-page-row slide over a {S}px scene) + "
+                        f"{n_export} export-shaped {2 * h}px blocks",
+            "unit": "gathered-HBM-bytes reduction (plan off -> on)",
+            "value": round(saved, 3),
+            "reduction_ok": saved >= 0.30,
+            "plan_off": {"gathered_bytes": int(bytes_off),
+                         "elapsed_s": round(s_off, 3)},
+            "plan_on": {"gathered_bytes": int(bytes_on),
+                        "superblocks": pst["superblocks"],
+                        "merged_lanes": pst["merged_lanes"],
+                        "routes": pst["routes"],
+                        "elapsed_s": round(s_on, 3)},
+            "parity_bit_exact": parity,
+            "errors": (err_off + err_on)[:3],
+            "interpret": interp,
+        }
+        # spot-check one pan tile against the per-call bucketed
+        # reference too (both legs must equal it, not just each other)
+        ref = np.asarray(render_scenes_ctrl(
+            jnp.asarray(stack), jnp.asarray(pan[0][1]),
+            jnp.asarray(params), jnp.asarray(sp), *statics))
+        out["parity_vs_reference"] = bool(
+            r_on[0] is not None and np.array_equal(ref, r_on[0]))
+        if interp:
+            out["note"] = ("interpret-mode pallas on CPU: byte counts, "
+                           "superblock counts and parity are platform-"
+                           "independent; elapsed_s is not a hardware "
+                           "number")
+        return out
+    finally:
+        if prev_plan is None:
+            os.environ.pop("GSKY_PLAN", None)
+        else:
+            os.environ["GSKY_PLAN"] = prev_plan
+        if interp and not prev_pallas:
+            os.environ.pop("GSKY_PALLAS", None)
+
+
 def bench_cfg_mesh():
     """Mesh serving A/B (docs/MESH.md): the cfg_wave mosaic storm
     dispatched (a) through single-chip waves (GSKY_MESH unset) and
@@ -1370,6 +1552,7 @@ def run_all():
         "cfg6_wcs_pipelined": bench_cfg6_wcs_pipelined(store, utm, tmp),
         "cfg_ragged": bench_ragged(),
         "cfg_wave": bench_cfg_wave(),
+        "cfg_plan": bench_cfg_plan(),
         "cfg_mesh": bench_cfg_mesh(),
         "cfg_ingest": bench_cfg_ingest(store, utm, tmp),
     }
@@ -1444,6 +1627,16 @@ def main(argv=None):
                     "wave": cw["wave"]["dispatches_per_1k_tiles"]},
                 "occupancy": cw["wave"]["occupancy"],
                 "amortisation_x": cw.get("value")}
+        cp = configs.get("cfg_plan") or {}
+        if cp.get("plan_on"):
+            # gathered HBM bytes belong with the chip numbers: what
+            # the superblock plan actually pulled pool->VMEM per leg
+            kernels["gathered_hbm_bytes"] = {
+                "plan_off": cp["plan_off"]["gathered_bytes"],
+                "plan_on": cp["plan_on"]["gathered_bytes"],
+                "reduction": cp.get("value"),
+                "superblocks": cp["plan_on"]["superblocks"],
+                "routes": cp["plan_on"]["routes"]}
         cm = configs.get("cfg_mesh") or {}
         if cm.get("mesh"):
             kernels["mesh_dispatch"] = {

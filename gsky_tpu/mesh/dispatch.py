@@ -185,6 +185,48 @@ class MeshDispatcher:
             out_specs=(P(MESH_AXES), P(MESH_AXES)), check_rep=False)
         return jax.jit(fn)
 
+    def _build_wave_byte_sb(self, method, n_ns, out_hw, step, auto,
+                            colour_scale, T, blk, interpret):
+        """Superblock variant: the chip-local body gathers its Gc
+        union regions once and broadcasts them to its rpc lanes via
+        the chip-LOCAL ``sb_of`` map — the autoplanner sliced the wave
+        per chip, so no superblock (and no halo) crosses the shard
+        boundary."""
+        from ..ops.paged import PARAMS_W, render_byte_paged
+
+        def local(parr, tables, params, ctrls, sps, sb_of):
+            n_l = params.shape[0]
+            return render_byte_paged(
+                parr, tables, params.reshape(n_l * T, PARAMS_W), ctrls,
+                sps, method, n_ns, out_hw, step, auto, colour_scale,
+                interpret=interpret, blk=blk, sb_of=sb_of)
+
+        fn = shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(), P(MESH_AXES), P(MESH_AXES), P(MESH_AXES),
+                      P(MESH_AXES), P(MESH_AXES)),
+            out_specs=P(MESH_AXES), check_rep=False)
+        return jax.jit(fn)
+
+    def _build_wave_scored_sb(self, method, n_ns, out_hw, step, T,
+                              blk, interpret):
+        from ..ops.paged import PARAMS_W, warp_scored_paged
+
+        def local(parr, tables, params, ctrls, sb_of):
+            n_l = params.shape[0]
+            canv, best = warp_scored_paged(
+                parr, tables, params.reshape(n_l * T, PARAMS_W), ctrls,
+                method, n_ns, out_hw, step, interpret=interpret,
+                blk=blk, sb_of=sb_of)
+            return canv, best > -jnp.inf
+
+        fn = shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(), P(MESH_AXES), P(MESH_AXES), P(MESH_AXES),
+                      P(MESH_AXES)),
+            out_specs=(P(MESH_AXES), P(MESH_AXES)), check_rep=False)
+        return jax.jit(fn)
+
     # -- per-layout dispatch -------------------------------------------
 
     def dispatch_wave(self, sched, kind: str, es: List):
@@ -218,11 +260,30 @@ class MeshDispatcher:
         pool = es[0].payload["pool"]
         statics = es[0].key[0]
         try:
+            from ..ops import paged
             from ..ops.pallas_tpu import pallas_interpret
             interpret = pallas_interpret()
             N = len(es)
             Np = self._wave_pad(N)
-            tables, params, T, S = self._stack_tables(es, Np)
+            # per-shard dataflow plan: each chip's lane slice is
+            # superblocked independently, so halos never cross chips
+            plan = None
+            try:
+                from ..pipeline import autoplan
+                plan = autoplan.plan_sharded(kind, es, self.n_chips,
+                                             Np)
+            except Exception:   # planning is an optimisation
+                plan = None
+            if plan is not None:
+                tables, params = plan.tables, plan.params
+                T, S = int(params.shape[1]), int(tables.shape[2])
+                blk, sb_of = plan.blk, plan.sb_of
+                paged.note_gather(plan.planned_bytes)
+            else:
+                tables, params, T, S = self._stack_tables(es, Np)
+                blk, sb_of = None, None
+                paged.note_gather(paged.table_gather_bytes(
+                    tables, pool.page_rows, pool.page_cols))
             ctrls = np.stack([e.payload["ctrl"] for e in es]
                              + [es[0].payload["ctrl"]] * (Np - N))
             wav = self._wave_sharding()
@@ -230,11 +291,26 @@ class MeshDispatcher:
             d_tables = jax.device_put(jnp.asarray(tables), wav)
             d_params = jax.device_put(jnp.asarray(params), wav)
             d_ctrls = jax.device_put(jnp.asarray(ctrls), wav)
+            d_sb = None if sb_of is None else \
+                jax.device_put(jnp.asarray(sb_of), wav)
             self._chip_occupancy(self._chip_counts(N, Np))
             if kind == "byte":
                 method, n_ns, out_hw, step, auto, colour_scale = statics
                 sps = np.stack([e.payload["sp"] for e in es]
                                + [es[0].payload["sp"]] * (Np - N))
+                d_sps = jax.device_put(jnp.asarray(sps), wav)
+                if d_sb is not None:
+                    Gc = int(tables.shape[0]) // self.n_chips
+                    fn = self._get(
+                        ("wave_byte_sb", statics, T, S, Np, Gc, blk,
+                         interpret),
+                        lambda: self._build_wave_byte_sb(
+                            method, n_ns, out_hw, step, auto,
+                            colour_scale, T, blk, interpret))
+                    with pool.locked_pool() as parr:
+                        out = fn(jax.device_put(parr, rep), d_tables,
+                                 d_params, d_ctrls, d_sps, d_sb)
+                    return (out[:N],)
                 fn = self._get(
                     ("wave_byte", statics, T, S, Np, interpret),
                     lambda: self._build_wave_byte(
@@ -242,10 +318,20 @@ class MeshDispatcher:
                         T, interpret))
                 with pool.locked_pool() as parr:
                     out = fn(jax.device_put(parr, rep), d_tables,
-                             d_params, d_ctrls,
-                             jax.device_put(jnp.asarray(sps), wav))
+                             d_params, d_ctrls, d_sps)
                 return (out[:N],)
             method, n_ns, out_hw, step = statics
+            if d_sb is not None:
+                Gc = int(tables.shape[0]) // self.n_chips
+                fn = self._get(
+                    ("wave_scored_sb", statics, T, S, Np, Gc, blk,
+                     interpret),
+                    lambda: self._build_wave_scored_sb(
+                        method, n_ns, out_hw, step, T, blk, interpret))
+                with pool.locked_pool() as parr:
+                    canv, valid = fn(jax.device_put(parr, rep),
+                                     d_tables, d_params, d_ctrls, d_sb)
+                return (canv[:N], valid[:N])
             fn = self._get(
                 ("wave_scored", statics, T, S, Np, interpret),
                 lambda: self._build_wave_scored(

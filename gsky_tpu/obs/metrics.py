@@ -70,6 +70,21 @@ TRACE_EVENTS = _REG.counter(
     "gsky_trace_events_total",
     "Cross-cutting events (retry, breaker_open, hedge, reroute, shed).",
     ["kind"])
+PLAN_SUPERBLOCKS = _REG.counter(
+    "gsky_plan_superblocks_total",
+    "Shared-halo superblocks dispatched by the dataflow autoplanner.")
+PLAN_BYTES_SAVED = _REG.counter(
+    "gsky_plan_gather_bytes_saved_total",
+    "HBM gather bytes the superblock plan avoided vs per-tile windows.")
+PLAN_BLOCK_SHAPE = _REG.counter(
+    "gsky_plan_block_shape",
+    "Cost-model Pallas block-shape decisions by chosen shape.",
+    ["shape"])
+PLAN_ROUTE = _REG.counter(
+    "gsky_plan_route_total",
+    "Autoplanner group routing between ragged slot pad and bucketed "
+    "pulls (the PR 8 crossover).",
+    ["path"])
 
 Rows = Iterable[Tuple[Dict[str, str], float]]
 

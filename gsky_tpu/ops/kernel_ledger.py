@@ -55,6 +55,11 @@ SCHEMA_VERSION = 1
 TOKEN_VERSIONS = {
     "warp_scored_paged": "pg1",
     "warp_render_paged": "pg1",
+    # autoplan's block-shape cost model (pipeline/autoplan.py): the
+    # chosen shape is encoded IN the token (verdict always "promoted"),
+    # so a costed shape is decided once per process lineage and
+    # replayed from the file, never re-derived
+    "plan_block": "pl1",
 }
 
 

@@ -102,14 +102,66 @@ def paged_enabled() -> bool:
     return os.environ.get("GSKY_PAGED", "1") != "0" and use_pallas()
 
 
-def paged_vmem_ok(slots: int, n_ns: int, pr: int, pc: int) -> bool:
+def paged_vmem_ok(slots: int, n_ns: int, pr: int, pc: int,
+                  blk=None) -> bool:
     """Eligibility gate, checked BEFORE the race: a page list too big
     for VMEM must go to the bucketed path, not burn the kernel-name
-    blacklist on a predictable OOM."""
+    blacklist on a predictable OOM.  ``blk`` is the (block_h, block_w)
+    output tile the cost model picked; None keeps the fixed
+    `_WARP_BLK` square."""
+    bh, bw = blk if blk is not None else (_WARP_BLK, _WARP_BLK)
     pages = slots * pr * pc * 4 * 2          # page block, x2 DMA
-    acc = n_ns * _WARP_BLK * _WARP_BLK * 4 * 2 * 2   # canv+best
-    grids = _WARP_BLK * _WARP_BLK * 4 * 2 * 2        # sx+sy, x2
+    acc = n_ns * bh * bw * 4 * 2 * 2         # canv+best
+    grids = bh * bw * 4 * 2 * 2              # sx+sy, x2
     return pages + acc + grids <= _WARP_VMEM_BUDGET
+
+
+# --- gathered-HBM-bytes accounting (module-level, eager-side only) ----
+#
+# The pool->VMEM gather in `_paged_scored` is jit-traced, so a counter
+# inside it would tick once per COMPILE, not per dispatch.  The raced
+# wrappers (and the mesh dispatcher) account the bytes of each dispatch
+# they launch here, eagerly; bench.py and the plan soak read the total
+# to measure what superblock compaction actually saved.
+_GATHER_LOCK = __import__("threading").Lock()
+_GATHER_BYTES = 0
+_GATHER_CALLS = 0
+
+
+def note_gather(nbytes: int) -> None:
+    """Record one dispatch's pool->VMEM gather volume (bytes)."""
+    global _GATHER_BYTES, _GATHER_CALLS
+    with _GATHER_LOCK:
+        _GATHER_BYTES += int(nbytes)
+        _GATHER_CALLS += 1
+
+
+def gather_bytes_total() -> int:
+    with _GATHER_LOCK:
+        return _GATHER_BYTES
+
+
+def gather_stats() -> dict:
+    with _GATHER_LOCK:
+        return {"bytes": _GATHER_BYTES, "dispatches": _GATHER_CALLS}
+
+
+def reset_gather_bytes() -> None:
+    """Zero the gather accounting — bench/soak A/B legs only."""
+    global _GATHER_BYTES, _GATHER_CALLS
+    with _GATHER_LOCK:
+        _GATHER_BYTES = 0
+        _GATHER_CALLS = 0
+
+
+def table_gather_bytes(tables, pr: int, pc: int) -> int:
+    """Bytes the paged gather moves pool->VMEM for a (G, T, S) table
+    block: every listed slot is one (pr, pc) f32 page pull.  With a
+    superblock plan, G is the COMPACTED superblock count, so this is
+    exactly what compaction saves vs the per-tile G = N."""
+    g, t, s = (int(tables.shape[0]), int(tables.shape[1]),
+               int(tables.shape[2]))
+    return g * t * s * int(pr) * int(pc) * 4
 
 
 def _paged_render_kernel(method: str, n_ns: int, T: int, S: int,
@@ -229,7 +281,7 @@ def _paged_render_kernel(method: str, n_ns: int, T: int, S: int,
 
 
 def _paged_scored(pool, tables, params, ctrls, method, n_ns, out_hw,
-                  step, interpret):
+                  step, interpret, blk=None, sb_of=None):
     """Shared core: XLA prologue (page-table gather out of the pool +
     per-tile ctrl-grid upsample) feeding one fused pallas_call over
     every tile in the dispatch.  Returns (canv (N, n_ns, h, w) f32,
@@ -238,17 +290,34 @@ def _paged_scored(pool, tables, params, ctrls, method, n_ns, out_hw,
     The gather `pool[tables]` is the whole HBM data movement of the
     dispatch: exactly the staged pages, no pow2 window pad — the XLA
     gather is page-granular (contiguous (pr, pc) blocks), which is the
-    coalesced access pattern the pool layout exists for."""
+    coalesced access pattern the pool layout exists for.
+
+    ``sb_of`` (N,) int32 activates superblock compaction: tables is
+    then (G, T, S) with G <= N SHARED page regions (autoplan merged
+    overlapping windows), the scattered pool gather runs once per
+    superblock, and ``[sb_of]`` broadcasts each region to the output
+    lanes that read it — a contiguous copy, not a second scattered
+    gather.  The kernel body, BlockSpecs and every operand shape after
+    the broadcast are unchanged, so parity with the per-tile path
+    transfers unconditionally.  ``blk`` retiles the output grid from
+    the cost model; None keeps the fixed `_WARP_BLK` square."""
     from .warp import _bilerp_grid
+    bh, bw = blk if blk is not None else (_WARP_BLK, _WARP_BLK)
     h, w = out_hw
-    N, T, S = (int(tables.shape[0]), int(tables.shape[1]),
-               int(tables.shape[2]))
+    T, S = int(tables.shape[1]), int(tables.shape[2])
     pr, pc = int(pool.shape[1]), int(pool.shape[2])
-    pages = pool[tables.reshape(-1)].reshape(N, T, S * pr, pc)
+    if sb_of is None:
+        N = int(tables.shape[0])
+        pages = pool[tables.reshape(-1)].reshape(N, T, S * pr, pc)
+    else:
+        G = int(tables.shape[0])
+        N = int(sb_of.shape[0])
+        pages = pool[tables.reshape(-1)].reshape(G, T, S * pr,
+                                                 pc)[sb_of]
     sx = jax.vmap(lambda c: _bilerp_grid(c[0], h, w, step))(ctrls)
     sy = jax.vmap(lambda c: _bilerp_grid(c[1], h, w, step))(ctrls)
-    hp = -(-h // _WARP_BLK) * _WARP_BLK
-    wp = -(-w // _WARP_BLK) * _WARP_BLK
+    hp = -(-h // bh) * bh
+    wp = -(-w // bw) * bw
     if (hp, wp) != (h, w):
         sx = jnp.pad(sx, ((0, 0), (0, hp - h), (0, wp - w)))
         sy = jnp.pad(sy, ((0, 0), (0, hp - h), (0, wp - w)))
@@ -261,20 +330,20 @@ def _paged_scored(pool, tables, params, ctrls, method, n_ns, out_hw,
                                    lambda n, i, j, t: (0, 0))
     canv, best = pl.pallas_call(
         kernel,
-        grid=(N, hp // _WARP_BLK, wp // _WARP_BLK, T),
+        grid=(N, hp // bh, wp // bw, T),
         in_specs=[
             params_spec,
-            pl.BlockSpec((1, _WARP_BLK, _WARP_BLK),
+            pl.BlockSpec((1, bh, bw),
                          lambda n, i, j, t: (n, i, j)),
-            pl.BlockSpec((1, _WARP_BLK, _WARP_BLK),
+            pl.BlockSpec((1, bh, bw),
                          lambda n, i, j, t: (n, i, j)),
             pl.BlockSpec((1, 1, S * pr, pc),
                          lambda n, i, j, t: (n, t, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, n_ns, _WARP_BLK, _WARP_BLK),
+            pl.BlockSpec((1, n_ns, bh, bw),
                          lambda n, i, j, t: (n, 0, i, j)),
-            pl.BlockSpec((1, n_ns, _WARP_BLK, _WARP_BLK),
+            pl.BlockSpec((1, n_ns, bh, bw),
                          lambda n, i, j, t: (n, 0, i, j)),
         ],
         out_shape=[
@@ -288,35 +357,40 @@ def _paged_scored(pool, tables, params, ctrls, method, n_ns, out_hw,
 
 @functools.partial(jax.jit,
                    static_argnames=("method", "n_ns", "out_hw", "step",
-                                    "interpret"))
+                                    "interpret", "blk"))
 def warp_scored_paged(pool, tables, params, ctrls, method: str = "near",
                       n_ns: int = 1, out_hw=(256, 256), step: int = 16,
-                      interpret: bool = False):
+                      interpret: bool = False, blk=None, sb_of=None):
     """Paged counterpart of `ops.warp.warp_scenes_ctrl_scored`, over N
     tiles at once: pool (cap, pr, pc) f32, tables (N, T, S) int32 page
     slots (null slot 0 pads), params (N*T, 16) f32, ctrls (N, 2, gh,
     gw) f32.  Returns (canvases (N, n_ns, h, w), best (N, n_ns, h, w),
     -inf = invalid).  The jit key holds NO window shape: one program
-    per (method, n_ns, out_hw, step, T, S) serves every tile shape."""
+    per (method, n_ns, out_hw, step, T, S) serves every tile shape.
+    ``blk`` (static) retiles the output grid; ``sb_of`` (traced (N,)
+    int32 or None) activates the superblock-compacted gather with
+    tables (G, T, S)."""
     return _paged_scored(pool, tables, params, ctrls, method, n_ns,
-                         tuple(out_hw), step, interpret)
+                         tuple(out_hw), step, interpret, blk, sb_of)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("method", "n_ns", "out_hw", "step",
-                                    "auto", "colour_scale", "interpret"))
+                                    "auto", "colour_scale", "interpret",
+                                    "blk"))
 def render_byte_paged(pool, tables, params, ctrls, sps,
                       method: str = "near", n_ns: int = 1,
                       out_hw=(256, 256), step: int = 16,
                       auto: bool = True, colour_scale: int = 0,
-                      interpret: bool = False):
+                      interpret: bool = False, blk=None, sb_of=None):
     """Paged counterpart of `ops.warp.render_scenes_ctrl` (and of the
     batcher's `render_scenes_ctrl_many`): fused paged warp + mosaic,
     then the SAME composite/byte-scale epilogue per tile.  sps (N, 3)
     f32.  Returns PNG-ready uint8 (N, h, w) tiles."""
     from .warp import composite_scale
     canv, best = _paged_scored(pool, tables, params, ctrls, method,
-                               n_ns, tuple(out_hw), step, interpret)
+                               n_ns, tuple(out_hw), step, interpret,
+                               blk, sb_of)
     return jax.vmap(
         lambda c, b, sp: composite_scale(c, b > -jnp.inf, sp, auto,
                                          colour_scale))(canv, best, sps)
@@ -347,36 +421,60 @@ def _paged_token(pool, tables, method, n_ns, out_hw, step, extra=()):
             int(step)) + tuple(extra)
 
 
+def _plan_extras(pool, tables, blk, sb_of):
+    """Token suffix for planner-shaped dispatches: appended ONLY when
+    the dispatch deviates from the historical default, so existing
+    pg1 ledger verdicts for the default path stay valid."""
+    extra = ()
+    if blk is not None and tuple(blk) != (_WARP_BLK, _WARP_BLK):
+        extra += (("blk", int(blk[0]), int(blk[1])),)
+    if sb_of is not None:
+        extra += (("sb", int(sb_of.shape[0])),)
+    return extra
+
+
 def warp_scored_paged_raced(pool, tables, params, ctrls, method, n_ns,
-                            out_hw, step, xla_thunk):
+                            out_hw, step, xla_thunk, blk=None,
+                            sb_of=None):
     """(canvases (N, n_ns, h, w), best) — the paged kernel raced (via
     `run_with_fallback` + the durable ledger) against the caller's
     bucketed XLA closure, which must return the same (N, ...) shape."""
+    note_gather(table_gather_bytes(tables, pool.shape[1],
+                                   pool.shape[2]))
+
     def _pallas():
         return warp_scored_paged(pool, tables, params, ctrls, method,
                                  n_ns, out_hw, step,
-                                 interpret=pallas_interpret())
+                                 interpret=pallas_interpret(),
+                                 blk=blk, sb_of=sb_of)
 
     return run_with_fallback(
         "warp_scored_paged", _pallas, xla_thunk,
         sync_token=_paged_token(pool, tables, method, n_ns, out_hw,
-                                step))
+                                step,
+                                extra=_plan_extras(pool, tables, blk,
+                                                   sb_of)))
 
 
 def render_byte_paged_raced(pool, tables, params, ctrls, sps, method,
                             n_ns, out_hw, step, auto, colour_scale,
-                            xla_thunk):
+                            xla_thunk, blk=None, sb_of=None):
     """uint8 (N, h, w) tiles — the fully fused paged warp+mosaic+scale
     raced against the caller's bucketed XLA closure (the GetMap hot
     path under GSKY_PAGED)."""
+    note_gather(table_gather_bytes(tables, pool.shape[1],
+                                   pool.shape[2]))
+
     def _pallas():
         return render_byte_paged(pool, tables, params, ctrls, sps,
                                  method, n_ns, out_hw, step, auto,
                                  colour_scale,
-                                 interpret=pallas_interpret())
+                                 interpret=pallas_interpret(),
+                                 blk=blk, sb_of=sb_of)
 
     token = _paged_token(pool, tables, method, n_ns, out_hw, step,
-                         extra=(bool(auto), int(colour_scale)))
+                         extra=(bool(auto), int(colour_scale))
+                         + _plan_extras(pool, tables, blk, sb_of))
     return run_with_fallback("warp_render_paged", _pallas, xla_thunk,
                              sync_token=token)
 

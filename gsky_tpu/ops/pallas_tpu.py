@@ -430,23 +430,26 @@ _WARP_BLK = 128
 _WARP_VMEM_BUDGET = 10 * 1024 * 1024
 
 
-def _warp_vmem_bytes(wr: int, wc: int, n_ns: int) -> int:
+def _warp_vmem_bytes(wr: int, wc: int, n_ns: int, blk=None) -> int:
+    bh, bw = blk if blk is not None else (_WARP_BLK, _WARP_BLK)
     wrp = -(-wr // 8) * 8
     wcp = -(-wc // 128) * 128
     src = wrp * wcp * 4 * 2                 # (1, WRp, WCp) f32, x2 DMA
-    acc = n_ns * _WARP_BLK * _WARP_BLK * 4 * 2 * 2  # canv+best, x2
-    grids = _WARP_BLK * _WARP_BLK * 4 * 2 * 2       # sx+sy, x2
+    acc = n_ns * bh * bw * 4 * 2 * 2        # canv+best, x2
+    grids = bh * bw * 4 * 2 * 2             # sx+sy, x2
     return src + acc + grids
 
 
-def warp_pallas_ok(wr: int, wc: int, n_ns: int) -> bool:
+def warp_pallas_ok(wr: int, wc: int, n_ns: int, blk=None) -> bool:
     """Eligibility gate for the fused warp kernel, checked BEFORE
     `run_with_fallback`: an over-budget gather window must go straight
     to XLA rather than burn the name-level blacklist on a predictable
-    VMEM OOM (which would disable the kernel for every shape)."""
+    VMEM OOM (which would disable the kernel for every shape).  ``blk``
+    is the (block_h, block_w) output tile the cost model picked; None
+    keeps the historical fixed `_WARP_BLK` square."""
     if not use_pallas():
         return False
-    return _warp_vmem_bytes(int(wr), int(wc), int(n_ns)) \
+    return _warp_vmem_bytes(int(wr), int(wc), int(n_ns), blk) \
         <= _WARP_VMEM_BUDGET
 
 
@@ -556,12 +559,15 @@ def _warp_render_kernel(method: str, n_ns: int, WR: int, WC: int,
 
 
 def _warp_scored_pallas(stack, ctrl, params, method, n_ns, out_hw, step,
-                        win, win0, interpret):
+                        win, win0, interpret, blk=None):
     """Shared core: XLA prologue (ctrl-grid upsample, window slice,
     f32 + lane-alignment padding) feeding one fused pallas_call.
     Returns (canv (n_ns, h, w) f32, best (n_ns, h, w) f32, -inf =
-    invalid) — the `warp_scenes_ctrl_scored` contract."""
+    invalid) — the `warp_scenes_ctrl_scored` contract.  ``blk`` is the
+    (block_h, block_w) output tile (cost-model chosen, mult-of-8 x
+    mult-of-128); None keeps the fixed `_WARP_BLK` square."""
     from .warp import _bilerp_grid, _window_slice
+    bh, bw = blk if blk is not None else (_WARP_BLK, _WARP_BLK)
     h, w = out_hw
     sx = _bilerp_grid(ctrl[0], h, w, step)
     sy = _bilerp_grid(ctrl[1], h, w, step)
@@ -577,8 +583,8 @@ def _warp_scored_pallas(stack, ctrl, params, method, n_ns, out_hw, step,
     stackf = stack.astype(jnp.float32)
     if (WRp, WCp) != (WR, WC):
         stackf = jnp.pad(stackf, ((0, 0), (0, WRp - WR), (0, WCp - WC)))
-    Hp = -(-h // _WARP_BLK) * _WARP_BLK
-    Wp = -(-w // _WARP_BLK) * _WARP_BLK
+    Hp = -(-h // bh) * bh
+    Wp = -(-w // bw) * bw
     if (Hp, Wp) != (h, w):
         sx = jnp.pad(sx, ((0, Hp - h), (0, Wp - w)))
         sy = jnp.pad(sy, ((0, Hp - h), (0, Wp - w)))
@@ -596,17 +602,17 @@ def _warp_scored_pallas(stack, ctrl, params, method, n_ns, out_hw, step,
         params_spec = pl.BlockSpec((B, 16), lambda i, j, t: (0, 0))
     canv, best = pl.pallas_call(
         kernel,
-        grid=(Hp // _WARP_BLK, Wp // _WARP_BLK, B),
+        grid=(Hp // bh, Wp // bw, B),
         in_specs=[
             params_spec,
-            pl.BlockSpec((_WARP_BLK, _WARP_BLK), lambda i, j, t: (i, j)),
-            pl.BlockSpec((_WARP_BLK, _WARP_BLK), lambda i, j, t: (i, j)),
+            pl.BlockSpec((bh, bw), lambda i, j, t: (i, j)),
+            pl.BlockSpec((bh, bw), lambda i, j, t: (i, j)),
             pl.BlockSpec((1, WRp, WCp), lambda i, j, t: (t, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((n_ns, _WARP_BLK, _WARP_BLK),
+            pl.BlockSpec((n_ns, bh, bw),
                          lambda i, j, t: (0, i, j)),
-            pl.BlockSpec((n_ns, _WARP_BLK, _WARP_BLK),
+            pl.BlockSpec((n_ns, bh, bw),
                          lambda i, j, t: (0, i, j)),
         ],
         out_shape=[
@@ -620,31 +626,35 @@ def _warp_scored_pallas(stack, ctrl, params, method, n_ns, out_hw, step,
 
 @functools.partial(jax.jit,
                    static_argnames=("method", "n_ns", "out_hw", "step",
-                                    "win", "interpret"))
+                                    "win", "interpret", "blk"))
 def warp_scenes_scored_pallas(stack, ctrl, params, method: str = "near",
                               n_ns: int = 1, out_hw=(256, 256),
                               step: int = 16, win=None, win0=None,
-                              interpret: bool = False):
+                              interpret: bool = False, blk=None):
     """Pallas counterpart of `ops.warp.warp_scenes_ctrl_scored`: the
     fused warp-gather replacing XLA's gather lowering on the mosaic hot
     path.  Same signature contract (stack (B, sh, sw) native, ctrl
     (2, gh, gw) f32, params (B, 11) f32, optional static win + traced
     win0) and same outputs (canvases, best-priority, -inf = invalid);
     parity is tested bit-exact for nearest and <= 2 ulp for
-    interpolated methods (tests/test_warp_pallas.py)."""
+    interpolated methods (tests/test_warp_pallas.py).  ``blk``
+    (static (bh, bw) or None) retiles the output grid; the kernel body
+    is block-shape-agnostic so results are identical for any blk."""
     return _warp_scored_pallas(stack, ctrl, params, method, n_ns,
-                               tuple(out_hw), step, win, win0, interpret)
+                               tuple(out_hw), step, win, win0, interpret,
+                               blk)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("method", "n_ns", "out_hw", "step",
                                     "auto", "colour_scale", "win",
-                                    "interpret"))
+                                    "interpret", "blk"))
 def render_scenes_pallas(stack, ctrl, params, scale_params,
                          method: str = "near", n_ns: int = 1,
                          out_hw=(256, 256), step: int = 16,
                          auto: bool = True, colour_scale: int = 0,
-                         win=None, win0=None, interpret: bool = False):
+                         win=None, win0=None, interpret: bool = False,
+                         blk=None):
     """Pallas counterpart of `ops.warp.render_scenes_ctrl`: fused warp +
     mosaic in the kernel, then the SAME composite/byte-scale epilogue
     the XLA render uses (`ops.warp.composite_scale` on the 64 KB
@@ -654,24 +664,50 @@ def render_scenes_pallas(stack, ctrl, params, scale_params,
     from .warp import composite_scale
     canv, best = _warp_scored_pallas(stack, ctrl, params, method, n_ns,
                                      tuple(out_hw), step, win, win0,
-                                     interpret)
+                                     interpret, blk)
     return composite_scale(canv, best > -jnp.inf, scale_params, auto,
                            colour_scale)
 
 
-def _warp_token(stack, win, out_hw, method, n_ns, step):
+def _warp_token(stack, win, out_hw, method, n_ns, step, blk=None):
     """Bucketed race token: stacks arrive bucket-padded and windows
     bucket-sized, so the token set — and with it the race count and the
     ledger cardinality — is bounded.  Plain ints/strs/tuples only (the
-    ledger round-trips tokens through repr/literal_eval)."""
-    return (tuple(int(d) for d in stack.shape), str(stack.dtype),
-            None if win is None else (int(win[0]), int(win[1])),
-            (int(out_hw[0]), int(out_hw[1])), str(method), int(n_ns),
-            int(step))
+    ledger round-trips tokens through repr/literal_eval).  A
+    cost-model block shape appends a ("blk", bh, bw) suffix ONLY when
+    non-default, so historical default-path verdicts stay valid."""
+    tok = (tuple(int(d) for d in stack.shape), str(stack.dtype),
+           None if win is None else (int(win[0]), int(win[1])),
+           (int(out_hw[0]), int(out_hw[1])), str(method), int(n_ns),
+           int(step))
+    if blk is not None and tuple(blk) != (_WARP_BLK, _WARP_BLK):
+        tok = tok + (("blk", int(blk[0]), int(blk[1])),)
+    return tok
+
+
+def _plan_blk(out_hw, win, method, n_ns, T=1):
+    """Cost-model block shape for a bucketed-window dispatch, consulted
+    lazily so ops never import the pipeline at module load.  The model
+    keys on the OUTPUT extent (what the grid tiles) and gates VMEM on
+    the WINDOW extent (what each step resident-loads).  Returns None
+    (= fixed `_WARP_BLK` square, today's behaviour) whenever the
+    planner is off or unavailable — the import is guarded because the
+    block shape is an optimisation, never a correctness dependency."""
+    if not use_pallas():
+        return None     # XLA-only serving: no pallas grid to shape
+    try:
+        from ..pipeline import autoplan
+        if not autoplan.plan_enabled():
+            return None
+        return autoplan.plan_block(
+            int(out_hw[0]), int(out_hw[1]), int(n_ns), str(method),
+            T=int(T), S=0, win=(int(win[0]), int(win[1])))
+    except Exception:  # noqa: BLE001 - planner unavailable: default blk
+        return None
 
 
 def warp_scored_raced(stack, ctrl_dev, params_dev, method, n_ns, out_hw,
-                      step, win=None, win0_dev=None):
+                      step, win=None, win0_dev=None, blk=None):
     """(canvases, best) — the fused pallas warp raced (via
     `run_with_fallback` + the durable ledger) against
     `ops.warp.warp_scenes_ctrl_scored`.  The executor's scene and
@@ -684,22 +720,27 @@ def warp_scored_raced(stack, ctrl_dev, params_dev, method, n_ns, out_hw,
                                        win=win, win0=win0_dev)
 
     wr, wc = win if win is not None else stack.shape[1:3]
-    if not warp_pallas_ok(wr, wc, n_ns):
+    if blk is None:
+        blk = _plan_blk(out_hw, (wr, wc), method, n_ns,
+                        T=int(stack.shape[0]))
+    if not warp_pallas_ok(wr, wc, n_ns, blk):
         return _xla()
 
     def _pallas():
         return warp_scenes_scored_pallas(
             stack, ctrl_dev, params_dev, method, n_ns, out_hw, step,
-            win=win, win0=win0_dev, interpret=pallas_interpret())
+            win=win, win0=win0_dev, interpret=pallas_interpret(),
+            blk=blk)
 
     return run_with_fallback(
         "warp_scored", _pallas, _xla,
-        sync_token=_warp_token(stack, win, out_hw, method, n_ns, step))
+        sync_token=_warp_token(stack, win, out_hw, method, n_ns, step,
+                               blk))
 
 
 def render_byte_raced(stack, ctrl_dev, params_dev, sp_dev, method, n_ns,
                       out_hw, step, auto, colour_scale, win=None,
-                      win0_dev=None):
+                      win0_dev=None, blk=None):
     """uint8 tile — the fully fused pallas warp+mosaic+scale raced
     against `ops.warp.render_scenes_ctrl` (the GetMap hot path)."""
     from .warp import render_scenes_ctrl
@@ -710,16 +751,20 @@ def render_byte_raced(stack, ctrl_dev, params_dev, sp_dev, method, n_ns,
                                   colour_scale, win=win, win0=win0_dev)
 
     wr, wc = win if win is not None else stack.shape[1:3]
-    if not warp_pallas_ok(wr, wc, n_ns):
+    if blk is None:
+        blk = _plan_blk(out_hw, (wr, wc), method, n_ns,
+                        T=int(stack.shape[0]))
+    if not warp_pallas_ok(wr, wc, n_ns, blk):
         return _xla()
 
     def _pallas():
         return render_scenes_pallas(stack, ctrl_dev, params_dev, sp_dev,
                                     method, n_ns, out_hw, step, auto,
                                     colour_scale, win=win, win0=win0_dev,
-                                    interpret=pallas_interpret())
+                                    interpret=pallas_interpret(),
+                                    blk=blk)
 
-    token = _warp_token(stack, win, out_hw, method, n_ns, step) \
+    token = _warp_token(stack, win, out_hw, method, n_ns, step, blk) \
         + (bool(auto), int(colour_scale))
     return run_with_fallback("warp_render", _pallas, _xla,
                              sync_token=token)

@@ -1,0 +1,590 @@
+"""Dataflow autoplanner: shared-halo superblock gathers + cost-model
+Pallas block sizing.
+
+The r05 device records (PERF.md) put HBM utilisation at 0.01-3.5%:
+after the wave scheduler (PR 12) amortised dispatch tax and the paged
+pool (PR 8) deduplicated STAGING, the remaining waste is the GATHER —
+every tile in a wave still pulls its own page window pool->VMEM, so an
+overlapping pan-walk (adjacent GetMap tiles) or a streamed 4K export
+re-reads the same pages N times per dispatch; and the paged/bucketed
+kernels tile their output with a fixed 128x128 Pallas block under a
+static VMEM gate regardless of window extent, method or granule depth.
+Following *Model-Based Warp Overlapped Tiling* (footprints planned
+once, halos shared between neighbouring output blocks) and *TileLoom*
+(block shapes from a cost model, not a constant), this module is the
+planning layer between the wave scheduler and the kernels:
+
+- **Superblock gathers** (`plan_wave_group`): drained wave entries
+  whose granule lists match and whose page rects overlap (or sit
+  within ``GSKY_PLAN_HALO_MAX`` pages of each other) merge into
+  superblocks.  Each superblock's union page region is gathered ONCE —
+  the per-tile tables (N, T, S) compact to (G, T, S_u), G <= N, and a
+  per-lane ``sb_of`` broadcast hands every output lane its region
+  (`ops.paged._paged_scored`).  The planner CONSUMES the footprints
+  the wave entries already carry (params slots 11-15, the plan-once
+  window spans from `executor._paged_from_group`); it never re-indexes.
+  Parity is structural: widening a lane's window to the union changes
+  no tap (true-extent oob poisoning runs BEFORE window rebase, and
+  every in-extent tap of a lane lies inside its own span by the
+  `_granule_bounds` margins), pages are content-keyed so members agree
+  on slots, and halo gaps map to the null page.
+- **Cost-model block shapes** (`plan_block`): per (output extent,
+  n_ns, method, granule depth, page/window geometry) the model scores
+  each ``GSKY_PLAN_BLOCKS`` candidate by padded compute + per-grid-step
+  overhead under the real VMEM gate, and the verdict persists through
+  the kernel ledger (kernel ``plan_block``, the chosen shape encoded
+  in the token) so a shape is costed once per process LINEAGE, not per
+  process.
+- **Ragged-vs-bucketed routing**: the same byte estimator resolves the
+  PR 8 caveat — a scattered mix whose ragged slot pad would move more
+  bytes than the per-tile bucketed pulls routes to the group's stacked
+  bucketed leg instead (``gsky_plan_route_total{path=bucketed}``).
+
+``GSKY_PLAN=0`` disables all three: dispatch shapes, tokens and bytes
+are byte-identical to the unplanned path (tests/test_autoplan.py).
+Mesh waves plan per shard (`plan_sharded`) so no superblock — and no
+halo — ever crosses a chip boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs.metrics import (PLAN_BLOCK_SHAPE, PLAN_BYTES_SAVED,
+                           PLAN_ROUTE, PLAN_SUPERBLOCKS)
+
+
+def plan_enabled() -> bool:
+    """Autoplanner gate: on by default; GSKY_PLAN=0 restores today's
+    independent-window dispatch byte-identically (no superblocks, no
+    block-shape overrides, no route changes)."""
+    return os.environ.get("GSKY_PLAN", "1") != "0"
+
+
+def plan_halo_max() -> int:
+    """Largest page gap (GSKY_PLAN_HALO_MAX, default 2) two windows
+    may leave between them and still merge: 0 merges only overlapping/
+    adjacent rects; larger values trade null-page gather waste for
+    fewer superblocks."""
+    try:
+        v = int(os.environ.get("GSKY_PLAN_HALO_MAX", "2"))
+    except ValueError:
+        v = 2
+    return max(0, min(16, v))
+
+
+# default block-shape ladder: f32 tiling wants rows a multiple of 8 and
+# cols a multiple of 128 (the (8, 128) min tile); 128x128 first so cost
+# ties keep today's shape
+_DEF_BLOCKS = ((128, 128), (256, 128), (128, 256), (256, 256),
+               (64, 128))
+# modelled per-grid-step overhead in pixel-visit units: grid setup +
+# accumulator init/flush per step — what a finer tiling pays for its
+# smaller pad waste
+_STEP_OVERHEAD = 4096
+_TAPS = {"near": 1, "nearest": 1, "bilinear": 4, "cubic": 16}
+
+
+def plan_blocks():
+    """Candidate (block_h, block_w) ladder from GSKY_PLAN_BLOCKS
+    ("128x128,256x128,..."); malformed or lane-misaligned entries are
+    dropped, an empty result falls back to the default ladder."""
+    v = os.environ.get("GSKY_PLAN_BLOCKS", "")
+    if not v.strip():
+        return _DEF_BLOCKS
+    out = []
+    for part in v.lower().split(","):
+        try:
+            bh_s, bw_s = part.strip().split("x")
+            bh, bw = int(bh_s), int(bw_s)
+        except ValueError:
+            continue
+        if bh > 0 and bw > 0 and bh % 8 == 0 and bw % 128 == 0:
+            out.append((bh, bw))
+    return tuple(out) if out else _DEF_BLOCKS
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# cost-model block sizing (ledger-persisted)
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_COSTED: Dict[tuple, tuple] = {}    # key -> (bh, bw) chosen
+_SEEDED = False
+# plan counters (under _LOCK)
+_STATS = {"superblocks": 0, "merged_lanes": 0, "bytes_saved": 0,
+          "routes": {"ragged": 0, "bucketed": 0}, "groups_planned": 0}
+
+
+def _seed_from_ledger():  # gskylint: holds-lock
+    """Replay persisted plan_block verdicts into the in-process memo,
+    once: the chosen shape is encoded in the token (the ledger only
+    accepts promoted/demoted/failed verdicts), so a costed shape
+    survives process restarts without re-deriving."""
+    global _SEEDED
+    if _SEEDED:
+        return
+    _SEEDED = True
+    try:
+        from ..ops import kernel_ledger as kl
+        for (name, tok), rec in kl.entries().items():
+            if name != "plan_block" or rec.get("verdict") != "promoted":
+                continue
+            token = kl.decode_token(tok)
+            if token is None or not kl.token_version_ok(name, token) \
+                    or len(token) != 12:
+                continue
+            key = tuple(token[1:10])
+            _COSTED.setdefault(key, (int(token[10]), int(token[11])))
+    except Exception:  # noqa: BLE001 - a bad ledger never blocks planning
+        pass
+
+
+def _block_cost(h: int, w: int, T: int, taps: int, bh: int,
+                bw: int) -> int:
+    """Modelled work for one lane at block (bh, bw): padded pixel
+    visits (pad waste is real compute) + per-grid-step overhead."""
+    hp = -(-h // bh) * bh
+    wp = -(-w // bw) * bw
+    steps = (hp // bh) * (wp // bw) * max(1, T)
+    return hp * wp * max(1, T) * taps + steps * _STEP_OVERHEAD
+
+
+def plan_block(h: int, w: int, n_ns: int, method: str, T: int = 1,
+               S: int = 0, pr: int = 0, pc: int = 0, win=None):
+    """Cost-model Pallas block shape for an (h, w) output under the
+    real VMEM ceiling.  ``S > 0`` gates candidates through the paged
+    budget (`ops.paged.paged_vmem_ok`); ``S == 0`` is the bucketed
+    kernel, gated on the window extent ``win``.  Returns (bh, bw), or
+    None when the default 128x128 wins (so default-path jit keys and
+    ledger tokens stay untouched).  Decisions memoise in-process and
+    persist through the kernel ledger."""
+    if not plan_enabled():
+        return None
+    from ..ops.paged import paged_vmem_ok
+    from ..ops.pallas_tpu import (_WARP_BLK, _WARP_VMEM_BUDGET,
+                                  _warp_vmem_bytes)
+    key = (int(h), int(w), int(n_ns), str(method), int(T), int(S),
+           int(pr), int(pc),
+           None if win is None else (int(win[0]), int(win[1])))
+    with _LOCK:
+        _seed_from_ledger()
+        got = _COSTED.get(key)
+    if got is None:
+        taps = _TAPS.get(str(method), 4)
+        best = None
+        best_cost = None
+        for bh, bw in plan_blocks():
+            if S > 0:
+                if not paged_vmem_ok(S, n_ns, pr, pc, (bh, bw)):
+                    continue
+            elif win is not None:
+                if _warp_vmem_bytes(int(win[0]), int(win[1]), n_ns,
+                                    (bh, bw)) > _WARP_VMEM_BUDGET:
+                    continue
+            cost = _block_cost(int(h), int(w), int(T), taps, bh, bw)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = (bh, bw), cost
+        if best is None:
+            best = (_WARP_BLK, _WARP_BLK)
+        with _LOCK:
+            got = _COSTED.setdefault(key, best)
+        if got is best:
+            # first process in the lineage to cost this point: persist
+            # (the shape rides the token; verdict is always promoted)
+            try:
+                from ..ops import kernel_ledger as kl
+                kl.record("plan_block", ("pl1",) + key + got, "promoted")
+            except Exception:  # noqa: BLE001 - durability is optional
+                pass
+    try:
+        PLAN_BLOCK_SHAPE.labels(shape=f"{got[0]}x{got[1]}").inc()
+    except Exception:  # prom telemetry only
+        pass
+    from ..ops.pallas_tpu import _WARP_BLK as _D
+    return None if got == (_D, _D) else got
+
+
+# ---------------------------------------------------------------------------
+# superblock planning over wave groups
+# ---------------------------------------------------------------------------
+
+class Plan:
+    """One wave group's dispatch plan.  ``route``:
+
+    - ``"superblock"``: dispatch the compacted (tables, params, sb_of)
+      through the paged kernel — ``tables`` (Gp, T, S_u) np.int32,
+      ``params`` (Np*T, 16) np.float32 (lane windows rewritten to
+      their superblock's union), ``sb_of`` (Np,) np.int32;
+    - ``"bucketed"``: the ragged slot pad would move more HBM bytes
+      than the per-tile bucketed pulls (the PR 8 crossover) — dispatch
+      the group's stacked bucketed XLA leg directly;
+    - ``"ragged"``: no profitable merge; dispatch unchanged (``blk``
+      still applies).
+    """
+
+    __slots__ = ("route", "tables", "params", "sb_of", "blk",
+                 "superblocks", "naive_bytes", "planned_bytes",
+                 "bucketed_bytes", "merged_lanes")
+
+    def __init__(self, route, blk=None, tables=None, params=None,
+                 sb_of=None, superblocks=0, naive_bytes=0,
+                 planned_bytes=0, bucketed_bytes=None, merged_lanes=0):
+        self.route = route
+        self.blk = blk
+        self.tables = tables
+        self.params = params
+        self.sb_of = sb_of
+        self.superblocks = superblocks
+        self.naive_bytes = naive_bytes
+        self.planned_bytes = planned_bytes
+        self.bucketed_bytes = bucketed_bytes
+        self.merged_lanes = merged_lanes
+
+
+def _entry_rows(e, pr: int, pc: int):
+    """Per-granule (page rect, slot row) footprints one wave entry
+    already carries: rect recovered from params slots 11-14 (origin
+    and extent are page-aligned by construction), slots from the
+    pinned table row.  The planner consumes, it doesn't re-index."""
+    p16 = np.asarray(e.payload["params16"], np.float32)
+    tb = np.asarray(e.payload["tables"], np.int32)
+    rows = []
+    for t in range(p16.shape[0]):
+        i0 = int(round(float(p16[t, 11]) / pr))
+        j0 = int(round(float(p16[t, 12]) / pc))
+        ni = max(1, int(round(float(p16[t, 13]) / pr)))
+        nj = max(1, int(round(float(p16[t, 14]) / pc)))
+        rows.append(((i0, i0 + ni - 1, j0, j0 + nj - 1),
+                     tb[t, :ni * nj]))
+    return rows
+
+
+def _rect_union(u, r, halo: int):
+    """Union of two page rects when they overlap or sit within
+    ``halo`` pages on BOTH axes, else None."""
+    gi = max(u[0], r[0]) - min(u[1], r[1]) - 1
+    gj = max(u[2], r[2]) - min(u[3], r[3]) - 1
+    if gi > halo or gj > halo:
+        return None
+    return (min(u[0], r[0]), max(u[1], r[1]),
+            min(u[2], r[2]), max(u[3], r[3]))
+
+
+def _merge_cluster(idxs: List[int], rows, halo: int, slot_cap: int,
+                   vmem_ok):
+    """Greedy superblock formation inside one granule-signature
+    cluster: lanes sorted by origin, each placed into the first
+    superblock whose per-granule unions stay within the halo, the
+    page-slot cap and the VMEM gate.  Returns [(member idxs, union
+    rects per granule)]."""
+    order = sorted(idxs, key=lambda i: (rows[i][0][0][0],
+                                        rows[i][0][0][2]))
+    sbs: List[list] = []
+    for i in order:
+        rects_i = [r for r, _s in rows[i]]
+        placed = False
+        for sb in sbs:
+            if len(sb[1]) != len(rects_i):
+                continue
+            cand = []
+            for u, r in zip(sb[1], rects_i):
+                nu = _rect_union(u, r, halo)
+                if nu is None or ((nu[1] - nu[0] + 1)
+                                  * (nu[3] - nu[2] + 1)) > slot_cap:
+                    cand = None
+                    break
+                cand.append(nu)
+            if cand is None:
+                continue
+            if not vmem_ok(max((u[1] - u[0] + 1) * (u[3] - u[2] + 1)
+                               for u in cand)):
+                continue
+            sb[0].append(i)
+            sb[1] = cand
+            placed = True
+            break
+        if not placed:
+            sbs.append([[i], rects_i])
+    return sbs
+
+
+def _cluster_and_merge(es, rows, n_ns: int, pr: int, pc: int, blk):
+    """Cluster lanes by granule signature (identical params[:11]
+    blocks — same scenes, same affine, same priorities) and merge each
+    cluster into superblocks.  Lanes that merge MUST read identical
+    page content at shared positions; the content-keyed pool
+    guarantees it for identical granule lists."""
+    from ..ops.paged import page_slots, paged_vmem_ok
+    halo = plan_halo_max()
+    slot_cap = page_slots()
+    clusters: Dict[tuple, List[int]] = {}
+    for i, e in enumerate(es):
+        p16 = np.asarray(e.payload["params16"], np.float32)
+        key = (p16.shape[0], p16[:, :11].tobytes())
+        clusters.setdefault(key, []).append(i)
+    sbs = []
+    for idxs in clusters.values():
+        sbs.extend(_merge_cluster(
+            idxs, rows, halo, slot_cap,
+            lambda npg: paged_vmem_ok(_pow2(npg), n_ns, pr, pc, blk)))
+    return sbs
+
+
+def _build_superblock_arrays(es, rows, sbs, T: int, Np: int, pr: int,
+                             pc: int):
+    """Assemble the compacted dispatch arrays from the merge result:
+    union tables (Gp, T, S_u) via `pages.union_table`, per-lane params
+    with window slots 11-15 rewritten to the lane's superblock union,
+    and the lane->superblock broadcast map."""
+    from ..ops.paged import PARAMS_W
+    from .pages import union_table
+    G = len(sbs)
+    Gp = _pow2(G)
+    S_u = _pow2(max(
+        (u[1] - u[0] + 1) * (u[3] - u[2] + 1)
+        for _m, rects in sbs for u in rects))
+    tables = np.zeros((Gp, T, S_u), np.int32)
+    params = np.zeros((Np, T, PARAMS_W), np.float32)
+    params[:, :, 10] = -1.0     # ns_id: padding rows gather nothing
+    sb_of = np.zeros(Np, np.int32)
+    for g, (members, rects) in enumerate(sbs):
+        for t, u in enumerate(rects):
+            mem = [(rows[i][t][1],) + rows[i][t][0] for i in members]
+            u_slots = union_table(mem, *u)
+            tables[g, t, :u_slots.shape[0]] = u_slots
+        for i in members:
+            sb_of[i] = g
+            p16 = np.asarray(es[i].payload["params16"], np.float32)
+            te = p16.shape[0]
+            params[i, :te] = p16
+            for t, u in enumerate(rects):
+                params[i, t, 11] = u[0] * pr
+                params[i, t, 12] = u[2] * pc
+                params[i, t, 13] = (u[1] - u[0] + 1) * pr
+                params[i, t, 14] = (u[3] - u[2] + 1) * pc
+                params[i, t, 15] = u[3] - u[2] + 1
+    return tables, params, sb_of, G, Gp, S_u
+
+
+def _bucketed_bytes(es) -> Optional[int]:
+    """Estimated HBM bytes the group's stacked bucketed leg would
+    move: per entry, the windowed slice of the scene stack it gathers
+    (the whole stack when unwindowed).  None when any entry lacks a
+    bucketed payload."""
+    total = 0
+    try:
+        for e in es:
+            stack, _p, bwin, _w0 = e.payload["xla"]
+            if bwin is not None:
+                total += (int(stack.shape[0]) * int(bwin[0])
+                          * int(bwin[1]) * stack.dtype.itemsize)
+            else:
+                total += int(np.prod([int(d) for d in stack.shape])) \
+                    * stack.dtype.itemsize
+    except Exception:  # noqa: BLE001 - estimator is advisory
+        return None
+    return total
+
+
+def _note_route(path: str):
+    with _LOCK:
+        _STATS["routes"][path] = _STATS["routes"].get(path, 0) + 1
+        _STATS["groups_planned"] += 1
+    try:
+        PLAN_ROUTE.labels(path=path).inc()
+    except Exception:  # prom telemetry only
+        pass
+
+
+def plan_wave_group(kind: str, es) -> Optional[Plan]:
+    """Plan one drained wave group (the `waves.run_wave` hook, called
+    before group dispatch).  Returns None — dispatch exactly as today —
+    when planning is off, the kind has no gather, or nothing improves;
+    otherwise a `Plan` whose route the dispatcher follows.  Never
+    raises into the wave path: any planner defect degrades to the
+    unplanned dispatch."""
+    if not plan_enabled() or kind not in ("byte", "scored") or not es:
+        return None
+    try:
+        statics = es[0].key[0]
+        method, n_ns, out_hw = statics[0], statics[1], statics[2]
+        pool = es[0].payload["pool"]
+        pr, pc = int(pool.page_rows), int(pool.page_cols)
+        N = len(es)
+        Np = _pow2(N)
+        T = max(e.payload["tables"].shape[0] for e in es)
+        S_in = max(e.payload["tables"].shape[1] for e in es)
+        naive = Np * T * S_in * pr * pc * 4
+        blk = plan_block(int(out_hw[0]), int(out_hw[1]), int(n_ns),
+                         str(method), T=T, S=S_in, pr=pr, pc=pc)
+        rows = [_entry_rows(e, pr, pc) for e in es]
+        sbs = _cluster_and_merge(es, rows, int(n_ns), pr, pc, blk)
+        planned = naive
+        built = None
+        if len(sbs) < N:
+            tables, params, sb_of, G, Gp, S_u = \
+                _build_superblock_arrays(es, rows, sbs, T, Np, pr, pc)
+            planned = Gp * T * S_u * pr * pc * 4
+            built = (tables, params, sb_of, G)
+        bucketed = _bucketed_bytes(es)
+        if bucketed is not None and bucketed < min(naive, planned):
+            _note_route("bucketed")
+            return Plan("bucketed", blk=blk, naive_bytes=naive,
+                        planned_bytes=planned, bucketed_bytes=bucketed)
+        _note_route("ragged")
+        if built is not None and planned < naive:
+            tables, params, sb_of, G = built
+            with _LOCK:
+                _STATS["superblocks"] += G
+                _STATS["merged_lanes"] += N - G
+                _STATS["bytes_saved"] += naive - planned
+            try:
+                PLAN_SUPERBLOCKS.inc(float(G))
+                PLAN_BYTES_SAVED.inc(float(naive - planned))
+            except Exception:  # prom telemetry only
+                pass
+            from ..ops.paged import PARAMS_W
+            return Plan("superblock", blk=blk, tables=tables,
+                        params=params.reshape(Np * T, PARAMS_W),
+                        sb_of=sb_of, superblocks=G, naive_bytes=naive,
+                        planned_bytes=planned, bucketed_bytes=bucketed,
+                        merged_lanes=N - G)
+        if blk is None:
+            return None
+        return Plan("ragged", blk=blk, naive_bytes=naive,
+                    planned_bytes=naive, bucketed_bytes=bucketed)
+    except Exception:  # noqa: BLE001 - planning is an optimisation
+        return None
+
+
+def plan_sharded(kind: str, es, n_chips: int, Np: int) -> Optional[Plan]:
+    """Mesh variant: plan each chip's lane slice INDEPENDENTLY (chip c
+    owns lanes [c*rpc, (c+1)*rpc)), so no superblock — and no halo —
+    ever crosses a chip boundary.  Per-chip superblock counts pad to a
+    common Gc and the chip tables concatenate to (n_chips*Gc, T, S_u),
+    which the wave sharding splits back into Gc rows per chip;
+    ``sb_of`` values are chip-LOCAL indices.  Returns None when no
+    chip merges anything (the unplanned mesh dispatch runs)."""
+    if not plan_enabled() or kind not in ("byte", "scored") or not es:
+        return None
+    try:
+        statics = es[0].key[0]
+        method, n_ns, out_hw = statics[0], statics[1], statics[2]
+        pool = es[0].payload["pool"]
+        pr, pc = int(pool.page_rows), int(pool.page_cols)
+        N = len(es)
+        rpc = max(1, Np // max(1, n_chips))
+        T = max(e.payload["tables"].shape[0] for e in es)
+        S_in = max(e.payload["tables"].shape[1] for e in es)
+        naive = Np * T * S_in * pr * pc * 4
+        blk = plan_block(int(out_hw[0]), int(out_hw[1]), int(n_ns),
+                         str(method), T=T, S=S_in, pr=pr, pc=pc)
+        rows = [_entry_rows(e, pr, pc) for e in es]
+        chip_sbs = []
+        merged_any = False
+        for c in range(n_chips):
+            lo, hi = c * rpc, min(N, (c + 1) * rpc)
+            if lo >= hi:
+                chip_sbs.append([])
+                continue
+            sub = list(range(lo, hi))
+            sub_es = [es[i] for i in sub]
+            sub_rows = [rows[i] for i in sub]
+            sbs = _cluster_and_merge(sub_es, sub_rows, int(n_ns), pr,
+                                     pc, blk)
+            # re-map member indices back to global lane numbers
+            sbs = [[[sub[m] for m in members], rects]
+                   for members, rects in sbs]
+            if len(sbs) < len(sub):
+                merged_any = True
+            chip_sbs.append(sbs)
+        if not merged_any:
+            return None
+        from ..ops.paged import PARAMS_W
+        from .pages import union_table
+        Gc = _pow2(max(1, max(len(s) for s in chip_sbs)))
+        S_u = _pow2(max(
+            (u[1] - u[0] + 1) * (u[3] - u[2] + 1)
+            for sbs in chip_sbs for _m, rects in sbs for u in rects))
+        tables = np.zeros((n_chips * Gc, T, S_u), np.int32)
+        params = np.zeros((Np, T, PARAMS_W), np.float32)
+        params[:, :, 10] = -1.0
+        sb_of = np.zeros(Np, np.int32)
+        total_sbs = 0
+        for c, sbs in enumerate(chip_sbs):
+            total_sbs += len(sbs)
+            for g, (members, rects) in enumerate(sbs):
+                row0 = c * Gc + g
+                for t, u in enumerate(rects):
+                    mem = [(rows[i][t][1],) + rows[i][t][0]
+                           for i in members]
+                    u_slots = union_table(mem, *u)
+                    tables[row0, t, :u_slots.shape[0]] = u_slots
+                for i in members:
+                    sb_of[i] = g    # chip-local index
+                    p16 = np.asarray(es[i].payload["params16"],
+                                     np.float32)
+                    params[i, :p16.shape[0]] = p16
+                    for t, u in enumerate(rects):
+                        params[i, t, 11] = u[0] * pr
+                        params[i, t, 12] = u[2] * pc
+                        params[i, t, 13] = (u[1] - u[0] + 1) * pr
+                        params[i, t, 14] = (u[3] - u[2] + 1) * pc
+                        params[i, t, 15] = u[3] - u[2] + 1
+        planned = n_chips * Gc * T * S_u * pr * pc * 4
+        if planned >= naive:
+            return None
+        with _LOCK:
+            _STATS["superblocks"] += total_sbs
+            _STATS["merged_lanes"] += N - total_sbs
+            _STATS["bytes_saved"] += naive - planned
+        try:
+            PLAN_SUPERBLOCKS.inc(float(total_sbs))
+            PLAN_BYTES_SAVED.inc(float(naive - planned))
+        except Exception:  # prom telemetry only
+            pass
+        _note_route("ragged")
+        return Plan("superblock", blk=blk, tables=tables, params=params,
+                    sb_of=sb_of, superblocks=total_sbs,
+                    naive_bytes=naive, planned_bytes=planned,
+                    merged_lanes=N - total_sbs)
+    except Exception:  # noqa: BLE001 - planning is an optimisation
+        return None
+
+
+def plan_stats() -> Dict:
+    """The /debug "plan" block: knobs, route split and savings."""
+    with _LOCK:
+        return {"enabled": plan_enabled(),
+                "halo_max": plan_halo_max(),
+                "blocks": [f"{bh}x{bw}" for bh, bw in plan_blocks()],
+                "costed_shapes": len(_COSTED),
+                "superblocks": _STATS["superblocks"],
+                "merged_lanes": _STATS["merged_lanes"],
+                "gather_bytes_saved": _STATS["bytes_saved"],
+                "groups_planned": _STATS["groups_planned"],
+                "routes": dict(_STATS["routes"])}
+
+
+def reset_plan_state():
+    """Test hook: drop the cost-model memo and counters so knob
+    changes (GSKY_PLAN_BLOCKS, ledger path) re-cost."""
+    global _SEEDED
+    with _LOCK:
+        _COSTED.clear()
+        _SEEDED = False
+        _STATS.update({"superblocks": 0, "merged_lanes": 0,
+                       "bytes_saved": 0, "groups_planned": 0,
+                       "routes": {"ragged": 0, "bucketed": 0}})

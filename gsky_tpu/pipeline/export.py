@@ -147,6 +147,8 @@ class ExportPipeline:
         # scene keys whose memo decode RAISED (vs. merely not
         # intersecting): feeds the partial-failure degradation policy
         self._memo_failed: set = set()
+        # tile index -> co-submission batch id (filled by _plan)
+        self._batch_of: List[int] = list(range(len(self.tiles)))
         self.stats: Dict[str, object] = {}
 
     # -- control -------------------------------------------------------------
@@ -214,7 +216,38 @@ class ExportPipeline:
                          if bb is None or bb.intersects(tb)])
         self.stats["granules"] = len(granules)
         self.stats["granule_tile_refs"] = sum(len(gs) for gs in plan)
+        self._batch_of = self._plan_batches(plan)
         return plan
+
+    def _plan_batches(self, plan: List[List[Granule]]) -> List[int]:
+        """Superblock planning over the tile assignment: consecutive
+        tiles that share at least one source batch together (id per
+        tile), so the warp stage can CO-SUBMIT them and the wave
+        scheduler hands the dataflow autoplanner neighbouring windows
+        to merge into shared-halo superblock gathers.  With the
+        planner or waves off every tile is its own batch and the warp
+        stage stays strictly serial — today's behaviour."""
+        n = len(self.tiles)
+        batch = [0] * n
+        try:
+            from . import autoplan
+            from .waves import waves_enabled
+            if not (autoplan.plan_enabled() and waves_enabled()):
+                return list(range(n))
+        except Exception:   # planner unavailable: serial warp
+            return list(range(n))
+        cap = _env_int("GSKY_EXPORT_COSUBMIT", 4, lo=1, hi=16)
+        keys = [set(map(_scene_key, gs)) for gs in plan]
+        bid, size = 0, 1
+        for i in range(1, n):
+            if size < cap and keys[i] & keys[i - 1]:
+                batch[i] = bid
+                size += 1
+            else:
+                bid += 1
+                batch[i] = bid
+                size = 1
+        return batch
 
     # -- stage 1: decode / warm ----------------------------------------------
 
@@ -345,36 +378,81 @@ class ExportPipeline:
             granule_count=len(gs),
             file_count=len({g.path for g in gs}))
 
+    def _flush_batch(self, batch, q_encode, pool) -> bool:
+        """Render one co-submission batch and hand the results to the
+        encoders in output order.  A multi-tile batch renders its tiles
+        CONCURRENTLY — each on its own context copy — so their wave
+        entries land in the same scheduler tick and the autoplanner can
+        superblock their shared gather windows; a single-tile batch is
+        the serial path unchanged."""
+        if not batch:
+            return True
+        reqs = [dataclasses.replace(self.base_req, bbox=tb, width=tw,
+                                    height=th)
+                for (tb, _ox, _oy, tw, th), _gs in batch]
+        if pool is not None and len(batch) > 1:
+            futs = [pool.submit(contextvars.copy_context().run,
+                                self._render_tile, rq, gs)
+                    for rq, (_t, gs) in zip(reqs, batch)]
+            results = [f.result() for f in futs]
+            self.stats["plan_batches"] = \
+                self.stats.get("plan_batches", 0) + 1
+            self.stats["plan_batched_tiles"] = \
+                self.stats.get("plan_batched_tiles", 0) + len(batch)
+        else:
+            results = [self._render_tile(rq, gs)
+                       for rq, (_t, gs) in zip(reqs, batch)]
+        for ((_tb, ox, oy, tw, th), _gs), res in zip(batch, results):
+            # start every device->host copy NOW: the encode stage's
+            # np.asarray then completes an in-flight transfer while
+            # this thread warps the next tile
+            for n in res.namespaces:
+                for env in (res.data, res.valid):
+                    v = env.get(n)
+                    if hasattr(v, "copy_to_host_async"):
+                        _prefetch(v)
+            self.stats["encode_queue_max"] = max(
+                self.stats.get("encode_queue_max", 0),
+                q_encode.qsize() + 1)
+            if not self._put(q_encode, ((ox, oy, tw, th), res)):
+                return False
+        return True
+
     def _warp_stage(self, q_warp: queue.Queue,
                     q_encode: queue.Queue) -> None:
         busy = 0.0
+        from collections import Counter
+        co = max(Counter(self._batch_of).values(), default=1)
+        pool = cf.ThreadPoolExecutor(
+            co, thread_name_prefix="gsky-export-warp") if co > 1 \
+            else None
         try:
+            batch: List = []
+            bid = None
+            i = 0
             while True:
                 item = self._take(q_warp)
                 if item is _DONE:
                     break
-                (tb, ox, oy, tw, th), gs = item
+                b = self._batch_of[i] if i < len(self._batch_of) else i
+                i += 1
                 t0 = time.monotonic()
-                req = dataclasses.replace(self.base_req, bbox=tb,
-                                          width=tw, height=th)
-                res = self._render_tile(req, gs)
-                # start every device->host copy NOW: the encode stage's
-                # np.asarray then completes an in-flight transfer while
-                # this thread warps the next tile
-                for n in res.namespaces:
-                    for env in (res.data, res.valid):
-                        v = env.get(n)
-                        if hasattr(v, "copy_to_host_async"):
-                            _prefetch(v)
+                if bid is not None and b != bid:
+                    ok = self._flush_batch(batch, q_encode, pool)
+                    batch = []
+                    if not ok:
+                        return
+                bid = b
+                batch.append(item)
                 busy += time.monotonic() - t0
-                self.stats["encode_queue_max"] = max(
-                    self.stats.get("encode_queue_max", 0),
-                    q_encode.qsize() + 1)
-                if not self._put(q_encode, ((ox, oy, tw, th), res)):
-                    return
+            t0 = time.monotonic()
+            self._flush_batch(batch, q_encode, pool)
+            busy += time.monotonic() - t0
         except BaseException as e:     # noqa: BLE001
             self._fail(e)
         finally:
+            if pool is not None:
+                pool.shutdown(wait=True)
             self.stats["warp_s"] = round(busy, 6)
 
     # -- stage 3: encode / write ---------------------------------------------

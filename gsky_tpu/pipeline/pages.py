@@ -425,6 +425,32 @@ class PagePool:
             }
 
 
+def union_table(members, i0: int, i1: int, j0: int, j1: int):
+    """Halo-aware multi-tile page table: merge member slot rows into
+    ONE row-major table over the union page rect (i0..i1) x (j0..j1).
+
+    ``members`` is a list of (slots, mi0, mi1, mj0, mj1) where
+    ``slots`` is the member's row-major (npages,) table over its own
+    rect — exactly what `table_for` returned for it.  Pages are
+    content-keyed, so members covering the same (pi, pj) agree on the
+    slot; positions no member covers (halo gaps) keep slot 0, the
+    reserved all-NaN null page, so a stray tap through a gap is
+    invalid, never garbage.  No new pins and no staging: the union
+    reuses the members' already-pinned slots (the autoplan superblock
+    gather, docs/PERF.md "Dataflow planning")."""
+    nj = int(j1) - int(j0) + 1
+    ni = int(i1) - int(i0) + 1
+    out = np.zeros(ni * nj, np.int32)
+    for slots, mi0, mi1, mj0, mj1 in members:
+        row = np.asarray(slots, np.int32).reshape(-1)
+        mnj = int(mj1) - int(mj0) + 1
+        for pi in range(int(mi0), int(mi1) + 1):
+            for pj in range(int(mj0), int(mj1) + 1):
+                out[(pi - int(i0)) * nj + (pj - int(j0))] = \
+                    row[(pi - int(mi0)) * mnj + (pj - int(mj0))]
+    return out
+
+
 _default = None
 _default_lock = threading.Lock()
 

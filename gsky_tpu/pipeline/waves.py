@@ -327,9 +327,20 @@ class WaveScheduler:
                         lambda m=md, k=kind, g=es:
                         m.dispatch_wave(self, k, g))
                 else:
+                    # dataflow autoplanner (GSKY_PLAN): superblock the
+                    # group's gathers / pick block shapes BEFORE the
+                    # device guard so a planner defect degrades to the
+                    # unplanned dispatch, never to a device incident
+                    plan = None
+                    try:
+                        from . import autoplan
+                        plan = autoplan.plan_wave_group(kind, es)
+                    except Exception:   # planning is an optimisation
+                        plan = None
                     devs = device_guard.run(
                         "dispatch.wave",
-                        lambda k=kind, g=es: self._dispatch_group(k, g))
+                        lambda k=kind, g=es, p=plan:
+                        self._dispatch_group(k, g, p))
             except Exception as exc:
                 # device incident mid-wave: the wave never fails as a
                 # unit — each request re-renders per-call
@@ -380,11 +391,11 @@ class WaveScheduler:
 
     # -- per-kind dispatch ---------------------------------------------
 
-    def _dispatch_group(self, kind: str, es: List[_Entry]):
+    def _dispatch_group(self, kind: str, es: List[_Entry], plan=None):
         if kind == "byte":
-            return self._dispatch_byte(es)
+            return self._dispatch_byte(es, plan)
         if kind == "scored":
-            return self._dispatch_scored(es)
+            return self._dispatch_scored(es, plan)
         if kind == "drill":
             return self._dispatch_drill(es)
         raise ValueError(f"unknown wave kind {kind!r}")
@@ -409,14 +420,14 @@ class WaveScheduler:
         return (jnp.asarray(tables),
                 jnp.asarray(params.reshape(Np * T, PARAMS_W)))
 
-    def _dispatch_byte(self, es: List[_Entry]):
+    def _dispatch_byte(self, es: List[_Entry], plan=None):
+        from ..ops import paged
         from ..ops.paged import render_byte_paged_raced
         pool = es[0].payload["pool"]
         method, n_ns, out_hw, step, auto, colour_scale = es[0].key[0]
         try:
             N = len(es)
             Np = _pow2(N)
-            tables, params = self._stack_tables(es, Np)
             ctrls = np.stack([e.payload["ctrl"] for e in es]
                              + [es[0].payload["ctrl"]] * (Np - N))
             sps = np.stack([e.payload["sp"] for e in es]
@@ -424,7 +435,8 @@ class WaveScheduler:
 
             def _xla():
                 # per-tile bucketed XLA legs stacked to the wave
-                # contract (runs only when racing or demoted)
+                # contract (runs when racing, demoted, or when the
+                # planner's byte estimator routed the group here)
                 from ..ops.warp import render_scenes_ctrl
                 from .executor import _dev_win0    # lazy: avoids cycle
                 outs = []
@@ -439,25 +451,39 @@ class WaveScheduler:
                 outs += [outs[0]] * (Np - N)
                 return jnp.stack(outs)
 
+            if plan is not None and plan.route == "bucketed":
+                # scattered mix: the ragged slot pad would move more
+                # HBM bytes than the per-tile pulls (the PR 8 caveat)
+                paged.note_gather(plan.bucketed_bytes)
+                dev = _xla()
+                return (self.ring.put(dev[:N]),)
+            blk = plan.blk if plan is not None else None
+            sb_of = None
+            if plan is not None and plan.route == "superblock":
+                tables = jnp.asarray(plan.tables)
+                params = jnp.asarray(plan.params)
+                sb_of = jnp.asarray(plan.sb_of)
+            else:
+                tables, params = self._stack_tables(es, Np)
             with pool.locked_pool() as parr:
                 dev = render_byte_paged_raced(
                     parr, tables, params, jnp.asarray(ctrls),
                     jnp.asarray(sps), method, n_ns, out_hw, step,
-                    auto, colour_scale, _xla)
+                    auto, colour_scale, _xla, blk=blk, sb_of=sb_of)
             # the wave pad never reaches the ring or the link
             return (self.ring.put(dev[:N]),)
         finally:
             for e in es:
                 e.cleanup_once()
 
-    def _dispatch_scored(self, es: List[_Entry]):
+    def _dispatch_scored(self, es: List[_Entry], plan=None):
+        from ..ops import paged
         from ..ops.paged import warp_scored_paged_raced
         pool = es[0].payload["pool"]
         method, n_ns, out_hw, step = es[0].key[0]
         try:
             N = len(es)
             Np = _pow2(N)
-            tables, params = self._stack_tables(es, Np)
             ctrls = np.stack([e.payload["ctrl"] for e in es]
                              + [es[0].payload["ctrl"]] * (Np - N))
 
@@ -477,10 +503,24 @@ class WaveScheduler:
                 bs += [bs[0]] * (Np - N)
                 return jnp.stack(cs), jnp.stack(bs)
 
+            if plan is not None and plan.route == "bucketed":
+                paged.note_gather(plan.bucketed_bytes)
+                canv, best = _xla()
+                valid = best > -jnp.inf
+                return (self.ring.put(canv[:N]),
+                        self.ring.put(valid[:N]))
+            blk = plan.blk if plan is not None else None
+            sb_of = None
+            if plan is not None and plan.route == "superblock":
+                tables = jnp.asarray(plan.tables)
+                params = jnp.asarray(plan.params)
+                sb_of = jnp.asarray(plan.sb_of)
+            else:
+                tables, params = self._stack_tables(es, Np)
             with pool.locked_pool() as parr:
                 canv, best = warp_scored_paged_raced(
                     parr, tables, params, jnp.asarray(ctrls), method,
-                    n_ns, out_hw, step, _xla)
+                    n_ns, out_hw, step, _xla, blk=blk, sb_of=sb_of)
             # fold best -> validity ON DEVICE: the -inf invalid marker
             # must not reach guarded_readback (the integrity probe
             # treats inf as DMA corruption — correctly, everywhere

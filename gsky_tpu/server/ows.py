@@ -312,6 +312,13 @@ class OWSServer:
         except Exception:  # mesh module optional in this build
             pass
         try:
+            from ..pipeline.autoplan import plan_stats
+            from ..ops.paged import gather_stats
+            doc["plan"] = plan_stats()
+            doc["plan"]["gather"] = gather_stats()
+        except Exception:  # autoplanner optional in this build
+            pass
+        try:
             from ..pipeline.drill_cache import default_drill_cache as dc
             from ..pipeline.executor import default_executor as ex
             from ..pipeline.scene_cache import default_scene_cache as sc

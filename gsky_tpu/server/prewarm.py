@@ -40,7 +40,10 @@ warm programs at every occupancy the scheduler can assemble.  When
 mesh serving is live (GSKY_MESH, gsky_tpu/mesh/) the same lattice
 gains the mesh-layout axis: the granule-sharded byte/scored wave
 programs and the time-sharded drill reduction compile here too
-(docs/MESH.md).
+(docs/MESH.md).  When the dataflow autoplanner is live (GSKY_PLAN,
+pipeline/autoplan.py) the lattice gains a block-shape axis: each point
+also compiles the planner-shaped program whenever the cost model picks
+a non-default Pallas block for it (docs/KERNELS.md).
 
 Knobs: GSKY_PREWARM=0 disables; GSKY_PREWARM_SIZES (tile edges,
 default "256"), GSKY_PREWARM_BUCKET (scene bucket edge, default 512),
@@ -304,6 +307,18 @@ def prewarm(configs: Dict,
                         p16[:, 13] = pr     # 1-page window extents:
                         p16[:, 14] = pc     # real gather work over the
                         p16[:, 15] = 1.0    # null page
+                        # block-shape lattice axis: when the dataflow
+                        # autoplanner's cost model picks a non-default
+                        # Pallas block for this point, the planner-
+                        # shaped program compiles here too — the first
+                        # planned storm after a deploy must be as warm
+                        # as the default-shaped one
+                        try:
+                            from ..pipeline.autoplan import plan_block
+                            blk = plan_block(hw, hw, n_pad, method,
+                                             T=B, S=S, pr=pr, pc=pc)
+                        except Exception:
+                            blk = None
                         for W in waves:
                             tables = jnp.zeros((W, B, S), jnp.int32)
                             p16w = jnp.asarray(np.tile(p16, (W, 1)))
@@ -335,6 +350,16 @@ def prewarm(configs: Dict,
                                     tables, p16w, ctrls, method,
                                     n_pad, (hw, hw), step,
                                     _xla_scored)
+                                if blk is not None:
+                                    run(render_byte_paged_raced, parr,
+                                        tables, p16w, ctrls, sps,
+                                        method, n_pad, (hw, hw), step,
+                                        auto, colour_scale, _xla_byte,
+                                        blk=blk)
+                                    run(warp_scored_paged_raced, parr,
+                                        tables, p16w, ctrls, method,
+                                        n_pad, (hw, hw), step,
+                                        _xla_scored, blk=blk)
             elif n_exprs == 1:
                 n_pad = _bucket_pow2(1)
                 for B in batches:

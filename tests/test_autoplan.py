@@ -1,0 +1,422 @@
+"""Dataflow autoplanner (`pipeline/autoplan.py`): interpret-mode
+parity of shared-halo superblock gathers against independent windows
+(near/bilinear/cubic, page-boundary-straddling halo gaps), GSKY_PLAN=0
+byte identity, cost-model block shapes under the VMEM gate with ledger
+round-trip, the PR 8 ragged-vs-bucketed routing crossover, and mesh
+shard-locality (superblocks never cross a chip boundary)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from gsky_tpu.ops import paged
+from gsky_tpu.ops.warp import render_scenes_ctrl
+from gsky_tpu.pipeline import autoplan as ap
+from gsky_tpu.pipeline import waves as W
+from gsky_tpu.pipeline.pages import PagePool
+
+
+@pytest.fixture(autouse=True)
+def _tmp_ledger(tmp_path, monkeypatch):
+    """Hermetic ledger per test (same rule as tests/test_paged.py) —
+    the cost model PERSISTS verdicts, so a shared ledger would leak
+    block shapes between tests."""
+    monkeypatch.setenv("GSKY_KERNEL_LEDGER", str(tmp_path / "ledger.jsonl"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan():
+    """Drop the in-process cost memo and counters around every test:
+    the memo is keyed per process LINEAGE, and these tests re-point
+    the lineage (the ledger env) per test."""
+    ap.reset_plan_state()
+    yield
+    ap.reset_plan_state()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_waves():
+    W.reset_waves()
+    yield
+    W.reset_waves()
+
+
+# small pages keep interpret-mode gathers cheap while a 256 px scene
+# still spans a 4x2 page grid — room for sliding windows and halo gaps
+PR, PC = 64, 128
+S = 256
+NPR, NPC = S // PR, S // PC
+
+
+def _scene(B=2, seed=5):
+    rng = np.random.default_rng(seed)
+    stack = rng.uniform(1.0, 4000.0, (B, S, S)).astype(np.float32)
+    stack[0, 30:50, 30:50] = np.nan
+    params = np.zeros((B, 11), np.float32)
+    for k in range(B):
+        params[k] = [0.4 * k - 0.2, 1.01, 0.02, 0.3 * k, -0.01, 0.99,
+                     S, S, -999.0, 100.0 - k, 0.0]
+    return stack, params
+
+
+def _ctrl2(hw_out, step, xlo, xhi, ylo, yhi):
+    g = (hw_out - 1 + step - 1) // step + 1
+    gx = np.linspace(xlo, xhi, g, dtype=np.float32)
+    gy = np.linspace(ylo, yhi, g, dtype=np.float32)
+    return np.stack([gx[None, :].repeat(g, 0), gy[:, None].repeat(g, 1)])
+
+
+def _stage_window(pool, stack, params, i0, i1, j0, j1, serial0=1):
+    """Stage one page-rect window of every granule and build the
+    (T, S) table + (T, 16) params rows — the hand-rolled equivalent of
+    `executor._paged_from_group` with an explicit window (the planner
+    consumes exactly these slot-11..15 footprints)."""
+    B = stack.shape[0]
+    tabs = []
+    for k in range(B):
+        t = pool.table_for(jnp.asarray(stack[k]), serial0 + k,
+                           i0, i1, j0, j1)
+        assert t is not None
+        tabs.append(t)
+    Ssl = 1
+    while Ssl < max(t.size for t in tabs):
+        Ssl *= 2
+    tables = np.zeros((B, Ssl), np.int32)
+    p16 = np.zeros((B, paged.PARAMS_W), np.float32)
+    p16[:, :11] = params
+    for k, t in enumerate(tabs):
+        tables[k, :t.size] = t
+        p16[k, 11] = i0 * PR
+        p16[k, 12] = j0 * PC
+        p16[k, 13] = (i1 - i0 + 1) * PR
+        p16[k, 14] = (j1 - j0 + 1) * PC
+        p16[k, 15] = j1 - j0 + 1
+    return tables, p16
+
+
+def _run_leg(stack, params, method, tiles, h=64, w=64, step=16, n_ns=1):
+    """Submit ``tiles`` = [((i0, i1, j0, j1), ctrl)] through ONE wave
+    of a fresh scheduler/pool and return the rendered byte tiles.
+    Asserts zero errors and zero leftover pins."""
+    pool = PagePool(capacity=64, page_rows=PR, page_cols=PC)
+    sched = W.WaveScheduler(max_entries=16, tick_ms=5000.0)
+    statics = (method, n_ns, (h, w), step, True, 0)
+    sp = np.array([10.0, 250.0, 0.0], np.float32)
+    results = [None] * len(tiles)
+    errors = []
+    ts = []
+    for i, (win, ctrl) in enumerate(tiles):
+        tb, p16 = _stage_window(pool, stack, params, *win)
+
+        def go(i=i, tb=tb, p16=p16, ctrl=ctrl):
+            try:
+                results[i] = sched.render_byte(
+                    pool, tb, p16, ctrl, sp, statics,
+                    (jnp.asarray(stack), jnp.asarray(params), None,
+                     None), None)
+            except Exception as e:   # noqa: BLE001 - asserted below
+                errors.append(repr(e))
+        t = threading.Thread(target=go)
+        t.start()
+        ts.append(t)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        with sched._lock:
+            if len(sched._pending) >= len(tiles):
+                break
+        time.sleep(0.002)
+    while sched.run_wave():
+        pass
+    for t in ts:
+        t.join(timeout=300)
+    pinned = pool.stats()["pinned"]
+    sched.shutdown()
+    assert not errors, errors
+    assert pinned == 0
+    return results
+
+
+def _pan_tiles(n=4):
+    """Sliding pan-walk: tile i's 2-page-row window starts one page row
+    after tile i-1's — consecutive windows overlap by a full page row,
+    the superblock planner's bread and butter."""
+    tiles = []
+    for i in range(n):
+        ri = i % (NPR - 1)
+        tiles.append(((ri, ri + 1, 0, NPC - 1),
+                      _ctrl2(64, 16, 6.0, S - 10.0,
+                             ri * PR + 6.0, (ri + 2) * PR - 8.0)))
+    return tiles
+
+
+class TestSuperblockParity:
+    """Shared-halo superblocks must be byte-exact against independent
+    windows: the two legs run the SAME paged kernel, only the gather
+    plumbing differs, so parity is bitwise — not tolerance-based."""
+
+    @pytest.mark.parametrize("method", ["near", "bilinear", "cubic"])
+    def test_pan_walk_byte_exact_vs_independent(self, method,
+                                                monkeypatch):
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        stack, params = _scene()
+        tiles = _pan_tiles(4)
+        # warm lap: settle the kernel race/promotion OUTSIDE the A/B
+        # so both legs read the same promoted kernel
+        _run_leg(stack, params, method, tiles[:1])
+        ap.reset_plan_state()
+        monkeypatch.setenv("GSKY_PLAN", "0")
+        off = _run_leg(stack, params, method, tiles)
+        assert ap.plan_stats()["groups_planned"] == 0
+        monkeypatch.setenv("GSKY_PLAN", "1")
+        on = _run_leg(stack, params, method, tiles)
+        st = ap.plan_stats()
+        assert st["superblocks"] >= 1 and st["merged_lanes"] >= 1
+        for a, b in zip(off, on):
+            np.testing.assert_array_equal(a, b)
+
+    def test_pan_walk_matches_bucketed_reference(self, monkeypatch):
+        """The planned leg must equal the per-call bucketed XLA
+        reference too, not just the unplanned paged leg."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        monkeypatch.setenv("GSKY_PLAN", "1")
+        stack, params = _scene()
+        tiles = _pan_tiles(4)
+        on = _run_leg(stack, params, "near", tiles)
+        assert ap.plan_stats()["merged_lanes"] >= 1
+        sp = np.array([10.0, 250.0, 0.0], np.float32)
+        statics = ("near", 1, (64, 64), 16, True, 0)
+        for (win, ctrl), got in zip(tiles, on):
+            ref = np.asarray(render_scenes_ctrl(
+                jnp.asarray(stack), jnp.asarray(ctrl),
+                jnp.asarray(params), jnp.asarray(sp), *statics))
+            np.testing.assert_array_equal(ref, got)
+
+    def test_page_boundary_straddling_halo_gap(self, monkeypatch):
+        """Two tile flocks two page rows apart (gap 1 <= halo 2) merge
+        across the page boundary: the union's gap row maps to the null
+        page, and because every lane's taps stay inside its own span
+        the null fill never reaches an output pixel — parity proves
+        it."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        stack, params = _scene()
+        tiles = []
+        for k, (ylo, yhi) in enumerate(((4.0, 52.0), (6.0, 54.0),
+                                        (8.0, 56.0))):
+            tiles.append(((0, 0, 0, NPC - 1),
+                          _ctrl2(64, 16, 6.0 + k, S - 10.0, ylo, yhi)))
+        for k, (ylo, yhi) in enumerate(((132.0, 180.0), (134.0, 182.0),
+                                        (136.0, 184.0))):
+            tiles.append(((2, 2, 0, NPC - 1),
+                          _ctrl2(64, 16, 6.0 + k, S - 10.0, ylo, yhi)))
+        _run_leg(stack, params, "near", tiles[:1])   # settle the race
+        monkeypatch.setenv("GSKY_PLAN", "0")
+        off = _run_leg(stack, params, "near", tiles)
+        monkeypatch.setenv("GSKY_PLAN", "1")
+        on = _run_leg(stack, params, "near", tiles)
+        st = ap.plan_stats()
+        assert st["superblocks"] == 1 and st["merged_lanes"] == 5
+        for a, b in zip(off, on):
+            np.testing.assert_array_equal(a, b)
+
+    def test_halo_zero_keeps_gap_windows_apart(self, monkeypatch):
+        """GSKY_PLAN_HALO_MAX=0 must refuse the gap merge the default
+        halo accepts (overlap-only planning)."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        monkeypatch.setenv("GSKY_PLAN_HALO_MAX", "0")
+        stack, params = _scene()
+        tiles = [((0, 0, 0, NPC - 1),
+                  _ctrl2(64, 16, 6.0, S - 10.0, 4.0, 52.0)),
+                 ((2, 2, 0, NPC - 1),
+                  _ctrl2(64, 16, 6.0, S - 10.0, 132.0, 180.0))]
+        _run_leg(stack, params, "near", tiles)
+        assert ap.plan_stats()["superblocks"] == 0
+
+
+class TestCostModel:
+    def test_candidate_shapes_pass_vmem_gate(self, monkeypatch):
+        """Every shape the model returns must fit the SAME VMEM
+        budgets the dispatch gates enforce — across the whole default
+        ladder and an output/method lattice, paged and bucketed."""
+        from gsky_tpu.ops.paged import paged_vmem_ok
+        from gsky_tpu.ops.pallas_tpu import (_WARP_BLK,
+                                             _WARP_VMEM_BUDGET,
+                                             _warp_vmem_bytes)
+        for h, w in ((64, 64), (128, 256), (512, 512)):
+            for method in ("near", "bilinear", "cubic"):
+                blk = ap.plan_block(h, w, 2, method, T=4, S=8,
+                                    pr=PR, pc=PC)
+                eff = blk if blk is not None else (_WARP_BLK, _WARP_BLK)
+                assert paged_vmem_ok(8, 2, PR, PC, eff)
+                blk = ap.plan_block(h, w, 2, method, T=4, S=0,
+                                    win=(96, 96))
+                eff = blk if blk is not None else (_WARP_BLK, _WARP_BLK)
+                assert _warp_vmem_bytes(96, 96, 2, eff) \
+                    <= _WARP_VMEM_BUDGET
+
+    def test_default_shape_returns_none(self, monkeypatch):
+        """A 128x128 verdict must come back as None so default-path
+        jit keys and kernel tokens stay untouched."""
+        monkeypatch.setenv("GSKY_PLAN_BLOCKS", "128x128")
+        assert ap.plan_block(64, 64, 1, "near", T=1, S=4,
+                             pr=PR, pc=PC) is None
+
+    def test_disabled_returns_none(self, monkeypatch):
+        monkeypatch.setenv("GSKY_PLAN", "0")
+        assert ap.plan_block(512, 512, 1, "near", T=1, S=4,
+                             pr=PR, pc=PC) is None
+
+    def test_blocks_env_parse(self, monkeypatch):
+        """Misaligned (rows % 8, cols % 128) and malformed entries are
+        dropped; an all-bad list falls back to the default ladder."""
+        monkeypatch.setenv("GSKY_PLAN_BLOCKS",
+                           "256x128, junk, 100x128, 8x256, 64x64")
+        assert ap.plan_blocks() == ((256, 128), (8, 256))
+        monkeypatch.setenv("GSKY_PLAN_BLOCKS", "junk")
+        assert ap.plan_blocks() == ap._DEF_BLOCKS
+
+    def test_ledger_roundtrip_costed_once_per_lineage(self, monkeypatch):
+        """The verdict persists through the kernel ledger: after the
+        memo is dropped AND the candidate ladder is narrowed so
+        re-costing could not rediscover the shape, the ledger replay
+        must still hand it back."""
+        blk = ap.plan_block(512, 512, 1, "near", T=1, S=4, pr=PR, pc=PC)
+        assert blk is not None and blk != (128, 128)
+        ap.reset_plan_state()
+        monkeypatch.setenv("GSKY_PLAN_BLOCKS", "128x128")
+        again = ap.plan_block(512, 512, 1, "near", T=1, S=4,
+                              pr=PR, pc=PC)
+        assert again == blk
+
+
+def _route_entry(pool, statics, win, xla_stack, bwin, T=1):
+    """Minimal wave-entry double for the route estimator: a (T, S)
+    table, slot-11..15 window footprint, and the stacked bucketed
+    payload the estimator prices."""
+    from types import SimpleNamespace
+    i0, i1, j0, j1 = win
+    ni, nj = i1 - i0 + 1, j1 - j0 + 1
+    tables = np.zeros((T, ni * nj), np.int32)
+    p16 = np.zeros((T, paged.PARAMS_W), np.float32)
+    p16[:, 11] = i0 * PR
+    p16[:, 12] = j0 * PC
+    p16[:, 13] = ni * PR
+    p16[:, 14] = nj * PC
+    p16[:, 15] = nj
+    return SimpleNamespace(
+        kind="byte", key=(statics, id(pool)),
+        payload={"pool": pool, "tables": tables, "params16": p16,
+                 "xla": (jnp.zeros(xla_stack, jnp.float32), None,
+                         bwin, None)})
+
+
+class TestRouteCrossover:
+    """The PR 8 caveat: a scattered mix whose ragged slot pad would
+    move more HBM bytes than the per-tile bucketed pulls must route to
+    the bucketed leg — pinned on both sides of the crossover."""
+
+    STATICS = ("near", 1, (64, 64), 16, True, 0)
+
+    def _plan(self, bwin):
+        pool = PagePool(capacity=8, page_rows=PR, page_cols=PC)
+        # two far-apart 2x2-page windows (gap 6 > halo): no merge, so
+        # naive == planned == Np * T * S_in * page_bytes = 262144
+        es = [_route_entry(pool, self.STATICS, (0, 1, 0, 1),
+                           (1, 256, 256), bwin),
+              _route_entry(pool, self.STATICS, (8, 9, 0, 1),
+                           (1, 256, 256), bwin)]
+        return ap.plan_wave_group("byte", es)
+
+    def test_bucketed_wins_below_crossover(self):
+        # 2 x 181*181*4 = 262,088 bytes < 262,144-byte ragged pad
+        plan = self._plan((181, 181))
+        assert plan is not None and plan.route == "bucketed"
+        assert plan.bucketed_bytes == 2 * 181 * 181 * 4
+        assert plan.bucketed_bytes < plan.naive_bytes
+        assert ap.plan_stats()["routes"]["bucketed"] == 1
+
+    def test_ragged_wins_above_crossover(self):
+        # 2 x 182*182*4 = 264,992 bytes > the same 262,144-byte pad
+        plan = self._plan((182, 182))
+        assert plan is None or plan.route != "bucketed"
+        assert ap.plan_stats()["routes"]["bucketed"] == 0
+
+    def test_superblock_beats_bucketed_when_cheaper(self, monkeypatch):
+        """A merged plan that moves fewer bytes than the bucketed leg
+        must keep the superblock route."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        stack, params = _scene()
+        tiles = _pan_tiles(4)
+        _run_leg(stack, params, "near", tiles)
+        st = ap.plan_stats()
+        assert st["superblocks"] >= 1
+        assert st["routes"]["bucketed"] == 0
+
+
+class TestMeshShardLocality:
+    """`plan_sharded` plans each chip's lane slice independently: a
+    cross-chip pair that WOULD merge under single-chip planning must
+    stay in separate, chip-local superblocks."""
+
+    def _entries(self):
+        stack, params = _scene(B=1)
+        pool = PagePool(capacity=64, page_rows=PR, page_cols=PC)
+        statics = ("near", 1, (64, 64), 16, True, 0)
+        from types import SimpleNamespace
+        es = []
+        for win in ((0, 1, 0, 1), (0, 1, 0, 1),
+                    (2, 3, 0, 1), (2, 3, 0, 1)):
+            tb, p16 = _stage_window(pool, stack, params, *win)
+            es.append(SimpleNamespace(
+                kind="byte", key=(statics, id(pool)),
+                payload={"pool": pool, "tables": tb, "params16": p16,
+                         "xla": (jnp.asarray(stack),
+                                 jnp.asarray(params), None, None)}))
+        return es, pool
+
+    def test_superblocks_never_cross_chips(self):
+        es, pool = self._entries()
+        # chips own lane halves: [0, 1] and [2, 3].  Lanes 1 and 2 are
+        # page-adjacent (rects (0,1) and (2,3), gap 0 <= halo) — the
+        # single-chip planner fuses ALL FOUR into one superblock...
+        single = ap.plan_wave_group("byte", es)
+        assert single is not None and single.route == "superblock"
+        assert single.superblocks == 1
+        ap.reset_plan_state()
+        # ...the sharded planner must keep one superblock PER CHIP
+        plan = ap.plan_sharded("byte", es, n_chips=2, Np=4)
+        assert plan is not None and plan.route == "superblock"
+        assert plan.superblocks == 2 and plan.merged_lanes == 2
+        # chip-local indices: every lane points at its chip's row 0
+        np.testing.assert_array_equal(plan.sb_of, [0, 0, 0, 0])
+        # one table row per chip (Gc = 1): chip 0 gathers page rows
+        # 0-1, chip 1 gathers 2-3 — no union spans the boundary
+        assert plan.tables.shape[0] == 2
+        assert not np.array_equal(plan.tables[0], plan.tables[1])
+        pool.unpin(np.concatenate(
+            [e.payload["tables"].reshape(-1) for e in es]))
+
+    def test_sharded_none_when_nothing_merges(self):
+        es, pool = self._entries()
+        # one lane per chip: nothing to merge anywhere
+        plan = ap.plan_sharded("byte", es[:2], n_chips=2, Np=2)
+        assert plan is None or plan.merged_lanes == 0
+        pool.unpin(np.concatenate(
+            [e.payload["tables"].reshape(-1) for e in es]))
+
+
+class TestPlanStats:
+    def test_stats_shape_and_reset(self):
+        st = ap.plan_stats()
+        assert set(st) >= {"enabled", "halo_max", "blocks",
+                           "superblocks", "merged_lanes",
+                           "gather_bytes_saved", "routes"}
+        assert st["superblocks"] == 0
+        ap.plan_block(512, 512, 1, "near", T=1, S=4, pr=PR, pc=PC)
+        assert ap.plan_stats()["costed_shapes"] == 1
+        ap.reset_plan_state()
+        assert ap.plan_stats()["costed_shapes"] == 0

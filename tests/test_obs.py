@@ -285,6 +285,45 @@ def test_mesh_families_render_parse_roundtrip():
     assert fams[ent]["samples"][(ent, (("layout", "granule"),))] == 3.0
 
 
+def test_plan_families_render_parse_roundtrip():
+    """The autoplanner families — superblock/bytes-saved counters plus
+    the shape- and path-labelled decision counters — must round-trip
+    the strict parser.  All four register at import, so their HELP/
+    TYPE headers are present even before any planning ran."""
+    from gsky_tpu.obs.metrics import (PLAN_BLOCK_SHAPE, PLAN_BYTES_SAVED,
+                                      PLAN_ROUTE, PLAN_SUPERBLOCKS,
+                                      render_metrics)
+    base = parse_exposition(render_metrics())
+    for fam in ("gsky_plan_superblocks_total",
+                "gsky_plan_gather_bytes_saved_total",
+                "gsky_plan_block_shape", "gsky_plan_route_total"):
+        assert base[fam]["type"] == "counter"
+
+    def val(fams, fam, name, labels=()):
+        if fam not in fams:
+            return 0.0
+        return fams[fam]["samples"].get((name, labels), 0.0)
+
+    PLAN_SUPERBLOCKS.inc(2.0)
+    PLAN_BYTES_SAVED.inc(4096.0)
+    PLAN_BLOCK_SHAPE.labels(shape="256x256").inc()
+    PLAN_ROUTE.labels(path="ragged").inc()
+    PLAN_ROUTE.labels(path="bucketed").inc(2)
+    fams = parse_exposition(render_metrics())
+    sb = "gsky_plan_superblocks_total"
+    assert val(fams, sb, sb) - val(base, sb, sb) == 2.0
+    sv = "gsky_plan_gather_bytes_saved_total"
+    assert val(fams, sv, sv) - val(base, sv, sv) == 4096.0
+    sh = "gsky_plan_block_shape"
+    assert val(fams, sh, sh, (("shape", "256x256"),)) \
+        - val(base, sh, sh, (("shape", "256x256"),)) == 1.0
+    rt = "gsky_plan_route_total"
+    assert val(fams, rt, rt, (("path", "ragged"),)) \
+        - val(base, rt, rt, (("path", "ragged"),)) == 1.0
+    assert val(fams, rt, rt, (("path", "bucketed"),)) \
+        - val(base, rt, rt, (("path", "bucketed"),)) == 2.0
+
+
 # ---------------------------------------------------------------------------
 # trace context
 

@@ -251,7 +251,8 @@ class TestDispatchAndEligibility:
 
     def test_raced_dispatch_interpret_runs_pallas(self, monkeypatch):
         """Under GSKY_PALLAS=interpret the raced dispatcher must run the
-        pallas kernel (no race, no ledger writes) and match XLA."""
+        pallas kernel (no race, no race-timing ledger writes) and match
+        XLA."""
         monkeypatch.setenv("GSKY_PALLAS", "interpret")
         from gsky_tpu.ops import kernel_ledger
         stack, ctrl, params, h, w, step, n_ns = _inputs(10)
@@ -261,7 +262,12 @@ class TestDispatchAndEligibility:
                                          n_ns, (h, w), step)
         np.testing.assert_array_equal(np.asarray(cx), np.asarray(canv))
         np.testing.assert_array_equal(np.asarray(bx), np.asarray(best))
-        assert kernel_ledger.entries() == {}  # interpret never records
+        # Interpreter timings are meaningless, so no race verdict may
+        # land.  The autoplanner's plan_block verdicts are analytic
+        # shape decisions, not timings, and persist in either mode.
+        raced = {k: v for k, v in kernel_ledger.entries().items()
+                 if k[0] != "plan_block"}
+        assert raced == {}  # interpret never records race verdicts
 
     def test_executor_warp_mosaic_parity(self, monkeypatch):
         """Executor-level: the decoded-window mosaic path produces the

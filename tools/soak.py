@@ -153,6 +153,20 @@ page pool ending with zero pinned pages, and /metrics exposing the
 ``gsky_mesh_*`` families through the strict parser.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario mesh --seconds 20
+
+``--scenario plan``: dataflow autoplanner (docs/PERF.md "Dataflow
+planning").  ``GSKY_PALLAS=interpret`` engages the paged+wave pipeline
+on CPU; an adjacent-tile GetMap pan-walk storm (neighbouring bboxes
+whose gather windows overlap) plus a streamed WCS-export minority must
+give the planner real merge opportunities.  Pass criteria: at least
+one shared-halo superblock with a gather-dedup ratio > 0 (the planner
+saved HBM gather bytes vs independent windows), a concurrent
+adjacent-tile volley re-fetched under ``GSKY_PLAN=0`` returning the
+SAME PNG bytes (escape-hatch byte identity), every response a clean
+200, the page pool ending with ZERO pinned pages, and /metrics
+exposing the ``gsky_plan_*`` families through the strict parser.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario plan --seconds 20
 """
 
 from __future__ import annotations
@@ -239,7 +253,7 @@ def _run(argv=None):
     ap.add_argument("--scenario",
                     choices=("churn", "hot", "wcs", "chaos", "burst",
                              "fleet", "overload", "ingest",
-                             "devicechaos", "wave", "mesh"),
+                             "devicechaos", "wave", "mesh", "plan"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -393,6 +407,8 @@ def _run(argv=None):
         return run_wave(args, watcher, mas_client, merc, boot)
     if args.scenario == "mesh":
         return run_mesh(args, watcher, mas_client, merc, boot)
+    if args.scenario == "plan":
+        return run_plan(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -2481,6 +2497,218 @@ def run_mesh(args, watcher, mas_client, merc, boot) -> int:
             else:
                 os.environ[k] = v
         mesh_dispatch.reset_mesh()
+
+
+def run_plan(args, watcher, mas_client, merc, boot) -> int:
+    """Dataflow autoplanner: an adjacent-tile GetMap pan-walk storm
+    whose overlapping gather windows must merge into shared-halo
+    superblocks (gather-dedup ratio > 0), with a streamed WCS-export
+    minority riding the same waves, byte parity vs GSKY_PLAN=0, and
+    zero pinned pages at exit (see module docstring)."""
+    import threading
+
+    import numpy as np
+
+    from gsky_tpu.ops import paged
+    from gsky_tpu.pipeline import autoplan
+    from gsky_tpu.pipeline.waves import wave_stats
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    # interpret engages paged+wave serving on CPU; a wide tick gives
+    # concurrent adjacent tiles a real coalescing window, and a raised
+    # slot cap leaves the planner union-table headroom (a merged pair
+    # of neighbouring windows needs more page slots than either tile —
+    # 16 slots of the default 128x512 page is 4 MiB, well under VMEM)
+    env_overrides = {
+        "GSKY_PALLAS": "interpret",
+        "GSKY_WAVES": "1",
+        "GSKY_WAVE_MAX": "8",
+        "GSKY_WAVE_TICK_MS": "100",
+        "GSKY_PLAN": "1",
+        "GSKY_PAGE_SLOTS": "16",
+    }
+    saved_env = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    autoplan.reset_plan_state()
+    paged.reset_gather_bytes()
+    try:
+        # gateway off: a response-cache hit would bypass the pipeline
+        # and the dedup ratio would measure the cache, not the planner
+        server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                           metrics=MetricsLogger(), gateway=None)
+        host = boot(server)
+
+        # pan-walk lattice: windows 12% of the cluster span stepping
+        # by 4% — each tile overlaps its neighbour by two thirds, so
+        # tiles landing in one wave tick have adjacent page windows
+        # the planner can union under the halo cap.  The y rows start
+        # high enough to stay on data (scenes anchor at ymax)
+        w = merc.width * 0.12
+        xs = np.arange(0.0, 0.60, 0.04)
+        ys = (0.15, 0.19, 0.35, 0.39)
+        tiles = [(float(fx), float(fy)) for fy in ys for fx in xs]
+
+        def getmap_url(fx: float, fy: float) -> str:
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + w},"
+                  f"{merc.ymin + fy * merc.height + w}")
+            return (f"http://{host}/ows?service=WMS&request=GetMap"
+                    f"&version=1.3.0&layers=landsat_burst"
+                    f"&crs=EPSG:3857&bbox={bb}"
+                    f"&width=256&height=256&format=image/png"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        def wcs_url(fx: float, fy: float) -> str:
+            ww = merc.width * 0.3
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + ww},"
+                  f"{merc.ymin + fy * merc.height + ww}")
+            return (f"http://{host}/ows?service=WCS"
+                    f"&request=GetCoverage"
+                    f"&coverage=landsat_burst&crs=EPSG:3857&bbox={bb}"
+                    f"&width=512&height=512&format=GeoTIFF"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        lock = threading.Lock()
+        counter = itertools.count()
+        errors: list = []
+
+        def fetch(url: str, kind: str):
+            """(ok, body) — no faults run in this scenario, so
+            anything but a clean 200 with the right magic fails."""
+            try:
+                with urllib.request.urlopen(url, timeout=300) as r:
+                    body = r.read()
+                    if r.status != 200:
+                        return False, body
+                    if kind == "map":
+                        return body[:8] == b"\x89PNG\r\n\x1a\n", body
+                    return body[:4] == b"II*\x00", body
+            except Exception as exc:  # noqa: BLE001 - reported below
+                with lock:
+                    if len(errors) < 5:
+                        errors.append(f"{kind}: {exc!r:.200}")
+                return False, b""
+
+        warm_ok = (fetch(getmap_url(*tiles[0]), "map")[0]
+                   and fetch(wcs_url(0.1, 0.2), "wcs")[0])
+
+        bad = [0]
+        n_req = {"map": 0, "wcs": 0}
+
+        def one():
+            i = next(counter)
+            # exports are a clustered minority; the map majority walks
+            # the pan lattice so simultaneous arrivals are neighbours
+            if i % 16 < 2:
+                kind, url = "wcs", wcs_url(*tiles[i % len(tiles)])
+            else:
+                kind, url = "map", getmap_url(*tiles[i % len(tiles)])
+            ok, _ = fetch(url, kind)
+            with lock:
+                n_req[kind] += 1
+                if not ok:
+                    bad[0] += 1
+
+        conc = max(args.conc, 16)
+        t_end = time.time() + args.seconds
+
+        def storm_worker():
+            while time.time() < t_end:
+                one()
+
+        storm = [threading.Thread(target=storm_worker)
+                 for _ in range(conc)]
+        for t in storm:
+            t.start()
+        for t in storm:
+            t.join()
+
+        st = autoplan.plan_stats()
+        gathered = paged.gather_bytes_total()
+        saved = st.get("gather_bytes_saved", 0)
+        dedup_ratio = saved / max(saved + gathered, 1)
+
+        # -- escape hatch: the SAME concurrent adjacent-tile volley
+        # with the planner off must be byte-identical — the plan-on
+        # volley is fired concurrently so its entries actually share a
+        # wave and can merge, making the parity claim non-trivial
+        probe = tiles[1:5]
+
+        def volley():
+            bodies: list = [None] * len(probe)
+
+            def grab(k, t):
+                bodies[k] = fetch(getmap_url(*t), "map")[1]
+            ths = [threading.Thread(target=grab, args=(k, t))
+                   for k, t in enumerate(probe)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            return bodies
+
+        bodies_on = volley()
+        os.environ["GSKY_PLAN"] = "0"
+        bodies_off = volley()
+        os.environ["GSKY_PLAN"] = "1"
+        byte_identical = (all(b for b in bodies_on)
+                          and bodies_on == bodies_off)
+
+        # every page the storm pinned must be back once waves drain
+        from gsky_tpu.pipeline import pages
+        pinned = -1
+        t_end = time.time() + 15
+        while time.time() < t_end:
+            pool = pages._default
+            pinned = (pool.stats().get("pinned", -1)
+                      if pool is not None else 0)
+            if pinned == 0:
+                break
+            time.sleep(0.5)
+
+        ws = wave_stats()
+        metrics = check_metrics(host, require=(
+            "gsky_requests_total", "gsky_wave_dispatches_total",
+            "gsky_plan_superblocks_total",
+            "gsky_plan_gather_bytes_saved_total",
+            "gsky_plan_block_shape", "gsky_plan_route_total"))
+
+        n_done = sum(n_req.values())
+        out = {
+            "scenario": "plan",
+            "warm_ok": warm_ok,
+            "requests": n_req, "failed": bad[0],
+            "errors": errors,
+            "plan": st,
+            "gathered_bytes": gathered,
+            "dedup_ratio": round(dedup_ratio, 4),
+            "escape_hatch_byte_identical": byte_identical,
+            "pool_pinned": pinned,
+            "waves": {"dispatches": ws.get("dispatches", 0),
+                      "requests": ws.get("requests", 0)},
+            "metrics": metrics,
+        }
+        print(json.dumps(out))
+        ok = (warm_ok and n_done > 0 and bad[0] == 0
+              and st.get("superblocks", 0) >= 1
+              and st.get("merged_lanes", 0) >= 1
+              and dedup_ratio > 0
+              and byte_identical
+              and pinned == 0
+              and not metrics["missing"])
+        print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+        return 0 if ok else 1
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        autoplan.reset_plan_state()
 
 
 if __name__ == "__main__":
