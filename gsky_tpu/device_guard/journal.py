@@ -129,10 +129,14 @@ def record_drop(serial: int, chip=None) -> None:
         _append(_chip_doc({"op": "drop", "serial": int(serial)}, chip))
 
 
-def replay(chip_map: Optional[Dict] = None) -> List[Tuple[int, int, int]]:
+def replay(chip_map: Optional[Dict] = None,
+           score_map: Optional[Dict] = None
+           ) -> List[Tuple[int, int, int]]:
     """Merge the journal into a hottest-first ``[(serial, pi, pj)]``.
     ``chip_map`` (optional out-param) collects the per-chip ownership
     tags mesh serving appends — see :func:`replay_chips`.
+    ``score_map`` (optional out-param) collects each page's merged
+    heat score — see :func:`replay_scored`.
 
     Priority is (accumulated heat + stage count, recency): a page the
     pool dumped with 17 hits outranks a page staged once and never
@@ -195,7 +199,20 @@ def replay(chip_map: Optional[Dict] = None) -> List[Tuple[int, int, int]]:
         return []
     if chip_map is not None:
         chip_map.update(chips)
+    if score_map is not None:
+        score_map.update(score)
     return sorted(score, key=lambda k: (-score[k], -last[k]))
+
+
+def replay_scored() -> List[Tuple[int, int, int, float]]:
+    """Heat export for the cache fabric (`fabric/replicate.py`):
+    hottest-first ``[(serial, pi, pj, score)]`` where score is the
+    merged heat+stage weight `replay()` orders by.  The absolute value
+    only matters relative to the other pages in the same journal —
+    popularity-weighted replication keys off the ranking and ratio."""
+    scores: Dict[Tuple[int, int, int], float] = {}
+    order = replay(score_map=scores)
+    return [(s, pi, pj, scores[(s, pi, pj)]) for s, pi, pj in order]
 
 
 def replay_chips() -> Tuple[List[Tuple[int, int, int]],

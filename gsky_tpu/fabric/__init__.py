@@ -1,0 +1,98 @@
+"""Cache fabric: lateral peer sharing between gateways and workers.
+
+Two tiers, both default-off behind ``GSKY_FABRIC``:
+
+* **Gateway tier** (`fabric/replay.py`) — on a response-cache miss the
+  consistent-hash ring (`fleet/ring.py`) designates an owner gateway;
+  non-owners issue a bounded peer-replay RPC and replay the encoded
+  bytes instead of paying a full render.  Misses in one gateway become
+  hits fleet-wide.
+* **Worker tier** (`fabric/pagerpc.py`, `fabric/replicate.py`) — pages
+  are content-keyed ``(serial, pi, pj)`` and the pool journal records
+  per-page heat, so a worker filling its pool asks ring-adjacent peers
+  for hot pages hottest-first over a batched page-fetch RPC instead of
+  re-decoding from storage; replicate.py spreads Zipf-head pages across
+  shards so hot content survives any single node.
+
+Peer HBM/host memory is an order of magnitude closer than object
+storage (see PAPERS.md, cloud-to-GPU throughput tiering): the fabric
+fills misses laterally before falling back to the cold tier.  Every
+peer interaction is deadline-clamped, breaker-guarded and falls back
+per-entry to the local render / cold-stage path — a dead peer costs
+one bounded probe, never a 5xx.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+
+def _on(name: str, dflt: str = "0") -> bool:
+    return os.environ.get(name, dflt).strip().lower() not in (
+        "0", "false", "off", "no", "")
+
+
+def fabric_enabled() -> bool:
+    """Master gate: ``GSKY_FABRIC=0`` (the default) keeps every fabric
+    code path dormant — byte-identical to a fabric-less build."""
+    return _on("GSKY_FABRIC")
+
+
+def replay_enabled() -> bool:
+    """Gateway peer-replay tier (needs the master gate too)."""
+    return fabric_enabled() and _on("GSKY_FABRIC_REPLAY", "1")
+
+
+def pages_enabled() -> bool:
+    """Worker page-peering tier (needs the master gate too)."""
+    return fabric_enabled() and _on("GSKY_FABRIC_PAGES", "1")
+
+
+def replicate_enabled() -> bool:
+    """Popularity-weighted hot-page replication (worker tier)."""
+    return fabric_enabled() and _on("GSKY_FABRIC_REPLICATE", "1")
+
+
+def self_addr() -> str:
+    """This gateway's advertised base URL on the replay ring."""
+    return os.environ.get("GSKY_FABRIC_SELF", "").strip()
+
+
+def peer_addrs() -> List[str]:
+    """Peer gateway base URLs (comma-separated, order-insensitive:
+    membership is a ring, not a list)."""
+    raw = os.environ.get("GSKY_FABRIC_PEERS", "")
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+def page_peer_addrs() -> List[str]:
+    """Peer worker gRPC addresses for the page-fetch RPC."""
+    raw = os.environ.get("GSKY_FABRIC_PAGE_PEERS", "")
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+def fabric_timeout_s() -> float:
+    """Upper bound on any single peer RPC; always further clamped by
+    the request deadline (`resilience.clamp_timeout`)."""
+    try:
+        return float(os.environ.get("GSKY_FABRIC_TIMEOUT_S", 2.0))
+    except (TypeError, ValueError):
+        return 2.0
+
+
+def fabric_stats(replay_fabric=None) -> Dict:
+    """One dict for the /debug ``fabric`` block; cheap when off."""
+    doc: Dict = {"enabled": fabric_enabled(),
+                 "replay_enabled": replay_enabled(),
+                 "pages_enabled": pages_enabled(),
+                 "replicate_enabled": replicate_enabled()}
+    if replay_fabric is not None:
+        doc["replay"] = replay_fabric.stats()
+    try:
+        from . import pagerpc, replicate
+        doc["pages"] = pagerpc.stats()
+        doc["replicate"] = replicate.stats()
+    except Exception:  # stats must never take /debug down
+        pass
+    return doc

@@ -1,0 +1,265 @@
+"""Worker tier: batched content-keyed page fetch between pools.
+
+Pages are content-keyed ``(serial, pi, pj)`` (pipeline/pages.py), so a
+page staged on any worker is byte-equivalent to the same page staged
+anywhere else — which makes peer HBM a legitimate fill source.  A
+worker rebuilding its pool (cold start, post-preemption
+``rehydrate()``) asks ring-adjacent peers for the journal's hot set
+hottest-first instead of re-decoding scenes from storage.
+
+Wire format — one worker-RPC round trip (``operation="page_fetch"``
+on the existing ``/gskyrpc.GDAL/Process`` method):
+
+* request, in ``Task.path``::
+
+      {"v": 1, "pages": [[serial, pi, pj], ...], "max_bytes": N}
+
+* response: ``Result.raster`` holds the concatenated float32 page
+  bytes; ``Result.info_json`` holds the manifest::
+
+      {"v": 1, "page_shape": [PR, PC],
+       "pages": [{"serial": s, "pi": i, "pj": j,
+                  "off": byte_offset, "len": byte_len, "crc": crc32},
+                 ...]}
+
+  Pages the peer doesn't hold are simply absent.  Every page carries a
+  stage-side CRC32; the receiver recomputes it before staging and
+  drops mismatches — a truncated or corrupted page must never enter a
+  pool under a content key it doesn't match.
+
+Batches are capped by ``GSKY_FABRIC_PAGE_BATCH_MB`` per RPC so one
+fetch can never message-size-bomb the channel; per-peer breakers
+(``fabric-page:{addr}``) stop a dead peer from stalling recovery.
+Everything degrades to the cold path: a failed fetch just leaves those
+pages for the scene-cache / storage loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import fabric_timeout_s, page_peer_addrs
+from ..fleet.ring import HashRing
+from ..resilience import get_breaker
+
+Key = Tuple[int, int, int]
+
+_lock = threading.Lock()
+_stats: Dict[str, float] = {"fills": 0, "served": 0, "rpc_errors": 0,
+                            "integrity_drops": 0, "breaker_skips": 0}
+_ewma_ms: Dict[str, float] = {}
+
+
+def _count(name: str, n: int = 1) -> None:
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + n
+
+
+def _latency(peer: str, ms: float) -> None:
+    with _lock:
+        prev = _ewma_ms.get(peer)
+        _ewma_ms[peer] = ms if prev is None else 0.8 * prev + 0.2 * ms
+
+
+def stats() -> Dict:
+    with _lock:
+        return {**{k: int(v) for k, v in _stats.items()},
+                "peer_ewma_ms": {p: round(v, 3)
+                                 for p, v in _ewma_ms.items()}}
+
+
+def batch_bytes() -> int:
+    try:
+        mb = float(os.environ.get("GSKY_FABRIC_PAGE_BATCH_MB", 8))
+    except (TypeError, ValueError):
+        mb = 8.0
+    return max(1 << 20, int(mb * (1 << 20)))
+
+
+# -- wire codec -------------------------------------------------------
+
+def encode_request(keys: Sequence[Key],
+                   max_bytes: Optional[int] = None) -> str:
+    return json.dumps({
+        "v": 1,
+        "pages": [[int(s), int(pi), int(pj)] for s, pi, pj in keys],
+        "max_bytes": int(max_bytes if max_bytes is not None
+                         else batch_bytes())})
+
+
+def serve_page_fetch(pool, doc: Dict) -> Tuple[Dict, bytes]:
+    """Serving half: read requested resident pages back to host.
+
+    Returns ``(manifest, blob)``; unknown pages are omitted, the byte
+    budget in the request is honoured request-order (the requester
+    sends hottest-first, so truncation drops the coldest tail)."""
+    budget = int(doc.get("max_bytes") or batch_bytes())
+    chunks: List[bytes] = []
+    entries: List[Dict] = []
+    off = 0
+    for item in doc.get("pages") or []:
+        try:
+            serial, pi, pj = (int(item[0]), int(item[1]), int(item[2]))
+        except (TypeError, ValueError, IndexError):
+            continue
+        page = pool.read_page(serial, pi, pj)
+        if page is None:
+            continue
+        raw = np.ascontiguousarray(page, np.float32).tobytes()
+        if off + len(raw) > budget and entries:
+            break
+        entries.append({"serial": serial, "pi": pi, "pj": pj,
+                        "off": off, "len": len(raw),
+                        "crc": zlib.crc32(raw)})
+        chunks.append(raw)
+        off += len(raw)
+    _count("served", len(entries))
+    manifest = {"v": 1,
+                "page_shape": [pool.page_rows, pool.page_cols],
+                "pages": entries}
+    return manifest, b"".join(chunks)
+
+
+def decode_result(info_json: str, blob: bytes
+                  ) -> Dict[Key, np.ndarray]:
+    """Client half: manifest + blob -> {key: (PR, PC) float32 page}.
+
+    CRC failures and malformed extents are dropped (and counted), not
+    raised — the content-key contract says a page either matches its
+    key exactly or does not exist."""
+    try:
+        manifest = json.loads(info_json or "{}")
+    except ValueError:
+        return {}
+    try:
+        pr, pc = (int(manifest["page_shape"][0]),
+                  int(manifest["page_shape"][1]))
+    except (KeyError, TypeError, ValueError, IndexError):
+        return {}
+    want = pr * pc * 4
+    out: Dict[Key, np.ndarray] = {}
+    for ent in manifest.get("pages") or []:
+        try:
+            key = (int(ent["serial"]), int(ent["pi"]), int(ent["pj"]))
+            off, ln, crc = int(ent["off"]), int(ent["len"]), int(ent["crc"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        raw = blob[off:off + ln]
+        if ln != want or len(raw) != ln or zlib.crc32(raw) != crc:
+            _count("integrity_drops")
+            continue
+        out[key] = np.frombuffer(raw, np.float32).reshape(pr, pc)
+    return out
+
+
+# -- transport --------------------------------------------------------
+
+def _grpc_fetch(peer: str, keys: Sequence[Key], max_bytes: int,
+                timeout: float) -> Dict[Key, np.ndarray]:
+    """One page-fetch RPC against one peer worker; raises on transport
+    or peer error (the caller's breaker records it)."""
+    import grpc
+
+    from ..worker import gskyrpc_pb2 as pb
+    from ..worker.server import METHOD
+    opts = [("grpc.max_receive_message_length",
+             max_bytes + (1 << 20)),
+            ("grpc.max_send_message_length", 4 << 20)]
+    ch = grpc.insecure_channel(peer, options=opts)
+    try:
+        call = ch.unary_unary(
+            METHOD, request_serializer=pb.Task.SerializeToString,
+            response_deserializer=pb.Result.FromString)
+        task = pb.Task(operation="page_fetch",
+                       path=encode_request(keys, max_bytes))
+        res = call(task, timeout=timeout)
+        if res.error:
+            raise RuntimeError(res.error)
+        return decode_result(res.info_json, res.raster)
+    finally:
+        ch.close()
+
+
+def fetch_pages(peer: str, keys: Sequence[Key],
+                max_bytes: Optional[int] = None,
+                timeout: Optional[float] = None,
+                fetch: Optional[Callable] = None
+                ) -> Dict[Key, np.ndarray]:
+    """Breaker-guarded fetch of ``keys`` from ``peer``; empty dict on
+    any failure (never raises)."""
+    brk = get_breaker(f"fabric-page:{peer}")
+    if not brk.allow():
+        _count("breaker_skips")
+        return {}
+    mb = int(max_bytes if max_bytes is not None else batch_bytes())
+    t0 = time.monotonic()
+    try:
+        got = (fetch or _grpc_fetch)(
+            peer, keys, mb,
+            timeout if timeout is not None else fabric_timeout_s())
+    except Exception:   # any peer failure degrades to the cold path
+        brk.record_failure()
+        _count("rpc_errors")
+        return {}
+    brk.record_success()
+    _latency(peer, (time.monotonic() - t0) * 1000.0)
+    return got
+
+
+# -- pool fill --------------------------------------------------------
+
+def _batches(keys: List[Key], page_bytes: int,
+             cap: int) -> List[List[Key]]:
+    per = max(1, cap // max(1, page_bytes))
+    return [keys[i:i + per] for i in range(0, len(keys), per)]
+
+
+def fill_from_peers(pool, entries: Sequence[Key],
+                    peers: Optional[List[str]] = None,
+                    fetch: Optional[Callable] = None) -> int:
+    """Fill ``pool`` from ring-adjacent peers, hottest-first.
+
+    ``entries`` is the journal's hottest-first page list; each key is
+    asked of its ring-preferred peer first (so a stable fleet converges
+    on who serves what), then of the next candidate for whatever the
+    first round missed.  Returns pages actually staged."""
+    peers = list(peers if peers is not None else page_peer_addrs())
+    if not peers or not entries:
+        return 0
+    ring = HashRing(peers, vnodes=32)
+    page_bytes = pool.page_rows * pool.page_cols * 4
+    cap = batch_bytes()
+    want: List[Key] = [(int(s), int(pi), int(pj))
+                       for s, pi, pj in entries]
+    filled = 0
+    for rnd in (0, 1):          # preference walk: owner, then next
+        missing: List[Key] = []
+        by_peer: Dict[str, List[Key]] = {}
+        for key in want:
+            pref = ring.preference(json.dumps(key), rnd + 1)
+            if len(pref) <= rnd:
+                continue
+            by_peer.setdefault(pref[rnd], []).append(key)
+        for peer, keys in by_peer.items():
+            got_any: Dict[Key, np.ndarray] = {}
+            for batch in _batches(keys, page_bytes, cap):
+                got_any.update(fetch_pages(peer, batch, cap,
+                                           fetch=fetch))
+            for key in keys:
+                page = got_any.get(key)
+                if page is not None and pool.stage_page(*key, page):
+                    filled += 1
+                else:
+                    missing.append(key)
+        want = missing
+        if not want:
+            break
+    _count("fills", filled)
+    return filled

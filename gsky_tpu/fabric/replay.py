@@ -1,0 +1,288 @@
+"""Gateway tier: peer replay of fully-encoded responses.
+
+A ``ReplayFabric`` places every gateway (self + ``GSKY_FABRIC_PEERS``)
+on the consistent-hash ring from `fleet/ring.py`.  For each canonical
+response key the ring designates an *owner* gateway; a non-owner that
+misses its local `serving.ResponseCache` asks the owner (then, if that
+fails, the next ring candidate) for the encoded bytes over a tiny HTTP
+GET before paying a full render.  Because owners concentrate the first
+render of each key, one gateway's miss becomes every gateway's hit.
+
+Wire format (``GET {peer}/fabric/replay?key={sha1}``)::
+
+    200  body = entry bytes, plus
+         Content-Type:            entry content type
+         ETag:                    "sha256[:32]" of the body
+         X-Gsky-Fabric-Status:    origin HTTP status (always 200)
+         X-Gsky-Fabric-Age:       seconds the entry has been cached
+         X-Gsky-Fabric-Max-Age:   origin TTL in seconds
+         X-Gsky-Fabric-Ns/-Layer/-Fp: cache identity (namespace, layer,
+                                  layer config fingerprint)
+         X-Gsky-Fabric-Keep:      JSON of extra replay headers
+    404  peer has no fresh entry (or fabric off / brownout shedding)
+
+Validators on receipt: the ETag must match a recomputed digest of the
+body (content integrity), and ``max_age - age`` must leave positive
+remaining TTL — the rebuilt entry expires at the *origin* deadline, so
+Age keeps accumulating across hops exactly as RFC 9111 wants.  Peers
+never serve stale or degraded entries (those are marked no-store at
+origin and refused here); a brownout peer answers 404 and sheds.
+
+Every fetch is deadline-clamped (`resilience.clamp_timeout`),
+singleflight-deduped per key, and guarded by a per-peer breaker
+(``fabric:{peer}``).  All failure modes return ``None`` — the caller
+falls back to its local render; the fabric can only ever remove work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import fabric_timeout_s, replay_enabled
+from ..fleet.ring import HashRing
+from ..resilience import (BreakerOpen, DeadlineExceeded, clamp_timeout,
+                          get_breaker)
+from ..serving.response_cache import CachedResponse, make_entry
+from ..serving.singleflight import SingleFlight
+
+_H = "X-Gsky-Fabric"
+
+# fetch outcomes, mirrored into gsky_fabric_replay_total{outcome}
+OUTCOMES = ("hit", "miss", "error", "deadline", "breaker_open",
+            "owner_local", "disabled")
+
+
+def _note(outcome: str) -> None:
+    try:
+        from ..obs import metrics as _m
+        _m.FABRIC_REPLAY.labels(outcome=outcome).inc()
+    except Exception:  # obs is best-effort, never on the serving path
+        pass
+
+
+def encode_entry(ent: CachedResponse) -> Tuple[Dict[str, str], bytes]:
+    """Headers + body for serving ``ent`` to a peer."""
+    age = max(0, int(ent.max_age - (ent.expires - time.monotonic())))
+    headers = {
+        "ETag": ent.etag,
+        f"{_H}-Status": str(ent.status),
+        f"{_H}-Age": str(age),
+        f"{_H}-Max-Age": str(ent.max_age),
+        f"{_H}-Ns": ent.namespace,
+        f"{_H}-Layer": ent.layer,
+        f"{_H}-Fp": ent.layer_fp,
+    }
+    if ent.headers:
+        headers[f"{_H}-Keep"] = json.dumps(list(ent.headers))
+    return headers, ent.body
+
+
+def entry_from_response(status: int, headers: Dict[str, str],
+                        body: bytes) -> Optional[CachedResponse]:
+    """Validate + rebuild a peer response into a cacheable entry.
+
+    Returns ``None`` for anything unusable: non-200, missing fabric
+    headers, ETag/body digest mismatch, or no remaining TTL.
+    """
+    if status != 200 or not body:
+        return None
+    hdr = {k.lower(): v for k, v in headers.items()}
+    if hdr.get(f"{_H}-NoStore".lower()):
+        return None
+    try:
+        origin_status = int(hdr.get(f"{_H}-Status".lower(), "0"))
+        age = int(hdr.get(f"{_H}-Age".lower(), "0"))
+        max_age = int(hdr.get(f"{_H}-Max-Age".lower(), "0"))
+    except (TypeError, ValueError):
+        return None
+    if origin_status != 200:
+        return None
+    remaining = max_age - max(0, age)
+    if remaining <= 0:
+        return None
+    etag = hdr.get("etag", "")
+    if etag != '"' + hashlib.sha256(body).hexdigest()[:32] + '"':
+        return None          # bytes corrupted or truncated in transit
+    keep: Tuple[Tuple[str, str], ...] = ()
+    raw_keep = hdr.get(f"{_H}-Keep".lower())
+    if raw_keep:
+        try:
+            keep = tuple((str(k), str(v))
+                         for k, v in json.loads(raw_keep))
+        except (ValueError, TypeError):
+            keep = ()
+    ent = make_entry(
+        body=body,
+        content_type=hdr.get("content-type", "application/octet-stream"),
+        status=origin_status,
+        namespace=hdr.get(f"{_H}-Ns".lower(), ""),
+        layer=hdr.get(f"{_H}-Layer".lower(), ""),
+        layer_fp=hdr.get(f"{_H}-Fp".lower(), ""),
+        max_age=max_age, headers=keep)
+    # expire at the origin deadline, not ours: Age must keep accruing
+    ent.expires = time.monotonic() + remaining
+    return ent
+
+
+def _http_fetch(url: str, timeout: float
+                ) -> Tuple[int, Dict[str, str], bytes]:
+    """Default transport: one blocking stdlib GET (run in a thread)."""
+    req = urllib.request.Request(url, method="GET")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers.items()), resp.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, dict(exc.headers.items() if exc.headers
+                              else []), b""
+
+
+class ReplayFabric:
+    """Per-gateway handle on the replay ring.
+
+    ``transport`` is injectable for tests: a callable
+    ``(url, timeout) -> (status, headers, body)`` run off-loop.
+    """
+
+    def __init__(self, self_addr: str, peers: List[str],
+                 timeout_s: Optional[float] = None,
+                 transport: Optional[Callable] = None,
+                 max_attempts: int = 2):
+        self.self_addr = self_addr
+        members = sorted({self_addr, *peers})
+        self.ring = HashRing(members, vnodes=32)
+        self._timeout_s = timeout_s
+        self.transport = transport or _http_fetch
+        self.flight = SingleFlight()
+        self.max_attempts = max_attempts
+        self._lock = threading.Lock()
+        self.outcomes: Dict[str, int] = {}
+        self._ewma_ms: Dict[str, float] = {}   # per-peer RPC latency
+
+    # -- membership --------------------------------------------------
+
+    def set_peers(self, peers: List[str]) -> None:
+        """Reconfigure ring membership (bumps ``ring.generation`` when
+        it actually changes, instantly re-homing every key)."""
+        self.ring.set_nodes(sorted({self.self_addr, *peers}))
+
+    def owner(self, key: str) -> Optional[str]:
+        return self.ring.owner(key)
+
+    def is_owner(self, key: str) -> bool:
+        return self.owner(key) == self.self_addr
+
+    def candidates(self, key: str) -> List[str]:
+        """Ring preference walk for ``key``, minus self, bounded."""
+        walk = self.ring.preference(key, self.max_attempts + 1)
+        return [p for p in walk if p != self.self_addr][:self.max_attempts]
+
+    # -- bookkeeping -------------------------------------------------
+
+    def _count(self, outcome: str) -> None:
+        with self._lock:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        _note(outcome)
+
+    def _latency(self, peer: str, ms: float) -> None:
+        with self._lock:
+            prev = self._ewma_ms.get(peer)
+            self._ewma_ms[peer] = ms if prev is None \
+                else 0.8 * prev + 0.2 * ms
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"self": self.self_addr,
+                    "members": list(self.ring.nodes),
+                    "generation": self.ring.generation,
+                    "outcomes": dict(self.outcomes),
+                    "peer_ewma_ms": {p: round(v, 3) for p, v
+                                     in self._ewma_ms.items()}}
+
+    # -- fetch path --------------------------------------------------
+
+    async def fetch(self, key: str) -> Optional[CachedResponse]:
+        """Best-effort peer replay for ``key``; never raises.
+
+        Owners return ``None`` immediately (they *are* the authority —
+        their render seeds the fleet).  Non-owners walk the ring
+        preference, one bounded breaker-guarded probe per candidate.
+        """
+        if not replay_enabled():
+            self._count("disabled")
+            return None
+        if self.is_owner(key):
+            self._count("owner_local")
+            return None
+        peers = self.candidates(key)
+        if not peers:
+            self._count("miss")
+            return None
+
+        async def _fetch_all():
+            for peer in peers:
+                ent = await self._fetch_one(peer, key)
+                if ent is not None:
+                    return ent
+            return None
+
+        try:
+            ent, _joined = await self.flight.do(f"fabric:{key}",
+                                                _fetch_all)
+        except DeadlineExceeded:
+            self._count("deadline")
+            return None
+        except Exception:   # transport bugs must not surface as 5xx
+            self._count("error")
+            return None
+        self._count("hit" if ent is not None else "miss")
+        return ent
+
+    async def _fetch_one(self, peer: str,
+                         key: str) -> Optional[CachedResponse]:
+        brk = get_breaker(f"fabric:{peer}")
+        if not brk.allow():
+            self._count("breaker_open")
+            return None
+        # no budget left: abort the whole candidate walk, not just
+        # this peer — DeadlineExceeded propagates to fetch()
+        timeout = clamp_timeout(self._timeout_s
+                                if self._timeout_s is not None
+                                else fabric_timeout_s())
+        url = (peer.rstrip("/") + "/fabric/replay?key="
+               + urllib.parse.quote(key, safe=""))
+        t0 = time.monotonic()
+        try:
+            status, headers, body = await asyncio.to_thread(
+                self.transport, url, timeout)
+        except BreakerOpen:
+            self._count("breaker_open")
+            return None
+        except Exception:
+            brk.record_failure()
+            self._count("error")
+            return None
+        self._latency(peer, (time.monotonic() - t0) * 1000.0)
+        if status >= 500:
+            brk.record_failure()
+            self._count("error")
+            return None
+        brk.record_success()
+        return entry_from_response(status, headers, body)
+
+
+def default_fabric() -> Optional["ReplayFabric"]:
+    """Build a fabric from env (``GSKY_FABRIC_SELF`` +
+    ``GSKY_FABRIC_PEERS``); ``None`` when not configured."""
+    from . import peer_addrs, self_addr
+    me, peers = self_addr(), peer_addrs()
+    if not me or not peers:
+        return None
+    return ReplayFabric(me, peers)

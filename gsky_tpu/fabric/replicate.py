@@ -1,0 +1,135 @@
+"""Popularity-weighted replication of hot pages across shards.
+
+The page-peering tier (`fabric/pagerpc.py`) makes any resident page
+fetchable — but a page resident on exactly one worker still dies with
+that worker, and serving traffic is Zipf-shaped: losing the head of
+the distribution is a fleet-wide miss storm, losing the tail is
+nothing.  This module turns the pool journal's heat ranking
+(`device_guard/journal.py::replay_scored`) into a replication plan:
+
+* every page gets a deterministic replica set — the first ``r`` nodes
+  of its consistent-hash preference walk (`fleet/ring.py`), where
+* ``r`` scales with popularity: the hottest page gets the full
+  ``GSKY_FABRIC_REPLICAS`` copies, a page at a fraction ``f`` of the
+  top score gets ``1 + round(f * (R - 1))`` — Zipf-head content
+  survives any single node, tail content costs one slot.
+
+A worker runs :func:`replicate_to_pool` opportunistically (after a
+rehydrate, or from an operator/cron poke): it stages — via the normal
+page-fetch RPC — every page whose replica set includes this node but
+which is not yet resident locally.  Replication is pull-based and
+idempotent; there is no coordinator and nothing to fail over.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import page_peer_addrs, replicate_enabled
+from ..fleet.ring import HashRing
+
+Key = Tuple[int, int, int]
+
+_lock = threading.Lock()
+_replica_pages = 0
+_rounds = 0
+
+
+def replica_count() -> int:
+    """Target copies for the hottest content (``GSKY_FABRIC_REPLICAS``,
+    default 2 — survive any one node)."""
+    try:
+        return max(1, int(os.environ.get("GSKY_FABRIC_REPLICAS", 2)))
+    except (TypeError, ValueError):
+        return 2
+
+
+def replicas_for(score: float, top_score: float, replicas: int) -> int:
+    """Popularity-weighted copy count: linear in the page's share of
+    the top heat score, floored at one copy."""
+    if top_score <= 0 or replicas <= 1:
+        return 1
+    frac = max(0.0, min(1.0, float(score) / float(top_score)))
+    return 1 + int(round(frac * (replicas - 1)))
+
+
+def replication_targets(ring: HashRing, key: Key,
+                        n: int) -> List[str]:
+    """The deterministic replica set: first ``n`` distinct nodes of the
+    key's preference walk."""
+    return ring.preference(json.dumps([int(k) for k in key]), n)
+
+
+def plan(scored: Sequence[Tuple[int, int, int, float]],
+         nodes: Sequence[str], self_node: str,
+         replicas: Optional[int] = None,
+         budget_pages: Optional[int] = None) -> List[Key]:
+    """Pages ``self_node`` should hold, hottest first.
+
+    ``scored`` is `journal.replay_scored()` output (hottest-first).
+    ``budget_pages`` caps the plan so replication never floods a pool
+    past its own working set."""
+    nodes = sorted(set(nodes))
+    if self_node not in nodes or not scored:
+        return []
+    ring = HashRing(nodes, vnodes=32)
+    r = replica_count() if replicas is None else max(1, int(replicas))
+    top = max(s for _, _, _, s in scored)
+    out: List[Key] = []
+    for serial, pi, pj, score in scored:
+        key = (int(serial), int(pi), int(pj))
+        n = replicas_for(score, top, r)
+        if self_node in replication_targets(ring, key, n):
+            out.append(key)
+            if budget_pages is not None and len(out) >= budget_pages:
+                break
+    return out
+
+
+def replicate_to_pool(pool, self_node: str,
+                      peers: Optional[List[str]] = None,
+                      fetch: Optional[Callable] = None) -> int:
+    """Pull this node's planned replicas into ``pool`` via the page
+    RPC.  Pages already resident are free; everything else is fetched
+    from ring-adjacent peers.  Returns pages newly staged."""
+    global _replica_pages, _rounds
+    if not replicate_enabled():
+        return 0
+    from ..device_guard import journal
+    scored = journal.replay_scored()
+    if not scored:
+        return 0
+    peers = list(peers if peers is not None else page_peer_addrs())
+    nodes = sorted({self_node, *peers})
+    # replicate at most half the pool: warmth insurance must not evict
+    # the locally-earned working set
+    budget = max(1, pool.capacity // 2)
+    wanted = plan(scored, nodes, self_node, budget_pages=budget)
+    missing = [k for k in wanted if not pool.has_page(*k)]
+    held = len(wanted) - len(missing)
+    filled = 0
+    if missing and peers:
+        from . import pagerpc
+        filled = pagerpc.fill_from_peers(pool, missing, peers=peers,
+                                         fetch=fetch)
+    with _lock:
+        _replica_pages = held + filled
+        _rounds += 1
+    return filled
+
+
+def stats() -> Dict:
+    with _lock:
+        return {"replica_pages": _replica_pages, "rounds": _rounds,
+                "replicas": replica_count()}
+
+
+def reset_stats() -> None:
+    """Test hook."""
+    global _replica_pages, _rounds
+    with _lock:
+        _replica_pages = 0
+        _rounds = 0

@@ -160,6 +160,18 @@ class FleetRouter:
                 if n not in set(healthy) and n not in set(suspect)]
         return healthy + suspect + rest
 
+    def peers_for(self, key: str, n: Optional[int] = None,
+                  exclude: Optional[str] = None) -> List[str]:
+        """Ring-adjacent peer selection for the cache fabric
+        (docs/FABRIC.md): the key's preference walk filtered to
+        currently-routable nodes, optionally excluding the asking node
+        itself.  Unlike :meth:`candidates` this never pads with dead
+        nodes — a fabric fill is an optimisation, so an unroutable
+        peer is simply not asked."""
+        out = [m for m in self.ring.preference(key)
+               if m != exclude and self.monitor.routable(m)]
+        return out if n is None else out[:n]
+
     def record_locality(self, key: str, node: str) -> None:
         with self._lock:
             prev = self._last_node.get(key)

@@ -23,7 +23,7 @@ from aiohttp import web
 from .store import MASStore
 
 
-class ResponseCache:
+class MasQueryCache:
     """LRU response cache keyed on the canonical query — the memcached
     response cache of `mas/api/api.go:43-52,133-137` (keyed md5(URL)
     there).  Keys carry the store generation, so every ingest
@@ -128,10 +128,10 @@ class SharedResponseCache:
 
 
 def build_app(store: MASStore,
-              cache: Optional[ResponseCache] = None,
+              cache: Optional[MasQueryCache] = None,
               shared_cache: Optional[SharedResponseCache] = None
               ) -> web.Application:
-    cache = cache if cache is not None else ResponseCache()
+    cache = cache if cache is not None else MasQueryCache()
     if shared_cache is None:
         import os
         sp = os.environ.get("GSKY_MAS_SHARED_CACHE", "")

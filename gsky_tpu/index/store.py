@@ -422,7 +422,7 @@ class MASStore:
         return self._cache_put(ckey, {"gdal": gdal})
 
     def _cache_put(self, ckey, value: Dict) -> Dict:
-        # NOTE: this, api.ResponseCache and executor's geo cache are
+        # NOTE: this, api.MasQueryCache and executor's geo cache are
         # three small LRUs with different value lifetimes (raw query
         # dicts / HTTP byte bodies / numpy+device arrays); kept separate
         # deliberately — a shared helper would couple their eviction

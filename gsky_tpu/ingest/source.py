@@ -24,6 +24,8 @@ and the caller stays on its plain read path.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import os
 import threading
 import time
@@ -163,12 +165,20 @@ class HTTPRangeSource(ByteSource):
 
     # -- requests -------------------------------------------------------
 
+    def _request_headers(self, method: str,
+                         headers: Dict[str, str]) -> Dict[str, str]:
+        """Per-request header hook; subclasses (S3RangeSource) add
+        authentication here.  Must return the headers to send —
+        including the ones passed in."""
+        return headers
+
     def _once(self, offset: int, length: int) -> bytes:
         conn = self._acquire()
         try:
-            conn.request("GET", self._path, headers={
-                "Range": f"bytes={offset}-{offset + length - 1}",
-                "Connection": "keep-alive"})
+            conn.request("GET", self._path, headers=self._request_headers(
+                "GET", {
+                    "Range": f"bytes={offset}-{offset + length - 1}",
+                    "Connection": "keep-alive"}))
             resp = conn.getresponse()
             body = resp.read()
             self.requests += 1
@@ -212,7 +222,8 @@ class HTTPRangeSource(ByteSource):
             # bounds checks before the first ranged GET answers)
             conn = self._acquire()
             try:
-                conn.request("HEAD", self._path)
+                conn.request("HEAD", self._path,
+                             headers=self._request_headers("HEAD", {}))
                 resp = conn.getresponse()
                 resp.read()
                 cl = resp.getheader("Content-Length")
@@ -232,6 +243,140 @@ class HTTPRangeSource(ByteSource):
                 c.close()
             except Exception:  # teardown - close errors on idle conns are moot
                 pass
+
+
+# ---------------------------------------------------------------------------
+# s3:// — SigV4-signed ranged reads
+# ---------------------------------------------------------------------------
+
+# sha256 of an empty payload: ranged GET/HEAD bodies are empty
+EMPTY_PAYLOAD_SHA256 = ("e3b0c44298fc1c149afbf4c8996fb9242"
+                        "7ae41e4649b934ca495991b7852b855")
+
+
+def aws_credentials() -> Optional[Tuple[str, str, Optional[str]]]:
+    """The env credential chain: (access_key, secret_key, session
+    token or None), or None when unconfigured (anonymous requests —
+    public buckets still work unsigned)."""
+    ak = os.environ.get("AWS_ACCESS_KEY_ID", "")
+    sk = os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+    if not ak or not sk:
+        return None
+    return ak, sk, os.environ.get("AWS_SESSION_TOKEN") or None
+
+
+def sigv4_headers(method: str, host: str, path: str, query: str = "",
+                  region: str = "us-east-1", access_key: str = "",
+                  secret_key: str = "",
+                  session_token: Optional[str] = None,
+                  amzdate: Optional[str] = None,
+                  payload_hash: str = EMPTY_PAYLOAD_SHA256,
+                  headers: Optional[Dict[str, str]] = None,
+                  service: str = "s3") -> Dict[str, str]:
+    """AWS Signature Version 4, header-auth flavour.
+
+    Pure function of its inputs — ``amzdate`` (``YYYYMMDDTHHMMSSZ``)
+    is injectable so tests can pin the canned AWS vector instead of
+    the clock.  ``headers`` are extra headers to SIGN (e.g. Range);
+    every signed header must then be sent byte-identical.  Returns the
+    headers to attach: the signed extras, ``x-amz-*``, and
+    ``Authorization`` (``host`` is omitted — http.client sends it)."""
+    if amzdate is None:
+        amzdate = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    datestamp = amzdate[:8]
+    hdrs = {"host": host, "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amzdate}
+    for k, v in (headers or {}).items():
+        hdrs[k.lower()] = str(v)
+    if session_token:
+        hdrs["x-amz-security-token"] = session_token
+    names = sorted(hdrs)
+    signed_names = ";".join(names)
+    canonical_headers = "".join(
+        f"{k}:{hdrs[k].strip()}\n" for k in names)
+    q = ""
+    if query:
+        from urllib.parse import parse_qsl, quote
+        q = "&".join(
+            f"{quote(k, safe='-_.~')}={quote(v, safe='-_.~')}"
+            for k, v in sorted(parse_qsl(query,
+                                         keep_blank_values=True)))
+    creq = "\n".join([method, path or "/", q, canonical_headers,
+                      signed_names, payload_hash])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = "\n".join(["AWS4-HMAC-SHA256", amzdate, scope,
+                         hashlib.sha256(creq.encode()).hexdigest()])
+
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k_sign = _hmac(_hmac(_hmac(_hmac(("AWS4" + secret_key).encode(),
+                                     datestamp), region), service),
+                   "aws4_request")
+    sig = hmac.new(k_sign, to_sign.encode(), hashlib.sha256).hexdigest()
+    out = {k: hdrs[k] for k in names if k != "host"}
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_names}, Signature={sig}")
+    return out
+
+
+class S3RangeSource(HTTPRangeSource):
+    """``s3://bucket/key`` through the same ranged-GET pool, with
+    SigV4 header signing from the env credential chain
+    (``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY`` /
+    ``AWS_SESSION_TOKEN``); unsigned when no credentials are set.
+    Region from ``AWS_REGION`` / ``AWS_DEFAULT_REGION`` (default
+    us-east-1); a custom endpoint (``AWS_ENDPOINT_URL_S3`` /
+    ``AWS_ENDPOINT_URL`` — minio, localstack) switches to path-style
+    addressing.  Every retry is re-signed: `_request_headers` runs per
+    attempt, so a request never goes out with a stale date."""
+
+    def __init__(self, url: str, pool_size: int = 4,
+                 timeout: float = 10.0):
+        from urllib.parse import urlsplit
+        parts = urlsplit(url)
+        if parts.scheme != "s3" or not parts.netloc or \
+                not parts.path.lstrip("/"):
+            raise ValueError(f"not an s3://bucket/key url: {url}")
+        self.bucket = parts.netloc
+        self.key = parts.path.lstrip("/")
+        self.region = (os.environ.get("AWS_REGION")
+                       or os.environ.get("AWS_DEFAULT_REGION")
+                       or "us-east-1")
+        endpoint = (os.environ.get("AWS_ENDPOINT_URL_S3")
+                    or os.environ.get("AWS_ENDPOINT_URL") or "")
+        if endpoint:
+            http_url = (endpoint.rstrip("/")
+                        + f"/{self.bucket}/{self.key}")
+        else:
+            host = (f"{self.bucket}.s3.amazonaws.com"
+                    if self.region == "us-east-1" else
+                    f"{self.bucket}.s3.{self.region}.amazonaws.com")
+            http_url = f"https://{host}/{self.key}"
+        super().__init__(http_url, pool_size=pool_size, timeout=timeout)
+        self.s3_url = url
+
+    def _signing_host(self) -> str:
+        if self._port and self._port not in (80, 443):
+            return f"{self._host}:{self._port}"
+        return self._host
+
+    def _request_headers(self, method: str,
+                         headers: Dict[str, str]) -> Dict[str, str]:
+        creds = aws_credentials()
+        if creds is None:
+            return headers
+        access_key, secret_key, token = creds
+        path, _, query = self._path.partition("?")
+        sign = {k: v for k, v in headers.items()
+                if k.lower() != "connection"}   # hop-by-hop: unsigned
+        out = dict(headers)
+        out.update(sigv4_headers(
+            method, self._signing_host(), path, query=query,
+            region=self.region, access_key=access_key,
+            secret_key=secret_key, session_token=token, headers=sign))
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -312,6 +457,10 @@ def open_source(path: str) -> Optional[ByteSource]:
     kinds = allowed_kinds()
     if path.startswith(("http://", "https://")):
         return HTTPRangeSource(path) if "http" in kinds else None
+    if path.startswith("s3://"):
+        # opt-in: add "s3" to GSKY_INGEST_SOURCES (credentials ride
+        # the standard AWS_* env chain; unsigned without them)
+        return S3RangeSource(path) if "s3" in kinds else None
     return LocalFileSource(path) if "local" in kinds else None
 
 

@@ -85,6 +85,16 @@ PLAN_ROUTE = _REG.counter(
     "Autoplanner group routing between ragged slot pad and bucketed "
     "pulls (the PR 8 crossover).",
     ["path"])
+FABRIC_REPLAY = _REG.counter(
+    "gsky_fabric_replay_total",
+    "Gateway peer-replay fetch outcomes (docs/FABRIC.md): hit/miss/"
+    "error/deadline/breaker_open/owner_local/disabled.",
+    ["outcome"])
+FABRIC_PAGE_FILLS = _REG.counter(
+    "gsky_fabric_page_fills_total",
+    "Page-pool fills by source: peer (fabric page RPC) vs cold "
+    "(decode + stage from storage).",
+    ["source"])
 
 Rows = Iterable[Tuple[Dict[str, str], float]]
 
@@ -504,10 +514,32 @@ def _collect_tsan():
     return out
 
 
+def _collect_fabric():
+    """Cache-fabric surfaces (docs/FABRIC.md): the replica-page gauge
+    from the popularity-weighted replication planner.  Reported when
+    the fabric is on or has ever planned — a fabric-less process keeps
+    its exposition byte-identical."""
+    out: List = []
+    try:
+        from .. import fabric
+        from ..fabric import replicate
+        st = replicate.stats()
+        if fabric.fabric_enabled() or st.get("rounds"):
+            out.append(_g("gsky_fabric_replica_pages",
+                          "Pages this node holds (or is due to hold) "
+                          "under the popularity-weighted replication "
+                          "plan.",
+                          [({}, float(st.get("replica_pages", 0)))]))
+    except Exception:
+        # scrape-time collectors must never break /metrics
+        pass
+    return out
+
+
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
             _collect_runtime, _collect_batcher, _collect_overload,
             _collect_ingest, _collect_device, _collect_waves,
-            _collect_mesh, _collect_tsan):
+            _collect_mesh, _collect_tsan, _collect_fabric):
     _REG.register_collector(_fn)
 
 

@@ -84,6 +84,25 @@ def _stage(pool, scene, ij, slot):
                                         (slot, zero, zero))
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _stage_ready(pool, page, slot):
+    """Write an already-cut (PR, PC) page into pool[slot] in place —
+    the fabric peer-fill path, where the page arrives as bytes and
+    there is no host scene to slice from."""
+    zero = jnp.zeros((), slot.dtype)
+    return jax.lax.dynamic_update_slice(
+        pool, page.astype(jnp.float32)[None], (slot, zero, zero))
+
+
+def _note_fill(source: str) -> None:
+    """gsky_fabric_page_fills_total{source=peer|cold} breadcrumb."""
+    try:
+        from ..obs.metrics import FABRIC_PAGE_FILLS
+        FABRIC_PAGE_FILLS.labels(source=source).inc()
+    except Exception:  # metrics are best-effort on the staging path
+        pass
+
+
 class PagePool:
     """Device-resident page pool + LRU page table.  Thread-safe; see
     the module docstring for the lock/pin coherence rules."""
@@ -117,6 +136,7 @@ class PagePool:
         self.trimmed = 0
         self.rehydrated = 0
         self.quarantined = 0
+        self.peer_filled = 0   # pages staged from fabric peers
         from ..obs import tsan
         if tsan.enabled():
             # lockset tracking across staging / dispatch / teardown
@@ -178,6 +198,7 @@ class PagePool:
                                 jnp.int32(slot))
         self._slots[key] = slot
         self.staged += 1
+        _note_fill("cold")
         from ..device_guard import (guard_enabled, journal,
                                     pool_audit_enabled)
         if guard_enabled():
@@ -228,6 +249,58 @@ class PagePool:
                     self._pins[s] = self._pins.get(s, 0) + 1
                     slots.append(s)
         return np.asarray(slots, np.int32)
+
+    def stage_page(self, serial: int, pi: int, pj: int, page) -> bool:
+        """Stage one already-cut page delivered by a fabric peer
+        (`fabric/pagerpc.py`): no host scene involved, the bytes ARE
+        the page.  Shape must match the pool's page grid exactly —
+        content keys only make sense between pools cut the same way.
+        Returns False on shape mismatch or a full/pinned pool."""
+        arr = np.asarray(page, np.float32)
+        if arr.shape != (self.page_rows, self.page_cols):
+            return False
+        key = (int(serial), int(pi), int(pj))
+        with self.lock:
+            if key in self._slots:
+                return True          # already resident: nothing to do
+            slot = self._take_slot()
+            if slot is None:
+                self.declined += 1
+                return False
+            self._ensure_pool()
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                self._pool = _stage_ready(self._pool, jnp.asarray(arr),
+                                          jnp.int32(slot))
+            self._slots[key] = slot
+            self.staged += 1
+            self.peer_filled += 1
+            from ..device_guard import (guard_enabled, journal,
+                                        pool_audit_enabled)
+            if guard_enabled():
+                journal.record_stage(*key, chip=self.chip)
+                if pool_audit_enabled():
+                    self._checksums[key] = zlib.crc32(
+                        np.asarray(self._pool[slot]).tobytes())
+        _note_fill("peer")
+        return True
+
+    def has_page(self, serial: int, pi: int, pj: int) -> bool:
+        """Residency probe (no LRU touch, no heat)."""
+        with self.lock:
+            return (int(serial), int(pi), int(pj)) in self._slots
+
+    def read_page(self, serial: int, pi: int, pj: int):
+        """Read a resident page back to host for a peer (the serving
+        half of the page-fetch RPC).  Passive: no LRU touch, no heat —
+        a peer's warm-up must not distort local eviction order.
+        Returns a (PR, PC) float32 ndarray or None when not resident."""
+        key = (int(serial), int(pi), int(pj))
+        with self.lock:
+            slot = self._slots.get(key)
+            if slot is None or self._pool is None:
+                return None
+            return np.asarray(self._pool[slot])
 
     def prewarm(self, dev, serial: int, i0: int, i1: int,
                 j0: int, j1: int) -> bool:
@@ -322,14 +395,30 @@ class PagePool:
         entries = journal.replay()
         if not entries:
             return 0
+        restored = 0
+        try:
+            from .. import fabric
+            if fabric.pages_enabled():
+                # ask ring-adjacent peers for the hot set first: peer
+                # HBM/host memory beats re-decoding from storage, and
+                # whatever peers can't serve falls through to the
+                # scene-cache loop below
+                from ..fabric import pagerpc
+                restored += pagerpc.fill_from_peers(self, entries)
+        except Exception:  # fabric is best-effort; recovery continues
+            pass
         try:
             from .scene_cache import default_scene_cache as sc
             with sc._lock:
                 scenes = {s.serial: s.dev for s in sc._scenes.values()}
         except Exception:
-            return 0
-        restored = 0
+            with self.lock:
+                self.rehydrated += restored
+            return restored
         for serial, pi, pj in entries:
+            with self.lock:
+                if (serial, pi, pj) in self._slots:
+                    continue        # already peer-filled above
             dev = scenes.get(serial)
             if dev is None:
                 continue            # stale: scene evicted since
@@ -420,6 +509,7 @@ class PagePool:
                 "trimmed": self.trimmed,
                 "rehydrated": self.rehydrated,
                 "quarantined": self.quarantined,
+                "peer_filled": self.peer_filled,
                 "pool_bytes": (self.capacity * self.page_rows
                                * self.page_cols * 4),
             }

@@ -88,13 +88,14 @@ from .params import (OWSError, infer_service, normalise_query, parse_wcs,
 
 
 _GATEWAY_DEFAULT = object()     # sentinel: None means "no gateway"
+_FABRIC_DEFAULT = object()      # sentinel: None means "no fabric"
 
 
 class OWSServer:
     def __init__(self, watcher: ConfigWatcher, mas_factory=None,
                  metrics: Optional[MetricsLogger] = None,
                  static_dir: str = "", temp_dir: str = "",
-                 gateway=_GATEWAY_DEFAULT):
+                 gateway=_GATEWAY_DEFAULT, fabric=_FABRIC_DEFAULT):
         self.watcher = watcher
         self.mas_factory = mas_factory
         self.metrics = metrics or MetricsLogger()
@@ -112,6 +113,17 @@ class OWSServer:
         # graceful drain (SIGTERM): the accept gate for /ows requests —
         # /debug keeps answering so operators can watch the drain land
         self.drain = DrainController("ows")
+        # cache fabric (docs/FABRIC.md): peer replay of encoded
+        # responses across gateways.  Default: built from env when the
+        # master gate + peer list are set; explicit instances let the
+        # soak run several in-process gateways with distinct rings.
+        if fabric is _FABRIC_DEFAULT:
+            from .. import fabric as _fabric_mod
+            from ..fabric.replay import default_fabric
+            self.fabric = default_fabric() \
+                if _fabric_mod.fabric_enabled() else None
+        else:
+            self.fabric = fabric
         if self.gateway is not None:
             _register_gateway_invalidation(watcher, self.gateway)
 
@@ -201,6 +213,11 @@ class OWSServer:
             inm = request.headers.get("If-None-Match", "")
             if inm and _etag_match(inm, ent.etag):
                 return web.Response(status=304, headers=headers)
+        if cache_status == "peer" and brownout_level():
+            # peer-replayed under local brownout: serve the bytes but
+            # keep downstream caches from retaining a degraded-mode
+            # response (docs/FABRIC.md failure semantics)
+            headers["Cache-Control"] = "no-store"
         for k, v in ent.headers:
             headers[k] = v
         return web.Response(body=ent.body, status=ent.status,
@@ -229,6 +246,18 @@ class OWSServer:
         if ent is not None:
             collector.info["response_cache"] = "hit"
             return self._replay(request, ent, "hit")
+        if self.fabric is not None:
+            # fabric peer replay (docs/FABRIC.md): a non-owner asks the
+            # key's owner gateway for the encoded bytes before paying a
+            # render.  fetch() never raises — any peer failure just
+            # falls through to the local render below.
+            with obs.span("gateway.fabric") as psp:
+                pent = await self.fabric.fetch(key)
+                psp.set(hit=pent is not None)
+            if pent is not None:
+                gw.cache.put(key, pent)
+                collector.info["response_cache"] = "peer"
+                return self._replay(request, pent, "peer")
 
         async def flight_fn():
             t0, pc0 = time.time(), time.perf_counter()
@@ -283,6 +312,9 @@ class OWSServer:
         app.router.add_get("/debug/trace/{trace_id}",
                            self._debug_trace_one)
         app.router.add_get("/metrics", self._metrics)
+        # cache-fabric peer endpoint: fully-encoded entry bytes for a
+        # canonical key, served gateway-to-gateway (docs/FABRIC.md)
+        app.router.add_get("/fabric/replay", self._fabric_replay)
         app.router.add_route("*", "/ows/{namespace:.*}", self.handle)
         if self.static_dir and os.path.isdir(self.static_dir):
             app.router.add_get("/", self._index)
@@ -376,12 +408,40 @@ class OWSServer:
             pass
         if self.gateway is not None:
             doc["serving"] = self.gateway.stats()
+        try:
+            from .. import fabric as _fabric_mod
+            if self.fabric is not None or _fabric_mod.fabric_enabled():
+                doc["fabric"] = _fabric_mod.fabric_stats(self.fabric)
+        except Exception:  # fabric optional in this build
+            pass
         doc["drain"] = self.drain.stats()
         doc["cancel"] = cancel_stats()
         doc["pressure"] = _pressure.default_monitor().stats()
         from ..obs.tsan import tsan_stats
         doc["tsan"] = tsan_stats()
         return web.json_response(doc)
+
+    async def _fabric_replay(self, request: web.Request) -> web.Response:
+        """Peer endpoint of the gateway replay tier (docs/FABRIC.md):
+        the fully-encoded cache entry for a canonical key, or 404.
+        Serves only FRESH 200 entries — stale and degraded bytes never
+        cross the fabric; under brownout it sheds (peers render
+        locally, this node keeps its cycles for its own clients)."""
+        from .. import fabric as _fabric_mod
+        from ..fabric import replay as _freplay
+        key = request.query.get("key", "")
+        gw = self.gateway
+        if gw is None or not key or not _fabric_mod.replay_enabled():
+            raise web.HTTPNotFound(text="fabric replay unavailable")
+        if brownout_level():
+            raise web.HTTPNotFound(
+                text="brownout", headers={"X-Gsky-Fabric-NoStore": "1"})
+        ent = gw.cache.peek(key)
+        if ent is None or ent.status != 200:
+            raise web.HTTPNotFound(text="miss")
+        headers, body = _freplay.encode_entry(ent)
+        return web.Response(body=body, content_type=ent.content_type,
+                            headers=headers)
 
     async def _metrics(self, request: web.Request) -> web.Response:
         text = await asyncio.to_thread(obs.render_metrics)

@@ -181,6 +181,17 @@ class ResponseCache:
             self.hits += 1
             return ent
 
+    def peek(self, key: str) -> Optional[CachedResponse]:
+        """A fresh entry without touching hit/miss counters or LRU
+        order — fabric peer probes (`fabric/replay.py`) must not
+        distort local cache stats or keep entries artificially warm."""
+        now = time.monotonic()
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None or ent.stale or now >= ent.expires:
+                return None
+            return ent
+
     def get_stale(self, key: str) -> Optional[CachedResponse]:
         """An entry usable for stale-on-error replay: fresh OR expired
         within the stale grace window.  Does not count a hit/miss."""

@@ -694,6 +694,21 @@ class WorkerClient:
             raise RuntimeError(res.error)
         return res.info_json
 
+    def page_fetch(self, keys, max_bytes: Optional[int] = None,
+                   route_key: Optional[str] = None) -> dict:
+        """Batched cache-fabric page fetch (docs/FABRIC.md): ask a
+        worker for content-keyed ``(serial, pi, pj)`` pages; returns
+        ``{key: (PR, PC) float32 page}`` with CRC-failed pages already
+        dropped.  Routed like any other op — a ``route_key`` (e.g. the
+        serialized page key) lands the ask on the ring-preferred node."""
+        from ..fabric import pagerpc
+        task = pb.Task(operation="page_fetch",
+                       path=pagerpc.encode_request(keys, max_bytes))
+        res = self.process(task, route_key=route_key)
+        if res.error:
+            raise RuntimeError(res.error)
+        return pagerpc.decode_result(res.info_json, res.raster)
+
     def close(self):
         """Idempotent shutdown.  The closed flag flips *first*, so any
         dispatch racing the teardown is rejected up front with

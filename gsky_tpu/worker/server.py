@@ -126,6 +126,8 @@ class WorkerService:
                     return self._warp(task, ctx)
                 if op == "drill":
                     return self._drill(task)
+                if op == "page_fetch":
+                    return self._page_fetch(task)
                 if op in ("extent", "info", "decode"):
                     return self.pool.submit(task)
                 return pb.Result(error=f"unknown operation {op!r}")
@@ -166,8 +168,40 @@ class WorkerService:
             info["pool"] = self.pool.stats()
         except Exception:  # pool stats optional in the health probe
             pass
+        try:
+            from ..pipeline import pages
+            if pages._default is not None:
+                # page-pool residency rides the same probe so the soak
+                # (and operators) can see peer fills vs cold stages
+                info["pages"] = pages._default.stats()
+        except Exception:  # no page pool in this build
+            pass
         r.info_json = json.dumps(info)
         return r
+
+    def _page_fetch(self, task: pb.Task) -> pb.Result:
+        """Cache-fabric page RPC (docs/FABRIC.md): read requested
+        resident pages back to host and ship them content-keyed with
+        per-page CRCs.  Refused when the worker page tier is off."""
+        from .. import fabric
+        if not fabric.pages_enabled():
+            return pb.Result(error="fabric: page peering disabled")
+        from ..fabric import pagerpc
+        from ..pipeline import pages
+        res = pb.Result()
+        pool = pages._default
+        try:
+            doc = json.loads(task.path or "{}")
+        except ValueError:
+            return pb.Result(error="fabric: malformed page_fetch request")
+        if pool is None:
+            res.info_json = json.dumps(
+                {"v": 1, "page_shape": [0, 0], "pages": []})
+            return res
+        manifest, blob = pagerpc.serve_page_fetch(pool, doc)
+        res.raster = blob
+        res.info_json = json.dumps(manifest)
+        return res
 
     def _warp(self, task: pb.Task, ctx=None) -> pb.Result:
         from ..geo.crs import parse_crs
@@ -379,6 +413,27 @@ def main(argv=None):
     server.start()
     log.info("gsky-rpc listening on %s:%d (pool=%d)",
              a.host, a.port, svc.pool.size)
+
+    try:
+        from .. import fabric
+        if fabric.pages_enabled() and fabric.page_peer_addrs():
+            # cache-fabric warm boot (docs/FABRIC.md): pull the
+            # journal's hot set from ring-adjacent peers instead of
+            # cold-staging it request by request.  Backgrounded: the
+            # node serves (and cold-stages) normally while it warms.
+            from ..pipeline.pages import default_page_pool
+
+            def _warm_boot():
+                try:
+                    n = default_page_pool().rehydrate()
+                    log.info("fabric: warm boot restored %d pages", n)
+                except Exception:
+                    log.exception("fabric: warm boot failed")
+
+            threading.Thread(target=_warm_boot, daemon=True,
+                             name="gsky-fabric-warm").start()
+    except Exception:  # fabric optional; a worker must boot without it
+        log.exception("fabric: warm boot setup failed")
 
     # graceful drain: SIGTERM/SIGINT closes the accept gate (new ops
     # answer "draining:", worker_info keeps answering with the draining

@@ -1,0 +1,556 @@
+"""Cache-fabric tests (docs/FABRIC.md): replay ring ownership across
+generation bumps, the peer-replay fetch fallback matrix, the batched
+page RPC round trip + content-key integrity, heat-ordered peer fills,
+popularity-weighted replication math, and the `GSKY_FABRIC=0`
+byte-identity escape hatch through the real OWS server.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from gsky_tpu import fabric
+from gsky_tpu import resilience
+from gsky_tpu.device_guard import journal
+from gsky_tpu.fabric import pagerpc, replicate
+from gsky_tpu.fabric.replay import (ReplayFabric, encode_entry,
+                                    entry_from_response)
+from gsky_tpu.fleet.ring import HashRing
+from gsky_tpu.pipeline.pages import PagePool
+from gsky_tpu.resilience import deadline_scope, get_breaker
+from gsky_tpu.serving.response_cache import make_entry
+
+from fixtures import make_archive
+
+A, B, C = "http://gw-a:80", "http://gw-b:80", "http://gw-c:80"
+
+
+@pytest.fixture(autouse=True)
+def _fabric_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("GSKY_FABRIC", "1")
+    monkeypatch.setenv("GSKY_POOL_JOURNAL",
+                       str(tmp_path / "journal.jsonl"))
+    resilience.reset()
+    replicate.reset_stats()
+    yield
+    resilience.reset()
+
+
+def _entry(body=b"not-actually-png", max_age=300):
+    return make_entry(body, "image/png", 200, "ns", "landsat",
+                      "fp0123", max_age)
+
+
+def _keys_owned_by(fab, owner, n=3, prefix="k"):
+    out = []
+    i = 0
+    while len(out) < n:
+        k = f"{prefix}{i}"
+        if fab.owner(k) == owner:
+            out.append(k)
+        i += 1
+    return out
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestOwnership:
+    def test_owner_is_deterministic_and_on_ring(self):
+        fab = ReplayFabric(A, [B, C])
+        for i in range(50):
+            k = f"key{i}"
+            assert fab.owner(k) == fab.owner(k)
+            assert fab.owner(k) in (A, B, C)
+        # all three members own something under vnode spreading
+        owners = {fab.owner(f"key{i}") for i in range(200)}
+        assert owners == {A, B, C}
+
+    def test_generation_bump_rehomes_dead_members_keys(self):
+        fab = ReplayFabric(A, [B, C])
+        keys = [f"key{i}" for i in range(200)]
+        before = {k: fab.owner(k) for k in keys}
+        gen0 = fab.ring.generation
+        fab.set_peers([B])            # C leaves the fleet
+        assert fab.ring.generation == gen0 + 1
+        after = {k: fab.owner(k) for k in keys}
+        assert set(after.values()) <= {A, B}
+        # consistent hashing: only the dead member's keys moved
+        for k in keys:
+            if before[k] != C:
+                assert after[k] == before[k]
+        # no-op membership change: no generation bump
+        fab.set_peers([B])
+        assert fab.ring.generation == gen0 + 1
+
+    def test_candidates_exclude_self_and_bound_attempts(self):
+        fab = ReplayFabric(A, [B, C], max_attempts=2)
+        for i in range(50):
+            cand = fab.candidates(f"key{i}")
+            assert A not in cand
+            assert 1 <= len(cand) <= 2
+
+
+class TestReplayFetchMatrix:
+    """Each fetch outcome, with an injected transport (no sockets)."""
+
+    def _fab(self, transport, **kw):
+        return ReplayFabric(A, [B, C], transport=transport, **kw)
+
+    def test_hit_replays_validated_bytes(self):
+        ent = _entry()
+        calls = []
+
+        def transport(url, timeout):
+            calls.append(url)
+            headers, body = encode_entry(ent)
+            headers["Content-Type"] = "image/png"
+            return 200, headers, body
+
+        fab = self._fab(transport)
+        key = _keys_owned_by(fab, B, 1)[0]
+        got = run(fab.fetch(key))
+        assert got is not None and got.body == ent.body
+        assert got.etag == ent.etag
+        assert got.content_type == "image/png"
+        assert calls and f"/fabric/replay?key={key}" in calls[0]
+        assert fab.outcomes.get("hit") == 1
+
+    def test_owner_misses_locally_without_probing(self):
+        def transport(url, timeout):   # pragma: no cover - must not run
+            raise AssertionError("owner must not probe peers")
+
+        fab = self._fab(transport)
+        key = _keys_owned_by(fab, A, 1)[0]
+        assert run(fab.fetch(key)) is None
+        assert fab.outcomes.get("owner_local") == 1
+
+    def test_peer_404_is_a_miss(self):
+        fab = self._fab(lambda url, t: (404, {}, b""))
+        key = _keys_owned_by(fab, B, 1)[0]
+        assert run(fab.fetch(key)) is None
+        assert fab.outcomes.get("miss") == 1
+
+    def test_exhausted_deadline_never_probes(self):
+        def transport(url, timeout):   # pragma: no cover - must not run
+            raise AssertionError("no budget, no probe")
+
+        fab = self._fab(transport)
+        key = _keys_owned_by(fab, B, 1)[0]
+
+        async def go():
+            with deadline_scope(0.0):
+                return await fab.fetch(key)
+        assert run(go()) is None
+        assert fab.outcomes.get("deadline") == 1
+
+    def test_transport_error_counts_and_falls_back(self):
+        def transport(url, timeout):
+            raise OSError("connection refused")
+
+        fab = self._fab(transport)
+        key = _keys_owned_by(fab, B, 1)[0]
+        assert run(fab.fetch(key)) is None
+        assert fab.outcomes.get("error", 0) >= 1
+        assert fab.outcomes.get("miss") == 1   # overall result: miss
+
+    def test_open_breaker_skips_the_peer(self):
+        calls = []
+
+        def transport(url, timeout):
+            calls.append(url)
+            raise OSError("down")
+
+        fab = self._fab(transport, max_attempts=1)
+        key = _keys_owned_by(fab, B, 1)[0]
+        peer = fab.candidates(key)[0]
+        brk = get_breaker(f"fabric:{peer}")
+        while brk.allow():            # drive it open
+            brk.record_failure()
+        n0 = len(calls)
+        assert run(fab.fetch(key)) is None
+        assert len(calls) == n0       # breaker short-circuited
+        assert fab.outcomes.get("breaker_open", 0) >= 1
+
+    def test_disabled_tier_is_dormant(self, monkeypatch):
+        monkeypatch.setenv("GSKY_FABRIC_REPLAY", "0")
+
+        def transport(url, timeout):   # pragma: no cover - must not run
+            raise AssertionError("disabled tier must not probe")
+
+        fab = self._fab(transport)
+        key = _keys_owned_by(fab, B, 1)[0]
+        assert run(fab.fetch(key)) is None
+        assert fab.outcomes.get("disabled") == 1
+
+    def test_singleflight_dedups_concurrent_fetches(self):
+        ent = _entry()
+        calls = []
+
+        def transport(url, timeout):
+            calls.append(url)
+            time.sleep(0.05)
+            return (200, dict(encode_entry(ent)[0],
+                              **{"Content-Type": "image/png"}),
+                    ent.body)
+
+        fab = self._fab(transport)
+        key = _keys_owned_by(fab, B, 1)[0]
+
+        async def go():
+            return await asyncio.gather(fab.fetch(key), fab.fetch(key),
+                                        fab.fetch(key))
+        got = run(go())
+        assert all(g is not None for g in got)
+        assert len(calls) == 1
+
+
+class TestReplayValidators:
+    def test_corrupted_body_rejected_by_etag(self):
+        ent = _entry()
+        headers, body = encode_entry(ent)
+        headers["Content-Type"] = "image/png"
+        assert entry_from_response(200, headers, body) is not None
+        assert entry_from_response(200, headers,
+                                   body[:-1] + b"X") is None
+
+    def test_age_consumes_remaining_ttl(self):
+        ent = _entry(max_age=300)
+        headers, body = encode_entry(ent)
+        headers["Content-Type"] = "image/png"
+        headers["X-Gsky-Fabric-Age"] = "100"
+        got = entry_from_response(200, headers, body)
+        remaining = got.expires - time.monotonic()
+        assert 195 < remaining <= 200
+        # fully aged out: unusable
+        headers["X-Gsky-Fabric-Age"] = "300"
+        assert entry_from_response(200, headers, body) is None
+
+    def test_nostore_and_non200_rejected(self):
+        ent = _entry()
+        headers, body = encode_entry(ent)
+        headers["Content-Type"] = "image/png"
+        assert entry_from_response(
+            200, dict(headers, **{"X-Gsky-Fabric-NoStore": "1"}),
+            body) is None
+        assert entry_from_response(404, headers, body) is None
+        bad = dict(headers, **{"X-Gsky-Fabric-Status": "503"})
+        assert entry_from_response(200, bad, body) is None
+
+
+def _page(v, pr=4, pc=4):
+    return np.full((pr, pc), float(v), np.float32)
+
+
+class TestPageRPC:
+    def _pool(self):
+        return PagePool(capacity=8, page_rows=4, page_cols=4)
+
+    def test_batch_round_trip(self):
+        pool = self._pool()
+        for i, key in enumerate([(7, 0, 0), (7, 0, 1), (9, 2, 3)]):
+            assert pool.stage_page(*key, _page(i + 1))
+        doc = json.loads(pagerpc.encode_request(
+            [(7, 0, 0), (7, 0, 1), (9, 2, 3), (1, 1, 1)]))
+        manifest, blob = pagerpc.serve_page_fetch(pool, doc)
+        got = pagerpc.decode_result(json.dumps(manifest), blob)
+        assert set(got) == {(7, 0, 0), (7, 0, 1), (9, 2, 3)}
+        assert got[(7, 0, 1)][0, 0] == 2.0
+        assert got[(9, 2, 3)].shape == (4, 4)
+
+    def test_crc_integrity_drops_corrupted_page(self):
+        pool = self._pool()
+        pool.stage_page(7, 0, 0, _page(1))
+        pool.stage_page(7, 0, 1, _page(2))
+        manifest, blob = pagerpc.serve_page_fetch(
+            pool, {"pages": [[7, 0, 0], [7, 0, 1]]})
+        # flip one byte inside the first page's extent
+        blob = b"\xff" + blob[1:]
+        got = pagerpc.decode_result(json.dumps(manifest), blob)
+        assert (7, 0, 0) not in got          # corrupted: dropped
+        assert (7, 0, 1) in got              # intact: survives
+        assert pagerpc.stats()["integrity_drops"] >= 1
+
+    def test_serve_honours_byte_budget(self):
+        pool = self._pool()
+        for pj in range(4):
+            pool.stage_page(7, 0, pj, _page(pj))
+        manifest, blob = pagerpc.serve_page_fetch(
+            pool, {"pages": [[7, 0, j] for j in range(4)],
+                   "max_bytes": 2 * 4 * 4 * 4})
+        assert len(manifest["pages"]) == 2   # hottest-first truncation
+        assert len(blob) == 2 * 4 * 4 * 4
+
+    def test_stage_page_rejects_shape_mismatch(self):
+        pool = self._pool()
+        assert not pool.stage_page(7, 0, 0, np.zeros((8, 8), np.float32))
+        assert pool.stage_page(7, 0, 0, _page(1))
+        # idempotent: re-staging a resident key is a no-op success
+        assert pool.stage_page(7, 0, 0, _page(9))
+        assert pool.read_page(7, 0, 0)[0, 0] == 1.0
+
+
+class TestHeatOrderedFill:
+    def test_fill_requests_hottest_first_and_stages(self):
+        journal.record_stage(7, 0, 0)
+        journal.record_heat(7, 0, 0, hits=2)
+        journal.record_stage(8, 1, 1)
+        journal.record_heat(8, 1, 1, hits=9)
+        journal.record_stage(9, 0, 1)
+        entries = journal.replay()
+        assert entries[0] == (8, 1, 1)       # hottest first
+        pool = PagePool(capacity=8, page_rows=4, page_cols=4)
+        asked = []
+
+        def fake_fetch(peer, keys, max_bytes, timeout):
+            asked.extend(keys)
+            return {k: _page(1) for k in keys}
+
+        n = pagerpc.fill_from_peers(pool, entries, peers=["w1:1"],
+                                    fetch=fake_fetch)
+        assert n == 3
+        assert asked[0] == (8, 1, 1)         # order preserved per peer
+        assert pool.peer_filled == 3
+        assert pool.stats()["peer_filled"] == 3
+
+    def test_second_ring_candidate_covers_first_round_misses(self):
+        journal.record_stage(7, 0, 0)
+        journal.record_stage(8, 1, 1)
+        entries = journal.replay()
+        pool = PagePool(capacity=8, page_rows=4, page_cols=4)
+        peers = ["w1:1", "w2:1"]
+        holder = {"w2:1"}                    # only w2 has the pages
+
+        def fake_fetch(peer, keys, max_bytes, timeout):
+            if peer not in holder:
+                return {}
+            return {k: _page(1) for k in keys}
+
+        n = pagerpc.fill_from_peers(pool, entries, peers=peers,
+                                    fetch=fake_fetch)
+        assert n == 2                        # second round found them
+
+    def test_rehydrate_uses_peer_fill_when_enabled(self, monkeypatch):
+        journal.record_stage(7, 0, 0)
+        journal.record_heat(7, 0, 0, hits=5)
+        monkeypatch.setenv("GSKY_FABRIC_PAGE_PEERS", "w1:1")
+        monkeypatch.setattr(
+            pagerpc, "_grpc_fetch",
+            lambda peer, keys, mb, t: {k: _page(3) for k in keys})
+        pool = PagePool(capacity=8, page_rows=4, page_cols=4)
+        assert pool.rehydrate() == 1
+        assert pool.read_page(7, 0, 0)[0, 0] == 3.0
+        assert pool.peer_filled == 1
+
+    def test_fabric_off_rehydrate_never_touches_peers(self, monkeypatch):
+        journal.record_stage(7, 0, 0)
+        monkeypatch.setenv("GSKY_FABRIC", "0")
+        monkeypatch.setenv("GSKY_FABRIC_PAGE_PEERS", "w1:1")
+
+        def boom(*a, **k):   # pragma: no cover - must not run
+            raise AssertionError("fabric off: no peer RPC")
+        monkeypatch.setattr(pagerpc, "fill_from_peers", boom)
+        pool = PagePool(capacity=8, page_rows=4, page_cols=4)
+        pool.rehydrate()     # scene cache is empty: restores nothing
+        assert pool.peer_filled == 0
+
+
+class TestReplication:
+    def test_replicas_for_scales_with_popularity(self):
+        assert replicate.replicas_for(10.0, 10.0, 3) == 3
+        assert replicate.replicas_for(5.0, 10.0, 3) == 2
+        assert replicate.replicas_for(0.0, 10.0, 3) == 1
+        assert replicate.replicas_for(10.0, 10.0, 1) == 1
+        assert replicate.replicas_for(1.0, 0.0, 3) == 1
+
+    def test_targets_are_the_preference_walk(self):
+        nodes = ["w1:1", "w2:1", "w3:1"]
+        ring = HashRing(sorted(nodes), vnodes=32)
+        key = (7, 0, 0)
+        t2 = replicate.replication_targets(ring, key, 2)
+        assert t2 == ring.preference(json.dumps([7, 0, 0]), 2)
+        assert len(set(t2)) == 2
+
+    def test_plan_places_each_key_on_exactly_its_replica_set(
+            self, monkeypatch):
+        monkeypatch.setenv("GSKY_FABRIC_REPLICAS", "2")
+        nodes = ["w1:1", "w2:1", "w3:1"]
+        scored = [(s, 0, 0, float(10 - s)) for s in range(8)]
+        plans = {n: replicate.plan(scored, nodes, n) for n in nodes}
+        ring = HashRing(sorted(nodes), vnodes=32)
+        top = max(sc for _, _, _, sc in scored)
+        for serial, pi, pj, sc in scored:
+            key = (serial, pi, pj)
+            r = replicate.replicas_for(sc, top, 2)
+            want = set(replicate.replication_targets(ring, key, r))
+            got = {n for n in nodes if key in plans[n]}
+            assert got == want and len(want) == r
+
+    def test_replicate_to_pool_pulls_missing_replicas(self, monkeypatch):
+        monkeypatch.setenv("GSKY_FABRIC_REPLICAS", "2")
+        journal.record_stage(7, 0, 0)
+        journal.record_heat(7, 0, 0, hits=9)
+        journal.record_stage(8, 0, 0)
+        pool = PagePool(capacity=8, page_rows=4, page_cols=4)
+        self_node = "wSELF:1"
+        peers = ["w2:1", "w3:1"]
+
+        def fake_fetch(peer, keys, max_bytes, timeout):
+            return {k: _page(4) for k in keys}
+
+        filled = replicate.replicate_to_pool(pool, self_node,
+                                             peers=peers,
+                                             fetch=fake_fetch)
+        st = replicate.stats()
+        assert st["rounds"] == 1
+        assert st["replica_pages"] == filled + 0
+        wanted = replicate.plan(
+            journal.replay_scored(),
+            sorted({self_node, *peers}), self_node)
+        assert filled == len(wanted)
+        for k in wanted:
+            assert pool.has_page(*k)
+
+    def test_replicate_disabled_is_dormant(self, monkeypatch):
+        monkeypatch.setenv("GSKY_FABRIC_REPLICATE", "0")
+        journal.record_stage(7, 0, 0)
+        pool = PagePool(capacity=8, page_rows=4, page_cols=4)
+        assert replicate.replicate_to_pool(pool, "w1:1",
+                                           peers=["w2:1"]) == 0
+        assert replicate.stats()["rounds"] == 0
+
+
+DATE = "2020-01-10T00:00:00.000Z"
+BBOX = "16478548,-4211230,16489679,-4198025"
+
+
+@pytest.fixture(scope="module")
+def arch(tmp_path_factory):
+    return make_archive(str(tmp_path_factory.mktemp("fab") / "data"))
+
+
+def _make_server(tmp_path, arch, name, fabric_obj=None):
+    from gsky_tpu.index import MASClient
+    from gsky_tpu.server.config import ConfigWatcher
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+    from gsky_tpu.serving import ServingGateway
+    conf = tmp_path / f"conf-{name}"
+    conf.mkdir()
+    config = {"service_config": {"ows_hostname": "",
+                                 "mas_address": "inproc"},
+              "layers": [{"name": "landsat", "title": "L",
+                          "data_source": arch["root"],
+                          "rgb_products": ["LC08_20200110_T1"],
+                          "dates": [DATE]}]}
+    (conf / "config.json").write_text(json.dumps(config))
+    mas = MASClient(arch["store"])
+    watcher = ConfigWatcher(str(conf), mas_factory=lambda a: mas,
+                            install_signal=False)
+    return OWSServer(watcher, mas_factory=lambda a: mas,
+                     metrics=MetricsLogger(), gateway=ServingGateway(),
+                     fabric=fabric_obj)
+
+
+def _getmap():
+    return (f"/ows?service=WMS&request=GetMap&version=1.3.0"
+            f"&layers=landsat&crs=EPSG:3857&bbox={BBOX}"
+            f"&width=64&height=64&format=image/png&time={DATE}")
+
+
+class TestFabricThroughServer:
+    def test_peer_replay_end_to_end(self, tmp_path, arch, monkeypatch):
+        """Two in-process gateways: A renders and caches, B replays
+        A's bytes over the real /fabric/replay endpoint."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        server_a = _make_server(tmp_path, arch, "a")
+
+        async def go():
+            client_a = TestClient(TestServer(server_a.app()))
+            await client_a.start_server()
+            a_url = f"http://127.0.0.1:{client_a.port}"
+            fab = ReplayFabric(f"http://127.0.0.1:9/b", [a_url])
+            # pin ownership so the test is deterministic: B never owns
+            fab.is_owner = lambda key: False
+            server_b = _make_server(tmp_path, arch, "b",
+                                    fabric_obj=fab)
+            client_b = TestClient(TestServer(server_b.app()))
+            await client_b.start_server()
+            try:
+                ra = await client_a.get(_getmap())
+                body_a = await ra.read()
+                assert ra.status == 200
+                assert ra.headers["X-Gsky-Cache"] == "miss"
+
+                rb = await client_b.get(_getmap())
+                body_b = await rb.read()
+                assert rb.status == 200
+                assert rb.headers["X-Gsky-Cache"] == "peer"
+                assert body_b == body_a
+                assert "Age" in rb.headers
+
+                # the peer entry is now cached locally on B
+                rb2 = await client_b.get(_getmap())
+                assert rb2.headers["X-Gsky-Cache"] == "hit"
+                assert (await rb2.read()) == body_a
+
+                # raw peer endpoint: a bogus key is a 404, not a 500
+                r404 = await client_a.get(
+                    "/fabric/replay?key=deadbeef")
+                assert r404.status == 404
+                return fab.stats()
+            finally:
+                await client_b.close()
+                await client_a.close()
+
+        st = asyncio.new_event_loop().run_until_complete(go())
+        assert st["outcomes"].get("hit") == 1
+        assert st["peer_ewma_ms"]
+
+    def test_fabric_off_is_byte_identical(self, tmp_path, arch,
+                                          monkeypatch):
+        """GSKY_FABRIC=0: a server handed a live fabric object serves
+        byte-identical responses to a fabric-less server, and never
+        probes a peer."""
+        monkeypatch.setenv("GSKY_FABRIC", "0")
+
+        def boom(url, timeout):   # pragma: no cover - must not run
+            raise AssertionError("GSKY_FABRIC=0 must not probe peers")
+
+        fab = ReplayFabric(A, [B], transport=boom)
+        fab.is_owner = lambda key: False
+        server_off = _make_server(tmp_path, arch, "off", fabric_obj=fab)
+        server_ref = _make_server(tmp_path, arch, "ref")
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def render(server):
+            client = TestClient(TestServer(server.app()))
+            await client.start_server()
+            try:
+                r = await client.get(_getmap())
+                return r.status, await r.read()
+            finally:
+                await client.close()
+
+        loop = asyncio.new_event_loop()
+        s_off, b_off = loop.run_until_complete(render(server_off))
+        s_ref, b_ref = loop.run_until_complete(render(server_ref))
+        assert (s_off, b_off) == (s_ref, b_ref) == (200, b_ref)
+        assert fab.outcomes.get("disabled") == 1
+
+    def test_env_default_builds_no_fabric(self, tmp_path, arch,
+                                          monkeypatch):
+        monkeypatch.delenv("GSKY_FABRIC", raising=False)
+        server = _make_server(tmp_path, arch, "plain",
+                              fabric_obj=None)
+        assert server.fabric is None
+        # and with the gate on but no peers configured: still None
+        monkeypatch.setenv("GSKY_FABRIC", "1")
+        from gsky_tpu.fabric.replay import default_fabric
+        assert default_fabric() is None

@@ -435,7 +435,7 @@ class TestDatelineSplit:
         assert both["files"]
 
 
-class TestResponseCache:
+class TestMasQueryCache:
     """masapi response caching (`mas/api/api.go:43-52`) — LRU keyed on
     the canonical query, invalidated by ingest generation."""
 
@@ -457,8 +457,8 @@ class TestResponseCache:
         return asyncio.new_event_loop().run_until_complete(go())
 
     def test_hit_and_invalidate(self, archive):
-        from gsky_tpu.index.api import ResponseCache, build_app
-        cache = ResponseCache()
+        from gsky_tpu.index.api import MasQueryCache, build_app
+        cache = MasQueryCache()
         app = build_app(archive["store"], cache)
         url = ("/?intersects&metadata=gdal&srs=EPSG:4326"
                "&wkt=POLYGON((148 -36,149 -36,149 -35,148 -35,148 -36))")
@@ -484,8 +484,8 @@ class TestResponseCache:
         self._run(app, scenario)
 
     def test_errors_not_cached(self, archive):
-        from gsky_tpu.index.api import ResponseCache, build_app
-        cache = ResponseCache()
+        from gsky_tpu.index.api import MasQueryCache, build_app
+        cache = MasQueryCache()
         app = build_app(archive["store"], cache)
 
         async def scenario(get):

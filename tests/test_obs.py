@@ -324,6 +324,48 @@ def test_plan_families_render_parse_roundtrip():
         - val(base, rt, rt, (("path", "bucketed"),)) == 2.0
 
 
+def test_fabric_families_render_parse_roundtrip(monkeypatch):
+    """The cache-fabric families — outcome-labelled replay counter,
+    source-labelled page-fill counter, and the replication gauge —
+    must round-trip the strict parser.  The gauge only renders with
+    the fabric on (or after a replication round), keeping fabric-less
+    exposition byte-identical."""
+    from gsky_tpu.fabric import replicate
+    from gsky_tpu.obs.metrics import (FABRIC_PAGE_FILLS, FABRIC_REPLAY,
+                                      render_metrics)
+    base = parse_exposition(render_metrics())
+    for fam in ("gsky_fabric_replay_total",
+                "gsky_fabric_page_fills_total"):
+        assert base[fam]["type"] == "counter"
+    assert "gsky_fabric_replica_pages" not in base  # fabric off: absent
+
+    def val(fams, fam, name, labels=()):
+        if fam not in fams:
+            return 0.0
+        return fams[fam]["samples"].get((name, labels), 0.0)
+
+    monkeypatch.setenv("GSKY_FABRIC", "1")
+    FABRIC_REPLAY.labels(outcome="hit").inc()
+    FABRIC_REPLAY.labels(outcome="breaker_open").inc(3)
+    FABRIC_PAGE_FILLS.labels(source="peer").inc(2)
+    FABRIC_PAGE_FILLS.labels(source="cold").inc()
+    fams = parse_exposition(render_metrics())
+    rp = "gsky_fabric_replay_total"
+    assert val(fams, rp, rp, (("outcome", "hit"),)) \
+        - val(base, rp, rp, (("outcome", "hit"),)) == 1.0
+    assert val(fams, rp, rp, (("outcome", "breaker_open"),)) \
+        - val(base, rp, rp, (("outcome", "breaker_open"),)) == 3.0
+    pf = "gsky_fabric_page_fills_total"
+    assert val(fams, pf, pf, (("source", "peer"),)) \
+        - val(base, pf, pf, (("source", "peer"),)) == 2.0
+    assert val(fams, pf, pf, (("source", "cold"),)) \
+        - val(base, pf, pf, (("source", "cold"),)) == 1.0
+    rg = "gsky_fabric_replica_pages"
+    assert fams[rg]["type"] == "gauge"
+    assert val(fams, rg, rg) == float(
+        replicate.stats()["replica_pages"])
+
+
 # ---------------------------------------------------------------------------
 # trace context
 

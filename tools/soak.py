@@ -167,6 +167,21 @@ SAME PNG bytes (escape-hatch byte identity), every response a clean
 exposing the ``gsky_plan_*`` families through the strict parser.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario plan --seconds 20
+
+``--scenario fabric``: cache fabric (docs/FABRIC.md).  Two gateway
+replicas (each with a private response cache, joined by the replay
+ring) in front of three worker-node processes peered for page RPC
+over a shared pool journal.  A Zipf tile storm alternates gateways;
+then one gateway "dies" and is replaced by a cold replica, which must
+serve at least half of the peer-owned hot set by replaying the
+survivor's bytes (``X-Gsky-Cache: peer``) instead of re-rendering;
+one worker is SIGKILLed and respawned, and its warm-boot refill must
+come from page-peer RPC rather than cold staging; a ``GSKY_FABRIC=0``
+leg must be byte-identical to a fabric-less server.  Zero bare 5xx
+throughout, and /metrics must round-trip the strict parser with the
+fabric families present::
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario fabric --seconds 20
 """
 
 from __future__ import annotations
@@ -253,7 +268,8 @@ def _run(argv=None):
     ap.add_argument("--scenario",
                     choices=("churn", "hot", "wcs", "chaos", "burst",
                              "fleet", "overload", "ingest",
-                             "devicechaos", "wave", "mesh", "plan"),
+                             "devicechaos", "wave", "mesh", "plan",
+                             "fabric"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -409,6 +425,8 @@ def _run(argv=None):
         return run_mesh(args, watcher, mas_client, merc, boot)
     if args.scenario == "plan":
         return run_plan(args, watcher, mas_client, merc, boot)
+    if args.scenario == "fabric":
+        return run_fabric(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -2709,6 +2727,365 @@ def run_plan(args, watcher, mas_client, merc, boot) -> int:
             else:
                 os.environ[k] = v
         autoplan.reset_plan_state()
+
+
+def run_fabric(args, watcher, mas_client, merc, boot) -> int:
+    """Cache fabric: two gateway replicas on the replay ring over
+    three page-peered worker nodes; gateway death -> cold replica
+    recovers from the survivor's bytes, worker death -> warm-boot
+    refill from page peers, plus a GSKY_FABRIC=0 byte-identity leg
+    (see module docstring for the pass criteria)."""
+    import socket
+    import subprocess
+    import threading
+
+    import numpy as np
+
+    import grpc
+
+    from gsky_tpu.fabric.replay import ReplayFabric
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+    from gsky_tpu.serving import ServingGateway
+    from gsky_tpu.worker import gskyrpc_pb2 as pb
+    from gsky_tpu.worker.server import METHOD
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    conf_dir = watcher.root
+    data_root = os.path.dirname(conf_dir)
+    journal = os.path.join(data_root, "fabric-journal.jsonl")
+    # gateway-side gates (the gateways run in THIS process); the
+    # explicit ReplayFabric instances below carry the per-replica ring.
+    # The journal + interpret-mode pallas make the in-process paged
+    # pipeline stage pages worth peering (same recipe as devicechaos).
+    os.environ["GSKY_FABRIC"] = "1"
+    os.environ["GSKY_POOL_JOURNAL"] = journal
+    os.environ.setdefault("GSKY_PALLAS", "interpret")
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    procs: dict = {}
+    ports = [free_port() for _ in range(3)]
+    nodes = [f"127.0.0.1:{p}" for p in ports]
+
+    def spawn(port: int, page_peers: str = ""):
+        # every worker shares one journal; page peers are config-driven
+        peers = page_peers or ",".join(
+            n for n in nodes if n != f"127.0.0.1:{port}")
+        e = dict(os.environ, PYTHONPATH=repo,
+                 GSKY_FABRIC="1", GSKY_FABRIC_PAGE_PEERS=peers,
+                 GSKY_POOL_JOURNAL=journal)
+        e.setdefault("JAX_PLATFORMS", "cpu")
+        logf = open(os.path.join(data_root, f"fab-{port}.log"), "ab")
+        procs[port] = subprocess.Popen(
+            [sys.executable, "-m", "gsky_tpu.worker.server",
+             "-p", str(port), "-host", "127.0.0.1",
+             "-n", "1", "-oom_threshold", "0"],
+            env=e, cwd=repo, stdout=logf, stderr=subprocess.STDOUT)
+        logf.close()                     # child holds its own fd
+
+    def stub_for(port: int):
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        return ch, ch.unary_unary(
+            METHOD, request_serializer=pb.Task.SerializeToString,
+            response_deserializer=pb.Result.FromString)
+
+    def wait_ready(port: int, deadline_s: float) -> bool:
+        # fresh channel per attempt: see run_fleet's wait_ready
+        t_end = time.time() + deadline_s
+        while time.time() < t_end:
+            if procs[port].poll() is not None:
+                return False
+            ch, stub = stub_for(port)
+            try:
+                stub(pb.Task(operation="worker_info"), timeout=2.0)
+                return True
+            except Exception:
+                time.sleep(0.5)
+            finally:
+                ch.close()
+        return False
+
+    def pages_stats(port: int) -> dict:
+        ch, stub = stub_for(port)
+        try:
+            res = stub(pb.Task(operation="worker_info"), timeout=5.0)
+            return json.loads(res.info_json or "{}").get("pages", {})
+        except Exception:
+            return {}
+        finally:
+            ch.close()
+
+    try:
+        for p in ports:
+            spawn(p)
+        boot_deadline = time.time() + 600
+        for p in ports:
+            if not wait_ready(p, max(boot_deadline - time.time(), 1.0)):
+                print(json.dumps({"scenario": "fabric",
+                                  "error": f"node :{p} never came up"}))
+                print("SOAK FAILED", flush=True)
+                return 1
+
+        import bench as B
+        ns_dir = os.path.join(conf_dir, "fabric")
+        os.makedirs(ns_dir, exist_ok=True)
+        with open(os.path.join(ns_dir, "config.json"), "w") as fp:
+            json.dump({
+                "service_config": {"ows_hostname": "", "mas_address": "",
+                                   "worker_nodes": nodes},
+                "layers": [{
+                    "name": "landsat_fabric", "title": "fabric soak",
+                    "data_source": data_root,
+                    "rgb_products": [f"LC08_20200{110 + k}_T1"
+                                     for k in range(B.N_SCENES)],
+                    "time_generator": "mas",
+                    "wms_timeout": 120,
+                    "wcs_max_width": 4096, "wcs_max_height": 4096,
+                    "wcs_max_tile_width": 256,
+                    "wcs_max_tile_height": 256}],
+            }, fp)
+        watcher.reload()
+
+        def gateway(fab) -> "OWSServer":
+            return OWSServer(watcher, mas_factory=lambda a: mas_client,
+                             metrics=MetricsLogger(),
+                             gateway=ServingGateway(), fabric=fab)
+
+        # the ring wants each replica's address before it exists; boot
+        # with placeholders, then rewire membership (generation bump
+        # included — exactly what a real redeploy does)
+        fab_a = ReplayFabric("http://pending-a", [])
+        fab_b = ReplayFabric("http://pending-b", [])
+        host_a = boot(gateway(fab_a))
+        host_b = boot(gateway(fab_b))
+        url_a, url_b = f"http://{host_a}", f"http://{host_b}"
+        fab_a.self_addr = url_a
+        fab_a.set_peers([url_b])
+        fab_b.self_addr = url_b
+        fab_b.set_peers([url_a])
+
+        grid = 4
+        frac = np.linspace(0.0, 0.75, grid)
+        tiles = [(float(fx), float(fy)) for fx in frac for fy in frac]
+        w = merc.width * 0.25
+        rng = np.random.default_rng(7)
+        ranks = (rng.zipf(1.2, size=100_000) - 1) % len(tiles)
+
+        def url_for(host: str, k: int) -> str:
+            fx, fy = tiles[k]
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + w},"
+                  f"{merc.ymin + fy * merc.height + w}")
+            return (f"http://{host}/ows/fabric?service=WMS"
+                    f"&request=GetMap&version=1.3.0"
+                    f"&layers=landsat_fabric&crs=EPSG:3857&bbox={bb}"
+                    f"&width=256&height=256&format=image/png"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        def fetchc(url: str):
+            """(class, X-Gsky-Cache, body)."""
+            try:
+                with urllib.request.urlopen(url, timeout=180) as r:
+                    return ("ok", r.headers.get("X-Gsky-Cache", ""),
+                            r.read())
+            except urllib.error.HTTPError as e:
+                ctype = e.headers.get("Content-Type", "")
+                e.read()
+                if e.code == 500 or "vnd.ogc.se_xml" not in ctype:
+                    return "hard_5xx", "", b""
+                return "ogc_error", "", b""
+            except Exception:
+                return "transport", "", b""
+
+        # warm: first warp on each node pays jax import + XLA compiles
+        warm_end = time.time() + 420
+        while time.time() < warm_end:
+            if fetchc(url_for(host_a, 0))[0] == "ok":
+                break
+            time.sleep(2.0)
+
+        # phase A: Zipf storm alternating gateways — both caches fill,
+        # non-owner misses replay across the ring as they go
+        counts: dict = {}
+        cache_outcomes: dict = {}
+        counter = itertools.count()
+        lock = threading.Lock()
+
+        def one(_):
+            i = next(counter)
+            host = host_a if i % 2 == 0 else host_b
+            c, src, _body = fetchc(url_for(host, int(ranks[i % len(ranks)])))
+            with lock:
+                counts[c] = counts.get(c, 0) + 1
+                if src:
+                    cache_outcomes[src] = cache_outcomes.get(src, 0) + 1
+
+        conc = min(args.conc, 4)
+        t_end = time.time() + max(args.seconds * 0.5, 8.0)
+        with cf.ThreadPoolExecutor(conc) as ex:
+            while time.time() < t_end:
+                list(ex.map(one, range(conc * 2)))
+
+        # every hot tile must be resident on gateway B (the survivor)
+        # before A dies, or the recovery phase measures luck instead of
+        # the fabric
+        for k in range(len(tiles)):
+            fetchc(url_for(host_b, k))
+
+        # phase B: gateway A "dies"; a cold replica takes its place.
+        # its empty cache must refill from B's bytes over the ring, not
+        # from re-renders
+        fab_a2 = ReplayFabric("http://pending-a2", [])
+        host_a2 = boot(gateway(fab_a2))
+        fab_a2.self_addr = f"http://{host_a2}"
+        fab_a2.set_peers([url_b])
+        fab_b.set_peers([f"http://{host_a2}"])   # B re-homes too
+        recovery_counts: dict = {}
+        peer_served = 0
+        for k in list(range(len(tiles))) * 2:
+            c, src, _body = fetchc(url_for(host_a2, k))
+            recovery_counts[c] = recovery_counts.get(c, 0) + 1
+            if src == "peer":
+                peer_served += 1
+        a2 = fab_a2.stats()["outcomes"]
+        probed = (a2.get("hit", 0) + a2.get("miss", 0)
+                  + a2.get("error", 0))
+        replay_rate = a2.get("hit", 0) / max(probed, 1)
+
+        # phase C: page peering.  The paged pipeline stages pool pages
+        # wherever COMPOSITES run — the worker-less default namespace
+        # renders in this process — so seed the local pool + shared
+        # journal with a lap of /ows renders, expose the pool over the
+        # real worker RPC front door, then SIGKILL a worker and require
+        # its replacement's warm boot to refill over page-fetch RPC
+        # (hottest-first, CRC-checked) instead of cold staging.
+        from gsky_tpu.pipeline import pages as _pages
+        from gsky_tpu.worker.server import WorkerService, \
+            make_grpc_server
+
+        def seed_url(k: int) -> str:
+            fx, fy = tiles[k]
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + w},"
+                  f"{merc.ymin + fy * merc.height + w}")
+            return (f"http://{host_b}/ows?service=WMS&request=GetMap"
+                    f"&version=1.3.0&layers=landsat&crs=EPSG:3857"
+                    f"&bbox={bb}&width=256&height=256"
+                    f"&format=image/png"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        for k in list(range(len(tiles))) * 2:   # twice: stage + heat
+            fetchc(seed_url(k))
+        seeded = _pages._default.stats() if _pages._default else {}
+
+        peer_port = free_port()
+        peer_svc = WorkerService(pool_size=1)
+        peer_srv = make_grpc_server(peer_svc,
+                                    f"127.0.0.1:{peer_port}")
+        peer_srv.start()
+        try:
+            kill_port = ports[2]
+            procs[kill_port].kill()
+            procs[kill_port].wait()
+            spawn(kill_port,
+                  page_peers=f"127.0.0.1:{peer_port}")
+            worker_back = wait_ready(kill_port, 300)
+            refill: dict = {}
+            if worker_back:
+                t_end = time.time() + 90
+                while time.time() < t_end:
+                    refill = pages_stats(kill_port)
+                    if refill.get("peer_filled", 0) > 0:
+                        break
+                    time.sleep(1.0)
+                # the poll breaks on the FIRST fill, mid-rehydrate:
+                # let the warm boot finish before judging the ratio
+                time.sleep(3.0)
+                refill = pages_stats(kill_port) or refill
+        finally:
+            peer_srv.stop(0)
+        peer_filled = refill.get("peer_filled", 0)
+        rehydrated = refill.get("rehydrated", 0)
+
+        # phase D: the escape hatch.  GSKY_FABRIC=0 must be
+        # byte-identical to a fabric-less server, and the fabric object
+        # must never probe a peer
+        os.environ["GSKY_FABRIC"] = "0"
+        try:
+            fab_off = ReplayFabric("http://off", [url_b])
+            host_off = boot(gateway(fab_off))
+            host_plain = boot(gateway(None))
+            c_off, src_off, body_off = fetchc(url_for(host_off, 0))
+            c_plain, _src, body_plain = fetchc(url_for(host_plain, 0))
+            identical = (c_off == c_plain == "ok"
+                         and body_off == body_plain
+                         and len(body_off) > 0)
+            off_outcomes = fab_off.stats()["outcomes"]
+            off_dormant = set(off_outcomes) <= {"disabled"}
+        finally:
+            os.environ["GSKY_FABRIC"] = "1"
+
+        # observability: strict exposition parse with the fabric
+        # families present, and the /debug fabric block
+        metrics = check_metrics(
+            host_b, require=("gsky_requests_total",
+                             "gsky_fabric_replay_total",
+                             "gsky_fabric_page_fills_total"))
+        with urllib.request.urlopen(f"http://{host_b}/debug",
+                                    timeout=30) as r:
+            debug_fabric = json.loads(r.read()).get("fabric")
+
+        out = {
+            "scenario": "fabric", "nodes": nodes,
+            "gateways": [host_a, host_b, host_a2],
+            "storm": counts, "storm_cache": cache_outcomes,
+            "recovery": recovery_counts,
+            "recovery_peer_served": peer_served,
+            "recovery_replay": {"outcomes": a2,
+                                "rate": round(replay_rate, 3)},
+            "worker_refill": {"back": worker_back,
+                              "seeded": seeded.get("staged", 0),
+                              "peer_filled": peer_filled,
+                              "rehydrated": rehydrated},
+            "fabric_off": {"identical": identical,
+                           "outcomes": off_outcomes},
+            "metrics": metrics,
+            "debug_fabric": bool(debug_fabric),
+        }
+        print(json.dumps(out))
+        hard = sum(d.get(k, 0) for d in (counts, recovery_counts)
+                   for k in ("hard_5xx", "transport"))
+        ok = (counts.get("ok", 0) > 0
+              and hard == 0
+              and recovery_counts.get("ok", 0) > 0
+              # >= half of the peer-owned hot set came back as replays
+              and a2.get("hit", 0) > 0
+              and peer_served > 0
+              and replay_rate >= 0.5
+              # >= half of the worker's warm refill came from peers
+              and worker_back
+              and seeded.get("staged", 0) > 0
+              and peer_filled > 0
+              and peer_filled >= rehydrated - peer_filled
+              and identical and off_dormant
+              and not metrics["missing"]
+              and bool(debug_fabric))
+        print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+        return 0 if ok else 1
+    finally:
+        for p, proc in procs.items():
+            try:
+                proc.kill()
+            except Exception:  # process already exited
+                pass
 
 
 if __name__ == "__main__":
