@@ -390,6 +390,61 @@ def register_oom_hook(fn: Callable[[], None]) -> None:
 
 _UNSET = object()
 
+# -- two-in-flight wave supervision -------------------------------------
+#
+# The pipelined wave scheduler (pipeline/waves.py) keeps TWO waves in
+# flight: wave N executing on device while wave N+1 stages its uploads.
+# A staging-side sync that exceeds the watchdog is almost never the
+# staging wave's fault — device_put serialises behind the executing
+# program's stream, so a hung kernel presents as a hung *upload* on the
+# assembly thread.  Execution windows let the watchdog attribute such a
+# hang to the wave that is actually wedging the device.
+
+_exec_lock = threading.Lock()
+_exec_windows: dict = {}     # id(token) -> (site, t_start)
+_exec_seq = [0]
+
+
+def _staging_site(site: str) -> bool:
+    """Staging-class sites: device uploads issued AHEAD of the program
+    that will consume them (``wave.stage`` / ``mesh.stage``)."""
+    return site.endswith(".stage")
+
+
+class execution_window:
+    """Marks ``site`` as the device program currently executing, for
+    hang attribution while a second (staging) wave is in flight."""
+
+    def __init__(self, site: str):
+        self.site = site
+
+    def __enter__(self):
+        with _exec_lock:
+            _exec_seq[0] += 1
+            self._key = _exec_seq[0]
+            _exec_windows[self._key] = (self.site, time.monotonic())
+        return self
+
+    def __exit__(self, *exc):
+        with _exec_lock:
+            _exec_windows.pop(self._key, None)
+        return False
+
+
+def attribute_hang(site: str) -> str:
+    """Resolve which wave a watchdog timeout belongs to.
+
+    A hang at an executing site is its own; a hang at a *staging* site
+    while an older execution window is still open is attributed to the
+    executing wave (the staging upload queued behind the wedged
+    program).  With no execution window open, the staging site keeps
+    the blame — the upload itself wedged."""
+    if not _staging_site(site):
+        return site
+    with _exec_lock:
+        live = sorted(_exec_windows.values(), key=lambda p: p[1])
+    return live[0][0] if live else site
+
 
 def supervised_sync(site: str, thunk: Callable,
                     deadline_s: Optional[float] = None):
@@ -412,7 +467,14 @@ def supervised_sync(site: str, thunk: Callable,
         try:
             from ..resilience import faults
             faults.inject("device")
-            out[0] = thunk()
+            if _staging_site(site):
+                out[0] = thunk()
+            else:
+                # window held by the SYNC thread: a hung dispatch keeps
+                # its window open after the watchdog abandons it, so a
+                # staging hang queued behind it attributes correctly
+                with execution_window(site):
+                    out[0] = thunk()
         except BaseException as e:   # noqa: BLE001 - re-raised below
             out[1] = e
 
@@ -420,10 +482,13 @@ def supervised_sync(site: str, thunk: Callable,
     t.start()
     t.join(deadline if deadline > 0 else None)
     if t.is_alive():
-        _default.record_hang(site)
+        blame = attribute_hang(site)
+        _default.record_hang(blame)
+        detail = "" if blame == site else \
+            f" (attributed to executing {blame!r})"
         raise DeviceHang(
-            f"device sync {site!r} exceeded {deadline:.3g}s watchdog",
-            site=site)
+            f"device sync {site!r} exceeded {deadline:.3g}s"
+            f" watchdog{detail}", site=blame)
     if out[1] is not None:
         raise out[1]
     return out[0]
@@ -541,4 +606,6 @@ def reset() -> None:
     """Test hook: fresh supervisor state.  Registered OOM hooks are
     kept — they are wired once at executor construction and must
     survive test resets the way the executor singleton does."""
+    with _exec_lock:
+        _exec_windows.clear()
     _default.reset()

@@ -229,13 +229,16 @@ class MeshDispatcher:
 
     # -- per-layout dispatch -------------------------------------------
 
-    def dispatch_wave(self, sched, kind: str, es: List):
+    def dispatch_wave(self, sched, kind: str, es: List, staged=None):
         """The scheduler's mesh entry: pick the layout, dispatch, and
         account.  Runs inside device_guard.run('dispatch.wave'); raises
-        propagate to the scheduler's per-entry failover."""
+        propagate to the scheduler's per-entry failover.  ``staged``
+        is the `stage_wave` handoff (per-chip slices already uploaded
+        while the previous sharded program ran); only the granule
+        layout stages, other layouts ignore it."""
         layout = self.layout_for(kind, es[0].key, len(es))
         if layout == "granule" and kind in ("byte", "scored"):
-            devs = self._dispatch_wave_granule(kind, es)
+            devs = self._dispatch_wave_granule(kind, es, staged)
         elif layout == "x" and kind in ("byte", "scored"):
             devs = self._dispatch_x(kind, es)
         elif layout == "time" and kind == "drill":
@@ -249,6 +252,64 @@ class MeshDispatcher:
         self._note(layout, es)
         return devs
 
+    def stage_wave(self, sched, kind: str, es: List):
+        """The ASSEMBLY-stage half of the granule layout: plan the
+        shard split, stack the wave's tables/params/ctrls and issue
+        the `NamedSharding` `device_put` uploads NOW — the per-chip
+        slices transfer while the previous sharded program is still
+        executing.  Returns the staged handoff dict for
+        `dispatch_wave(..., staged=...)`, or None when the group's
+        layout doesn't pre-stage (x / time / replicated re-stack at
+        dispatch, unchanged).  Runs under
+        device_guard.run('mesh.stage') — a staging-class site, so a
+        hang queued behind a wedged kernel is attributed to the
+        EXECUTING wave."""
+        layout = self.layout_for(kind, es[0].key, len(es))
+        if layout != "granule" or kind not in ("byte", "scored"):
+            return None
+        return self._stage_granule(kind, es)
+
+    def _stage_granule(self, kind: str, es: List) -> Dict:
+        """Shared plan/stack/upload: the assembly stage calls it one
+        wave ahead (via `stage_wave`); the synchronous leg calls it
+        inline at dispatch — identical buffers either way."""
+        from ..ops import paged
+        N = len(es)
+        Np = self._wave_pad(N)
+        plan = None
+        try:
+            from ..pipeline import autoplan
+            plan = autoplan.plan_sharded(kind, es, self.n_chips, Np)
+        except Exception:   # planning is an optimisation
+            plan = None
+        if plan is not None:
+            tables, params = plan.tables, plan.params
+            T, S = int(params.shape[1]), int(tables.shape[2])
+            blk, sb_of = plan.blk, plan.sb_of
+            paged.note_gather(plan.planned_bytes)
+        else:
+            pool = es[0].payload["pool"]
+            tables, params, T, S = self._stack_tables(es, Np)
+            blk, sb_of = None, None
+            paged.note_gather(paged.table_gather_bytes(
+                tables, pool.page_rows, pool.page_cols))
+        ctrls = np.stack([e.payload["ctrl"] for e in es]
+                         + [es[0].payload["ctrl"]] * (Np - N))
+        wav = self._wave_sharding()
+        staged = {
+            "layout": "granule", "Np": Np, "T": T, "S": S, "blk": blk,
+            "d_tables": jax.device_put(jnp.asarray(tables), wav),
+            "d_params": jax.device_put(jnp.asarray(params), wav),
+            "d_ctrls": jax.device_put(jnp.asarray(ctrls), wav),
+            "d_sb": None if sb_of is None else
+            jax.device_put(jnp.asarray(sb_of), wav),
+        }
+        if kind == "byte":
+            sps = np.stack([e.payload["sp"] for e in es]
+                           + [es[0].payload["sp"]] * (Np - N))
+            staged["d_sps"] = jax.device_put(jnp.asarray(sps), wav)
+        return staged
+
     def _chip_counts(self, n_real: int, n_padded: int) -> List[int]:
         """Real entries landing on each chip under the wave-axis
         split (chip i owns rows [i*rpc, (i+1)*rpc))."""
@@ -256,51 +317,28 @@ class MeshDispatcher:
         return [max(0, min(n_real - c * rpc, rpc))
                 for c in range(self.n_chips)]
 
-    def _dispatch_wave_granule(self, kind: str, es: List):
+    def _dispatch_wave_granule(self, kind: str, es: List, staged=None):
         pool = es[0].payload["pool"]
         statics = es[0].key[0]
         try:
-            from ..ops import paged
             from ..ops.pallas_tpu import pallas_interpret
             interpret = pallas_interpret()
             N = len(es)
-            Np = self._wave_pad(N)
-            # per-shard dataflow plan: each chip's lane slice is
-            # superblocked independently, so halos never cross chips
-            plan = None
-            try:
-                from ..pipeline import autoplan
-                plan = autoplan.plan_sharded(kind, es, self.n_chips,
-                                             Np)
-            except Exception:   # planning is an optimisation
-                plan = None
-            if plan is not None:
-                tables, params = plan.tables, plan.params
-                T, S = int(params.shape[1]), int(tables.shape[2])
-                blk, sb_of = plan.blk, plan.sb_of
-                paged.note_gather(plan.planned_bytes)
-            else:
-                tables, params, T, S = self._stack_tables(es, Np)
-                blk, sb_of = None, None
-                paged.note_gather(paged.table_gather_bytes(
-                    tables, pool.page_rows, pool.page_cols))
-            ctrls = np.stack([e.payload["ctrl"] for e in es]
-                             + [es[0].payload["ctrl"]] * (Np - N))
-            wav = self._wave_sharding()
+            if staged is None:
+                staged = self._stage_granule(kind, es)
+            Np = staged["Np"]
+            T, S, blk = staged["T"], staged["S"], staged["blk"]
+            d_tables = staged["d_tables"]
+            d_params = staged["d_params"]
+            d_ctrls = staged["d_ctrls"]
+            d_sb = staged["d_sb"]
             rep = self._rep_sharding()
-            d_tables = jax.device_put(jnp.asarray(tables), wav)
-            d_params = jax.device_put(jnp.asarray(params), wav)
-            d_ctrls = jax.device_put(jnp.asarray(ctrls), wav)
-            d_sb = None if sb_of is None else \
-                jax.device_put(jnp.asarray(sb_of), wav)
             self._chip_occupancy(self._chip_counts(N, Np))
             if kind == "byte":
                 method, n_ns, out_hw, step, auto, colour_scale = statics
-                sps = np.stack([e.payload["sp"] for e in es]
-                               + [es[0].payload["sp"]] * (Np - N))
-                d_sps = jax.device_put(jnp.asarray(sps), wav)
+                d_sps = staged["d_sps"]
                 if d_sb is not None:
-                    Gc = int(tables.shape[0]) // self.n_chips
+                    Gc = int(d_tables.shape[0]) // self.n_chips
                     fn = self._get(
                         ("wave_byte_sb", statics, T, S, Np, Gc, blk,
                          interpret),
@@ -322,7 +360,7 @@ class MeshDispatcher:
                 return (out[:N],)
             method, n_ns, out_hw, step = statics
             if d_sb is not None:
-                Gc = int(tables.shape[0]) // self.n_chips
+                Gc = int(d_tables.shape[0]) // self.n_chips
                 fn = self._get(
                     ("wave_scored_sb", statics, T, S, Np, Gc, blk,
                      interpret),

@@ -54,6 +54,16 @@ WAVE_ASSEMBLY_MS = _REG.histogram(
     "gsky_wave_assembly_ms",
     "Wave assembly + dispatch-enqueue time (milliseconds).",
     buckets=log_buckets(0.01, 100.0))
+WAVE_GAP_MS = _REG.histogram(
+    "gsky_wave_gap_ms",
+    "Host-side idle gap between consecutive wave dispatch enqueues "
+    "(milliseconds) - the inter-wave stutter the pipelined scheduler "
+    "closes (docs/PERF.md 'Continuous device occupancy').",
+    buckets=log_buckets(0.01, 1000.0))
+WAVE_STAGED = _REG.counter(
+    "gsky_wave_staged_total",
+    "Wave groups staged ahead of dispatch by the assembly stage "
+    "(double-buffered input ring uploads).")
 MESH_WAVES = _REG.counter(
     "gsky_mesh_waves_total",
     "Mesh wave dispatches by partition layout.",

@@ -525,6 +525,24 @@ def _ring_put_fn():
         donate_argnums=donate)
 
 
+@functools.lru_cache(maxsize=1)
+def _stage_refresh_fn():
+    """Donated input-staging refresh: upload ``fresh`` into the HBM
+    pages of a retired staging slot.  The slot buffer is donated (so
+    the allocator reuses its storage instead of growing the arena per
+    wave) and the device stream's WAR ordering guarantees the overwrite
+    waits for the program still reading the old generation — the same
+    ordering contract `_ring_put_fn` relies on.  Donation is skipped on
+    the CPU backend (XLA:CPU ignores aliasing hints and warns)."""
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+
+    def _refresh(slot, fresh):
+        del slot     # donated: its storage backs the fresh upload
+        return fresh
+
+    return jax.jit(_refresh, donate_argnums=donate)
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def _ring_take(buf, base, n: int):
     """Slice the n rows just written back out of the ring — enqueued

@@ -124,7 +124,8 @@ _COSTED: Dict[tuple, tuple] = {}    # key -> (bh, bw) chosen
 _SEEDED = False
 # plan counters (under _LOCK)
 _STATS = {"superblocks": 0, "merged_lanes": 0, "bytes_saved": 0,
-          "routes": {"ragged": 0, "bucketed": 0}, "groups_planned": 0}
+          "routes": {"ragged": 0, "bucketed": 0}, "groups_planned": 0,
+          "assembly_planned": 0}
 
 
 def _seed_from_ledger():  # gskylint: holds-lock
@@ -408,15 +409,24 @@ def _note_route(path: str):
         pass
 
 
-def plan_wave_group(kind: str, es) -> Optional[Plan]:
-    """Plan one drained wave group (the `waves.run_wave` hook, called
-    before group dispatch).  Returns None — dispatch exactly as today —
-    when planning is off, the kind has no gather, or nothing improves;
-    otherwise a `Plan` whose route the dispatcher follows.  Never
-    raises into the wave path: any planner defect degrades to the
-    unplanned dispatch."""
+def plan_wave_group(kind: str, es, stage: str = "dispatch"
+                    ) -> Optional[Plan]:
+    """Plan one drained wave group.  Under the synchronous ticker this
+    runs just before group dispatch; the pipelined scheduler
+    (GSKY_WAVE_PIPELINE, pipeline/waves.py) calls it from the ASSEMBLY
+    stage with ``stage="assembly"`` — planning off the dispatch
+    critical path, overlapped with the previous wave's execution.  All
+    planner state is under ``_LOCK``, so assembly-thread planning may
+    race a mesh ``plan_sharded`` on the dispatch thread.  Returns None
+    — dispatch exactly as today — when planning is off, the kind has no
+    gather, or nothing improves; otherwise a `Plan` whose route the
+    dispatcher follows.  Never raises into the wave path: any planner
+    defect degrades to the unplanned dispatch."""
     if not plan_enabled() or kind not in ("byte", "scored") or not es:
         return None
+    if stage == "assembly":
+        with _LOCK:
+            _STATS["assembly_planned"] += 1
     try:
         statics = es[0].key[0]
         method, n_ns, out_hw = statics[0], statics[1], statics[2]
@@ -575,6 +585,7 @@ def plan_stats() -> Dict:
                 "merged_lanes": _STATS["merged_lanes"],
                 "gather_bytes_saved": _STATS["bytes_saved"],
                 "groups_planned": _STATS["groups_planned"],
+                "assembly_planned": _STATS["assembly_planned"],
                 "routes": dict(_STATS["routes"])}
 
 
@@ -587,4 +598,5 @@ def reset_plan_state():
         _SEEDED = False
         _STATS.update({"superblocks": 0, "merged_lanes": 0,
                        "bytes_saved": 0, "groups_planned": 0,
+                       "assembly_planned": 0,
                        "routes": {"ragged": 0, "bucketed": 0}})

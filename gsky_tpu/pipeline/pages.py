@@ -137,6 +137,11 @@ class PagePool:
         self.rehydrated = 0
         self.quarantined = 0
         self.peer_filled = 0   # pages staged from fabric peers
+        # async-staging handoff generation: bumped by teardown so a
+        # wave staged against this pool BEFORE a device incident
+        # refuses to dispatch against the rebuilt pool (its pinned
+        # slot indices no longer name the pages its tables meant)
+        self._handoff_gen = 0
         from ..obs import tsan
         if tsan.enabled():
             # lockset tracking across staging / dispatch / teardown
@@ -340,6 +345,23 @@ class PagePool:
             self._ensure_pool()
             yield self._pool
 
+    # -- async-staging handoff (pipelined waves) -----------------------
+
+    def handoff(self) -> int:
+        """Capture the staging generation at wave-assembly time.  The
+        pipelined wave scheduler stages uploads one wave AHEAD of
+        dispatch; the token pins the meaning of its slot indices."""
+        with self.lock:
+            return self._handoff_gen
+
+    def handoff_ok(self, gen: int) -> bool:
+        """True while a :meth:`handoff` token is still dispatchable —
+        no teardown has recycled the slot namespace since assembly.
+        (LRU eviction cannot invalidate a staged wave: its table slots
+        stay pinned across the handoff.)"""
+        with self.lock:
+            return self._handoff_gen == int(gen)
+
     def drop_scene(self, serial: int):
         """Free every unpinned page of a scene (cache eviction hook);
         pinned pages stay resident until their dispatch retires them
@@ -383,6 +405,7 @@ class PagePool:
             self._quarantine_pins.clear()
             self._free = list(range(self.capacity - 1, 0, -1))
             self.teardowns += 1
+            self._handoff_gen += 1
 
     def rehydrate(self) -> int:
         """Warm recovery: re-stage the journal's hottest pages from

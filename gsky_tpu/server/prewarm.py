@@ -289,9 +289,15 @@ def prewarm(configs: Dict,
                 # representative work.  The pool must be the RUNTIME
                 # singleton — its (capacity, PR, PC) shape is part of
                 # the compiled program.
+                from ..ops.paged import (OutputRing, _stage_refresh_fn)
                 from ..pipeline.pages import default_page_pool
                 n_pad = _bucket_pow2(1)
                 pool = default_page_pool()
+                # the wave pipeline's ring/staging programs compile on
+                # the SAME (W, shape, dtype) lattice: one throwaway
+                # ring warms the donated put/take pair per lane, and
+                # the staging refresh warms per input-stack shape
+                ring = OutputRing()
                 pr, pc = pool.page_rows, pool.page_cols
                 scap = _bucket_pow2(page_slots())
                 slot_sweep = [s for s in (1, 2, 4, 8)
@@ -360,6 +366,29 @@ def prewarm(configs: Dict,
                                         tables, p16w, ctrls, method,
                                         n_pad, (hw, hw), step,
                                         _xla_scored, blk=blk)
+                            # output-ring lattice: the dispatcher
+                            # pushes FULL pow2 result blocks through
+                            # the donated ring, so put+take compile
+                            # per (W, result shape, dtype) lane —
+                            # cover byte, scored canvas and validity
+                            run(lambda: ring.put(jnp.zeros(
+                                (W, hw, hw), jnp.uint8)))
+                            run(lambda: ring.put(jnp.zeros(
+                                (W, n_pad, hw, hw), jnp.float32)))
+                            run(lambda: ring.put(jnp.zeros(
+                                (W, n_pad, hw, hw), bool)))
+                            # the scored dispatch folds best ->
+                            # validity on device; warm the fold too
+                            run(lambda: jnp.zeros(
+                                (W, n_pad, hw, hw), jnp.float32)
+                                > -jnp.inf)
+                            # staging-ring refresh: the assembly stage
+                            # re-uploads each input stack through the
+                            # donated refresh, one program per shape
+                            for d in (tables, p16w, ctrls, sps):
+                                h = np.asarray(d)
+                                run(lambda h=h: _stage_refresh_fn()(
+                                    jnp.asarray(h), h))
             elif n_exprs == 1:
                 n_pad = _bucket_pow2(1)
                 for B in batches:

@@ -15,7 +15,8 @@ import jax.numpy as jnp
 import test_paged
 from gsky_tpu.ops.drill import masked_mean_impl
 from gsky_tpu.ops.paged import OutputRing
-from gsky_tpu.ops.warp import render_scenes_ctrl
+from gsky_tpu.ops.warp import render_scenes_ctrl, \
+    warp_scenes_ctrl_scored
 from gsky_tpu.pipeline import waves as W
 from gsky_tpu.resilience import CancelToken, RequestCancelled, \
     cancel_scope
@@ -298,6 +299,297 @@ class TestWaveAssembly:
         assert sched._effective_max() == 8
         monkeypatch.setattr(pressure, "brownout_level", lambda: 0)
         assert sched._effective_max() == 16
+        sched.shutdown()
+
+
+class TestWavePipeline:
+    """The two-stage pipeline (PERF.md "Continuous device occupancy"):
+    the assembly stage plans, stacks and uploads into the donated
+    staging ring while the dispatch stage executes — byte parity with
+    the synchronous ticker, cancellation releasing staging pins,
+    watchdog attribution with two waves in flight, the
+    GSKY_WAVE_PIPELINE=0 escape hatch, and donated-ring reuse."""
+
+    def test_pipelined_parity_all_lanes(self, monkeypatch):
+        """The SAME byte / scored / drill submissions through the
+        staged assemble_once()/dispatch_once() pipeline and through the
+        synchronous run_wave() ticker return identical bytes, and both
+        match the per-call references."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        # queue depth 4: assemble_once stages three groups before the
+        # test pops any of them (depth 1 would block assembly)
+        monkeypatch.setenv("GSKY_WAVE_QUEUE", "4")
+        # planning off: small groups would otherwise route bucketed
+        # (nothing staged) and the staging-ring assertions go dark
+        monkeypatch.setenv("GSKY_PLAN", "0")
+
+        tiles = [test_paged._inputs(0, B=1, lo=1.0, hi=4000.0),
+                 test_paged._inputs(1, B=2, lo=1.0, hi=4000.0)]
+        _, _, _, h, w, step, n_ns = tiles[0]
+        b_statics = _byte_statics(n_ns, h, w, step)
+        s_statics = ("near", n_ns, (h, w), step)
+        sp = np.array([10.0, 250.0, 0.0], np.float32)
+        rng = np.random.default_rng(11)
+        drills = [(rng.uniform(0, 9, (3, 64)).astype(np.float32),
+                   rng.uniform(size=(3, 64)) > 0.4) for _ in range(2)]
+
+        def run_leg(pipelined):
+            monkeypatch.setenv("GSKY_WAVE_PIPELINE",
+                               "1" if pipelined else "0")
+            pool = test_paged._pool(cap=64)
+            sched = W.WaveScheduler(tick_ms=5000.0,
+                                    manual_dispatch=pipelined)
+            staged = [test_paged._stage_full(pool, t[0], t[2],
+                                             serial0=100 * (i + 1))
+                      for i, t in enumerate(tiles)]
+            results = [None] * 5
+            errors = [None] * 5
+            ts = [_submit_byte(sched, pool, tiles[i], staged[i], sp,
+                               b_statics, results, errors, i)
+                  for i in range(2)]
+            sc_tab, sc_p16 = test_paged._stage_full(
+                pool, tiles[0][0], tiles[0][2], serial0=900)
+
+            def go_scored():
+                try:
+                    results[2] = sched.warp_scored(
+                        pool, sc_tab, sc_p16,
+                        np.asarray(tiles[0][1]), s_statics,
+                        (tiles[0][0], tiles[0][2], None, None), None)
+                except Exception as e:   # noqa: BLE001
+                    errors[2] = e
+            t = threading.Thread(target=go_scored)
+            t.start()
+            ts.append(t)
+            for j, (d, v) in enumerate(drills):
+                def god(j=j, d=d, v=v):
+                    try:
+                        results[3 + j] = sched.drill_stats(
+                            d, v, -3e38, 3e38, False, None)
+                    except Exception as e:   # noqa: BLE001
+                        errors[3 + j] = e
+                t = threading.Thread(target=god)
+                t.start()
+                ts.append(t)
+            _await_pending(sched, 5)
+            if pipelined:
+                # assembly stages all three groups ahead of dispatch,
+                # then the dispatch stage pops them back-to-back
+                assert sched.assemble_once() == 5
+                st = sched.stats()
+                assert st["staged_waves"] == 3
+                assert st["staged_queue_depth"] == 3
+                n = 0
+                while True:
+                    got = sched.dispatch_once(timeout=1.0)
+                    if got == 0:
+                        break
+                    n += got
+                assert n == 5
+            else:
+                assert sched.run_wave() == 5
+            for t in ts:
+                t.join(timeout=60)
+            assert errors == [None] * 5
+            st = sched.stats()
+            assert st["dispatches"] == 3 and st["requests"] == 5
+            assert pool.stats()["pinned"] == 0
+            if pipelined:
+                # all three groups staged through the ring (the drill
+                # stacks pass through upload already on device)
+                assert st["staging"]["staged"] == 3
+            sched.shutdown()
+            return results
+
+        sync = run_leg(False)
+        pipe = run_leg(True)
+        # pipelined vs synchronous: bit-exact, every lane
+        for i in range(2):
+            np.testing.assert_array_equal(sync[i], pipe[i])
+        np.testing.assert_array_equal(sync[2][0], pipe[2][0])
+        np.testing.assert_array_equal(sync[2][1], pipe[2][1])
+        for j in range(2):
+            np.testing.assert_array_equal(sync[3 + j][0], pipe[3 + j][0])
+            np.testing.assert_array_equal(sync[3 + j][1], pipe[3 + j][1])
+        # and both match the per-call references
+        for i, (stack, ctrl, params, h, w, step, n_ns) in \
+                enumerate(tiles):
+            rx = render_scenes_ctrl(stack, ctrl, params,
+                                    jnp.asarray(sp), *b_statics)
+            np.testing.assert_array_equal(np.asarray(rx), pipe[i])
+        cx, bx = warp_scenes_ctrl_scored(
+            tiles[0][0], tiles[0][1], tiles[0][2], *s_statics)
+        np.testing.assert_array_equal(np.asarray(cx), pipe[2][0])
+        np.testing.assert_array_equal(
+            np.asarray(bx) > -np.inf, pipe[2][1])
+        for j, (d, v) in enumerate(drills):
+            rv, rc = masked_mean_impl(d, v, -3e38, 3e38, False, np)
+            np.testing.assert_allclose(pipe[3 + j][0], rv, rtol=1e-6)
+            np.testing.assert_array_equal(pipe[3 + j][1], rc)
+
+    def test_cancellation_mid_upload_releases_staging_slot(
+            self, monkeypatch):
+        """A wave cancelled BETWEEN assembly (inputs already uploaded
+        into the staging ring) and dispatch skips the device program,
+        unpins its pages AND frees the staging slot for the next
+        wave."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        monkeypatch.setenv("GSKY_WAVE_PIPELINE", "1")
+        monkeypatch.setenv("GSKY_PLAN", "0")   # force the staged path
+        pool = test_paged._pool(cap=64)
+        sched = W.WaveScheduler(tick_ms=5000.0, manual_dispatch=True)
+        tile = test_paged._inputs(0, B=1, lo=1.0, hi=4000.0)
+        stack, ctrl, params, h, w, step, n_ns = tile
+        statics = _byte_statics(n_ns, h, w, step)
+        sp = np.array([10.0, 250.0, 0.0], np.float32)
+        toks = [CancelToken(), CancelToken()]
+        errors = [None, None]
+        ts = []
+        for i in range(2):
+            staged_i = test_paged._stage_full(pool, stack, params,
+                                              serial0=50 + 10 * i)
+
+            def go(i=i, staged_i=staged_i):
+                try:
+                    with cancel_scope(toks[i]):
+                        tables, p16 = staged_i
+                        sched.render_byte(
+                            pool, tables, p16, np.asarray(ctrl), sp,
+                            statics, (stack, params, None, None), None)
+                except BaseException as e:   # noqa: BLE001
+                    errors[i] = e
+            t = threading.Thread(target=go)
+            t.start()
+            ts.append(t)
+        _await_pending(sched, 2)
+        assert sched.assemble_once() == 2    # staged + uploaded
+        assert pool.stats()["pinned"] > 0    # pins ride to dispatch
+        for tok in toks:
+            tok.cancel()
+        assert sched.dispatch_once(timeout=1.0) == 0   # skipped
+        for t in ts:
+            t.join(timeout=30)
+        assert all(isinstance(e, RequestCancelled) for e in errors)
+        st = sched.stats()
+        assert st["cancelled"] == 2 and st["dispatches"] == 0
+        assert pool.stats()["pinned"] == 0
+        # the slot freed by the cancelled wave must be reacquirable —
+        # a leaked pin here would wedge assembly at the ring
+        fam = ("byte", (tuple(statics), id(pool)))
+        tok2 = sched.staging.acquire(fam)     # returns, doesn't block
+        tok3 = sched.staging.acquire(fam)     # BOTH slots came back
+        assert {tok2[1], tok3[1]} == {0, 1}
+        sched.staging.release(tok2)
+        sched.staging.release(tok3)
+        sched.shutdown()
+
+    def test_watchdog_attributes_hang_to_executing_wave(self):
+        """Two waves in flight: a staging upload that times out while
+        an older wave's program is EXECUTING blames the executing
+        wave (the upload queued behind the wedged program); with no
+        execution window open, the staging site keeps the blame."""
+        from gsky_tpu.device_guard import supervisor as sup
+        sup.reset()
+        try:
+            with sup.execution_window("dispatch.wave"):
+                with pytest.raises(sup.DeviceHang) as ei:
+                    sup.supervised_sync("wave.stage",
+                                        lambda: time.sleep(0.5),
+                                        deadline_s=0.05)
+            assert ei.value.site == "dispatch.wave"
+            assert "attributed to executing" in str(ei.value)
+            with pytest.raises(sup.DeviceHang) as ei2:
+                sup.supervised_sync("wave.stage",
+                                    lambda: time.sleep(0.5),
+                                    deadline_s=0.05)
+            assert ei2.value.site == "wave.stage"
+            # an executing-site hang is always its own
+            with pytest.raises(sup.DeviceHang) as ei3:
+                sup.supervised_sync("dispatch.wave",
+                                    lambda: time.sleep(0.5),
+                                    deadline_s=0.05)
+            assert ei3.value.site == "dispatch.wave"
+        finally:
+            sup.reset()
+
+    def test_pipeline_escape_hatch_synchronous_identity(
+            self, monkeypatch):
+        """GSKY_WAVE_PIPELINE=0 restores the synchronous ticker: no
+        staging, no staged waves, and the result still matches the
+        per-call reference (the acceptance escape hatch)."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        monkeypatch.setenv("GSKY_WAVE_PIPELINE", "0")
+        assert not W.wave_pipeline_enabled()
+        pool = test_paged._pool(cap=64)
+        sched = W.WaveScheduler(tick_ms=5000.0)
+        tile = test_paged._inputs(0, B=1, lo=1.0, hi=4000.0)
+        stack, ctrl, params, h, w, step, n_ns = tile
+        statics = _byte_statics(n_ns, h, w, step)
+        sp = np.array([10.0, 250.0, 0.0], np.float32)
+        staged = test_paged._stage_full(pool, stack, params, serial0=60)
+        results = [None]
+        errors = [None]
+        t = _submit_byte(sched, pool, tile, staged, sp, statics,
+                         results, errors, 0)
+        _await_pending(sched, 1)
+        assert sched.run_wave() == 1
+        t.join(timeout=30)
+        assert errors == [None]
+        rx = render_scenes_ctrl(stack, ctrl, params, jnp.asarray(sp),
+                                *statics)
+        np.testing.assert_array_equal(np.asarray(rx), results[0])
+        st = sched.stats()
+        assert st["pipeline"] is False
+        assert st["staged_waves"] == 0
+        assert st["staging"]["staged"] == 0   # ring never touched
+        assert pool.stats()["pinned"] == 0
+        sched.shutdown()
+
+    def test_donated_ring_reuse_across_consecutive_waves(
+            self, monkeypatch):
+        """Three consecutive pipelined waves of the same program
+        family: the output ring keeps ONE donated lane across waves
+        (no per-wave re-allocation) and the staging ring refreshes
+        its slot buffers in place (slot_reuse) once the round-robin
+        wraps."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        monkeypatch.setenv("GSKY_WAVE_PIPELINE", "1")
+        monkeypatch.setenv("GSKY_PLAN", "0")   # force the staged path
+        pool = test_paged._pool(cap=64)
+        sched = W.WaveScheduler(tick_ms=5000.0, manual_dispatch=True)
+        tile = test_paged._inputs(0, B=1, lo=1.0, hi=4000.0)
+        stack, ctrl, params, h, w, step, n_ns = tile
+        statics = _byte_statics(n_ns, h, w, step)
+        sp = np.array([10.0, 250.0, 0.0], np.float32)
+        results = [None] * 3
+        errors = [None] * 3
+        for wv in range(3):
+            staged = test_paged._stage_full(pool, stack, params,
+                                            serial0=200 + 10 * wv)
+            t = _submit_byte(sched, pool, tile, staged, sp, statics,
+                             results, errors, wv)
+            _await_pending(sched, 1)
+            assert sched.assemble_once() == 1
+            assert sched.dispatch_once(timeout=1.0) == 1
+            t.join(timeout=30)
+        assert errors == [None] * 3
+        rx = np.asarray(render_scenes_ctrl(
+            stack, ctrl, params, jnp.asarray(sp), *statics))
+        for wv in range(3):
+            np.testing.assert_array_equal(rx, results[wv])
+        st = sched.stats()
+        assert st["dispatches"] == 3 and st["staged_waves"] == 3
+        # ONE uint8 ring lane serves all three waves, donated across
+        # dispatches rather than re-allocated
+        assert st["ring"]["writes"] >= 3
+        assert st["ring"]["lanes"] == 1
+        assert st["ring"]["bypassed"] == 0
+        # two staging slots round-robin: wave 3 lands back on wave 1's
+        # slot and refreshes every same-shape host stack in place
+        assert st["staging"]["families"] == 1
+        assert st["staging"]["staged"] == 3
+        assert st["staging"]["slot_reuse"] >= 1
+        assert pool.stats()["pinned"] == 0
         sched.shutdown()
 
 
