@@ -225,6 +225,38 @@ def replay_chips() -> Tuple[List[Tuple[int, int, int]],
     return replay(chip_map=chips), chips
 
 
+def export_hot(limit: int = 2048) -> List[Tuple[int, int, int, float]]:
+    """The handoff payload a preempted node ships to its ring successor
+    (fleet/elastic): the hottest-first scored page list, capped so the
+    notice fits one bounded RPC even after a long serving run."""
+    return replay_scored()[:max(int(limit), 0)]
+
+
+def merge_scored(entries, cap: int = 2048) -> int:
+    """Fold a peer's exported heat (``[(serial, pi, pj, score)]``, the
+    :func:`export_hot` shape) into THIS node's journal as ``heat``
+    lines, so the inherited hot set survives a local restart and ranks
+    against locally-observed heat on the next replay.  Malformed
+    entries are skipped — the sender may be mid-crash.  Returns the
+    number of entries merged."""
+    n = 0
+    for e in entries:
+        if n >= cap:
+            break
+        try:
+            s, pi, pj = int(e[0]), int(e[1]), int(e[2])
+            score = float(e[3]) if len(e) > 3 else 1.0
+        except (TypeError, ValueError, IndexError):
+            continue
+        if pi < 0 or pj < 0:
+            continue
+        # score already folds the peer's stage+heat weight; -1 undoes
+        # the +1 replay() adds per line so replayed rank is preserved
+        record_heat(s, pi, pj, max(int(score) - 1, 0))
+        n += 1
+    return n
+
+
 def clear() -> None:
     """Forget the recorded residency (test hook / operator reset) —
     the delete-the-file knob, same as the kernel ledger."""

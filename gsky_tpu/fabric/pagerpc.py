@@ -223,14 +223,20 @@ def _batches(keys: List[Key], page_bytes: int,
 
 def fill_from_peers(pool, entries: Sequence[Key],
                     peers: Optional[List[str]] = None,
-                    fetch: Optional[Callable] = None) -> int:
+                    fetch: Optional[Callable] = None,
+                    prefer: Optional[str] = None) -> int:
     """Fill ``pool`` from ring-adjacent peers, hottest-first.
 
     ``entries`` is the journal's hottest-first page list; each key is
     asked of its ring-preferred peer first (so a stable fleet converges
     on who serves what), then of the next candidate for whatever the
-    first round missed.  Returns pages actually staged."""
+    first round missed.  ``prefer`` names one peer to ask for *every*
+    key before the ring walk — the warm-handoff path sets it to the
+    preempting node, whose HBM provably holds the shipped hot set for
+    as long as its grace window lasts.  Returns pages actually staged."""
     peers = list(peers if peers is not None else page_peer_addrs())
+    if not peers and prefer:
+        peers = [prefer]
     if not peers or not entries:
         return 0
     ring = HashRing(peers, vnodes=32)
@@ -239,6 +245,21 @@ def fill_from_peers(pool, entries: Sequence[Key],
     want: List[Key] = [(int(s), int(pi), int(pj))
                        for s, pi, pj in entries]
     filled = 0
+    if prefer:
+        missing: List[Key] = []
+        got_any: Dict[Key, np.ndarray] = {}
+        for batch in _batches(want, page_bytes, cap):
+            got_any.update(fetch_pages(prefer, batch, cap, fetch=fetch))
+        for key in want:
+            page = got_any.get(key)
+            if page is not None and pool.stage_page(*key, page):
+                filled += 1
+            else:
+                missing.append(key)
+        want = missing
+        if not want:
+            _count("fills", filled)
+            return filled
     for rnd in (0, 1):          # preference walk: owner, then next
         missing: List[Key] = []
         by_peer: Dict[str, List[Key]] = {}

@@ -44,6 +44,7 @@ class DrainController:
         self._draining = False
         self.refused = 0
         self.completed = 0
+        self.abandoned = 0
         self.drained_at: Optional[float] = None
 
     @property
@@ -99,9 +100,22 @@ class DrainController:
         self.start_drain()
         return self.wait_drained(timeout_s)
 
+    def abandon_inflight(self) -> int:
+        """Grace-deadline failover: the drain timed out with work still
+        running, and the process is about to exit (supervisor SIGKILL,
+        preemption deadline).  Count the stranded tasks explicitly —
+        their callers will see a transport failure, which the fleet
+        router classifies as retryable and fails over — instead of
+        exiting with silent in-flight loss.  Returns the count."""
+        with self._cond:
+            n = self._inflight
+            self.abandoned += n
+            return n
+
     def stats(self) -> dict:
         with self._cond:
             return {"draining": self._draining,
                     "inflight": self._inflight,
                     "refused": self.refused,
-                    "completed": self.completed}
+                    "completed": self.completed,
+                    "abandoned": self.abandoned}

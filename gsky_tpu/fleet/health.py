@@ -124,6 +124,38 @@ class HealthMonitor:
             if nh is not None:
                 nh.draining = True
 
+    # -- membership ----------------------------------------------------------
+
+    def ensure(self, nodes: Sequence[str]) -> None:
+        """Track ``nodes`` (fresh optimistic records for unknown ones)."""
+        with self._lock:
+            for n in nodes:
+                if n not in self._nodes:
+                    self._nodes[n] = NodeHealth(n)
+
+    def forget(self, nodes: Sequence[str]) -> int:
+        """Drop departed nodes' records entirely.  Without this, a
+        flapping elastic fleet grows one phi tracker per address ever
+        seen — the stale-member leak ISSUE 18 closes.  Returns how many
+        records were actually removed."""
+        removed = 0
+        with self._lock:
+            for n in nodes:
+                if self._nodes.pop(n, None) is not None:
+                    removed += 1
+        return removed
+
+    def set_nodes(self, nodes: Sequence[str]) -> None:
+        """Reconcile the tracked set: add unknown nodes, purge the rest."""
+        keep = set(nodes)
+        with self._lock:
+            for n in list(self._nodes):
+                if n not in keep:
+                    del self._nodes[n]
+            for n in keep:
+                if n not in self._nodes:
+                    self._nodes[n] = NodeHealth(n)
+
     # -- reading -------------------------------------------------------------
 
     def phi(self, node: str, now: Optional[float] = None) -> float:

@@ -96,6 +96,23 @@ class HashRing:
         pref = self.preference(key, 1)
         return pref[0] if pref else None
 
+    def successor(self, node: str) -> Optional[str]:
+        """The next DISTINCT node clockwise from ``node``'s primary
+        vnode point — the shard that inherits the largest share of
+        ``node``'s arc when it leaves, and therefore the natural heir
+        for its page-residency journal on preemption (fleet/elastic).
+        Deterministic across processes for a given membership."""
+        with self._lock:
+            if node not in self._nodes or len(self._nodes) < 2:
+                return None
+            h = _hash64(f"{node}#0")
+            i = bisect.bisect_right(self._points, h)
+            for k in range(len(self._points)):
+                owner = self._owners[(i + k) % len(self._points)]
+                if owner != node:
+                    return owner
+        return None
+
     def route(self, key: str,
               eligible: Optional[Callable[[str], bool]] = None,
               load: Optional[Dict[str, int]] = None,

@@ -118,6 +118,28 @@ class FleetRouter:
         self.rr_fallback = 0
         register_router(self)
 
+    # -- membership ----------------------------------------------------------
+
+    def set_nodes(self, nodes) -> None:
+        """Reconcile membership (elastic fleet scale/replace): rebuild
+        the ring (generation bump), track new nodes in the health
+        monitor, and purge departed nodes from the phi trackers, the
+        per-node in-flight map and the locality ledger — without the
+        purge a flapping fleet grows unbounded state (ISSUE 18)."""
+        old = set(self.ring.nodes)
+        self.ring.set_nodes(nodes)
+        new = set(self.ring.nodes)
+        self.monitor.ensure(sorted(new - old))
+        gone = old - new
+        if not gone:
+            return
+        self.monitor.forget(sorted(gone))
+        with self._lock:
+            for n in gone:
+                self._load.pop(n, None)
+            self._last_node = {k: v for k, v in self._last_node.items()
+                               if v not in gone}
+
     # -- load accounting -----------------------------------------------------
 
     def load_of(self, node: str) -> int:

@@ -546,10 +546,56 @@ def _collect_fabric():
     return out
 
 
+def _collect_elastic():
+    """Elastic-fleet surfaces (docs/FLEET.md "Elastic fleet"): node
+    counts by lifecycle state, scale decisions, preemption notices and
+    warm-handoff page outcomes.  Reported only when elastic has left a
+    trace in this process (``GSKY_ELASTIC=1``, a live autoscaler, or a
+    non-zero counter) — a fixed fleet keeps its exposition
+    byte-identical."""
+    out: List = []
+    try:
+        from ..fleet import elastic
+        if elastic.dormant():
+            return out
+        counts: Dict[str, float] = {}
+        for a in elastic.autoscalers():
+            for state, n in a.node_counts().items():
+                counts[state] = counts.get(state, 0) + n
+        if counts:
+            out.append(_g("gsky_elastic_nodes",
+                          "Worker nodes by elastic lifecycle state.",
+                          [({"state": s}, float(n))
+                           for s, n in sorted(counts.items())]))
+        c = elastic.counters()
+        out.append(_c("gsky_elastic_decisions_total",
+                      "Autoscaler scale decisions by direction.",
+                      [({"dir": d}, float(n))
+                       for d, n in sorted(c["decisions"].items())]))
+        out.append(_c("gsky_preemptions_total",
+                      "Preemption notices handled, by whether a grace "
+                      "window allowed the drain + journal handoff.",
+                      [({"graceful": "true"},
+                        float(c["preemptions"]["graceful"])),
+                       ({"graceful": "false"},
+                        float(c["preemptions"]["nograce"]))]))
+        out.append(_c("gsky_handoff_pages_total",
+                      "Hot pages inherited on preemption handoff: "
+                      "refilled from peer HBM vs left to cold staging.",
+                      [({"source": s}, float(n))
+                       for s, n in sorted(
+                           c["handoff_pages"].items())]))
+    except Exception:
+        # scrape-time collectors must never break /metrics
+        pass
+    return out
+
+
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
             _collect_runtime, _collect_batcher, _collect_overload,
             _collect_ingest, _collect_device, _collect_waves,
-            _collect_mesh, _collect_tsan, _collect_fabric):
+            _collect_mesh, _collect_tsan, _collect_fabric,
+            _collect_elastic):
     _REG.register_collector(_fn)
 
 

@@ -42,6 +42,18 @@ Each comma-separated clause is ``site:kind:arg``:
     their own output — exercises the readback integrity probe and the
     ``GSKY_POOL_AUDIT`` quarantine.  The wired site is ``device``
     (``device_guard.guarded_readback``).
+``preempt:GRACE[:RATE]``
+    deliver a preemption *notice* with a ``GRACE`` window (``10s``)
+    with probability ``RATE`` (default 1.0) — fires at most once per
+    process, through the handler installed with
+    :func:`set_preempt_handler` (the worker server registers one that
+    runs the drain handshake + warm journal handoff under the grace
+    deadline; see docs/FLEET.md "Elastic fleet").  The current call
+    proceeds normally: a graceful preemption finishes admitted work.
+``preempt_nograce:RATE``
+    a preemption with zero grace — the handler gets ``grace_s=0`` and
+    is expected to flush the page journal and exit immediately.  With
+    no handler registered this degrades to ``kill`` semantics.
 
 Outcomes are drawn from a per-site ``random.Random`` seeded from
 ``GSKY_FAULTS_SEED`` (default 0) xor a CRC of the site name, so a given
@@ -100,12 +112,15 @@ class InjectedDeviceFault(RuntimeError):
 
 
 class _Rule:
-    __slots__ = ("kind", "rate", "latency_s")
+    __slots__ = ("kind", "rate", "latency_s", "fired")
 
     def __init__(self, kind: str, rate: float, latency_s: float = 0.0):
         self.kind = kind
         self.rate = rate
         self.latency_s = latency_s
+        # preempt kinds are one-shot per process: a spot reclaim is a
+        # single notice, not a fault rolled on every RPC
+        self.fired = False
 
 
 class _SiteState:
@@ -138,9 +153,10 @@ def parse_spec(spec: str) -> Dict[str, List[_Rule]]:
             raise ValueError(f"bad fault clause {clause!r} "
                              "(want site:kind:arg)")
         site, kind = parts[0].strip(), parts[1].strip()
-        if kind in ("error", "kill", "crash", "oom", "corrupt"):
+        if kind in ("error", "kill", "crash", "oom", "corrupt",
+                    "preempt_nograce"):
             rule = _Rule(kind, float(parts[2]))
-        elif kind in ("latency", "slow", "hang"):
+        elif kind in ("latency", "slow", "hang", "preempt"):
             rate = float(parts[3]) if len(parts) > 3 else 1.0
             rule = _Rule(kind, rate, _duration(parts[2]))
         else:
@@ -197,6 +213,40 @@ def reset() -> None:
     configure(None)
 
 
+# -- preemption notices -------------------------------------------------------
+
+# fn(grace_s: float, graceful: bool) -> None; must return quickly (the
+# worker server's handler spawns the drain/handoff thread and returns)
+_PREEMPT_HANDLER = None
+_preempt_lock = threading.Lock()
+
+
+def set_preempt_handler(fn) -> None:
+    """Install the process's preemption handler (last writer wins; pass
+    ``None`` to clear).  The worker server registers one at boot so a
+    ``node:preempt:<grace>`` fault rides the real drain + warm-handoff
+    protocol instead of a bespoke test path."""
+    global _PREEMPT_HANDLER
+    with _preempt_lock:
+        _PREEMPT_HANDLER = fn
+
+
+def _deliver_preempt(site: str, grace_s: float, graceful: bool) -> None:
+    from .registry import registry
+    registry.count_fault(site)
+    with _preempt_lock:
+        handler = _PREEMPT_HANDLER
+    if handler is not None:
+        try:
+            handler(grace_s, graceful)
+        except Exception:  # a broken handler must not fail the RPC
+            pass
+        return
+    if not graceful:
+        # no handler to flush state: zero grace degrades to SIGKILL
+        os._exit(137)
+
+
 def active() -> bool:
     _ensure_configured()
     return _PLAN is not None
@@ -218,11 +268,19 @@ def inject(site: str) -> None:
         return
     delay = 0.0
     die = False
+    preempt = None   # (grace_s, graceful)
     boom: Optional[Exception] = None
     with st.lock:
         for rule in st.rules:
             if rule.kind == "corrupt":
                 continue    # data-poisoning rules fire via flag()
+            if rule.kind in ("preempt", "preempt_nograce"):
+                if rule.fired:
+                    continue
+                if rule.rate >= 1.0 or st.rng.random() < rule.rate:
+                    rule.fired = True
+                    preempt = (rule.latency_s, rule.kind == "preempt")
+                continue
             if rule.rate >= 1.0 or st.rng.random() < rule.rate:
                 if rule.kind in ("latency", "slow", "hang"):
                     delay += rule.latency_s
@@ -241,6 +299,8 @@ def inject(site: str) -> None:
         from .registry import registry
         registry.count_fault(site)
         os._exit(137)
+    if preempt is not None:
+        _deliver_preempt(site, preempt[0], preempt[1])
     if delay > 0.0:
         time.sleep(delay)
     if boom is not None:

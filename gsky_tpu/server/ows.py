@@ -414,6 +414,12 @@ class OWSServer:
                 doc["fabric"] = _fabric_mod.fabric_stats(self.fabric)
         except Exception:  # fabric optional in this build
             pass
+        try:
+            from ..fleet import elastic as _elastic
+            if not _elastic.dormant():
+                doc["elastic"] = _elastic.elastic_stats()
+        except Exception:  # elastic optional in this build
+            pass
         doc["drain"] = self.drain.stats()
         doc["cancel"] = cancel_stats()
         doc["pressure"] = _pressure.default_monitor().stats()

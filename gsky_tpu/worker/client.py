@@ -126,6 +126,8 @@ class WorkerClient:
                 # a couple of health-probe beats, not after gRPC's
                 # default reconnect backoff (which grows to 2 minutes)
                 ("grpc.max_reconnect_backoff_ms", 3000)]
+        self._grpc_opts = opts
+        self._conc_per_node = conc_per_node
         self._channels = [grpc.insecure_channel(n, options=opts)
                           for n in nodes]
         self._stubs = [ch.unary_unary(
@@ -143,6 +145,10 @@ class WorkerClient:
         self._max_msg = max_msg
         self._closed = False
         self._close_lock = threading.Lock()
+        # guards membership swaps (elastic scale/replace); dispatch
+        # reads one consistent snapshot instead of holding it
+        self._membership_lock = threading.Lock()
+        self._listened: set = set()
         # jittered backoff for an all-nodes-busy fleet (NodeBusy): the
         # work queues drain in tens of ms, so short delays suffice
         self._busy_policy = RetryPolicy(max_attempts=3, base_delay=0.05,
@@ -151,6 +157,7 @@ class WorkerClient:
         self.fleet = FleetRouter(nodes, name="worker", probe=self._probe)
         for i, br in enumerate(self._breakers):
             br.add_listener(self._make_breaker_listener(nodes[i]))
+            self._listened.add(nodes[i])
         if len(nodes) > 1 and self.fleet.monitor.interval_s > 0:
             self.fleet.monitor.start()
         # persistent fan-out pool: sized to the RPC concurrency cap so
@@ -160,6 +167,77 @@ class WorkerClient:
             thread_name_prefix="gsky-warp-rpc")
 
     # -- fleet plumbing ------------------------------------------------------
+
+    def _snapshot(self):
+        """One consistent (nodes, stubs, breakers, index) view: the
+        lists are rebuilt wholesale on membership change, never mutated
+        in place, so a snapshot taken here stays internally aligned for
+        the whole dispatch even while the elastic fleet rewires."""
+        with self._membership_lock:
+            return self.nodes, self._stubs, self._breakers, self._index
+
+    def set_nodes(self, addrs: Sequence[str]) -> None:
+        """Rewire membership live (elastic fleet scale-up/down/replace,
+        docs/FLEET.md "Elastic fleet"): dial channels for new nodes,
+        retire departed ones, and reconcile the ring + health monitor —
+        purging the departed nodes' router state so churn cannot grow
+        unbounded maps.  In-flight RPCs on a retired channel surface as
+        transport failures and fail over like any node death."""
+        import grpc
+
+        addrs = list(dict.fromkeys(addrs))
+        if not addrs:
+            raise ValueError("no worker nodes")
+        added: List[str] = []
+        removed: List = []
+        with self._membership_lock:
+            if self._closed or set(addrs) == set(self.nodes):
+                return
+            keep = set(addrs)
+            old_index = self._index
+            nodes: List[str] = []
+            channels, stubs, breakers = [], [], []
+            for n in addrs:
+                i = old_index.get(n)
+                if i is not None:
+                    channels.append(self._channels[i])
+                    stubs.append(self._stubs[i])
+                    breakers.append(self._breakers[i])
+                else:
+                    ch = grpc.insecure_channel(n, options=self._grpc_opts)
+                    channels.append(ch)
+                    stubs.append(ch.unary_unary(
+                        METHOD,
+                        request_serializer=pb.Task.SerializeToString,
+                        response_deserializer=pb.Result.FromString))
+                    breakers.append(get_breaker(f"worker:{n}"))
+                    added.append(n)
+                nodes.append(n)
+            removed = [(n, self._channels[i])
+                       for n, i in old_index.items() if n not in keep]
+            self.nodes = nodes
+            self._channels = channels
+            self._stubs = stubs
+            self._breakers = breakers
+            self._index = {n: i for i, n in enumerate(nodes)}
+            for n in added:
+                # breakers are shared process-wide by address: only the
+                # first membership of a node hooks this client's listener
+                if n not in self._listened:
+                    self._listened.add(n)
+                    breakers[self._index[n]].add_listener(
+                        self._make_breaker_listener(n))
+        self.fleet.set_nodes(addrs)
+        for _, ch in removed:
+            try:
+                ch.close()
+            except Exception:  # channel already closed
+                pass
+        if len(addrs) > 1 and self.fleet.monitor.interval_s > 0:
+            self.fleet.monitor.start()   # idempotent
+        log.info("fleet membership: %d node(s) (+%d/-%d), generation %d",
+                 len(addrs), len(added), len(removed),
+                 self.fleet.ring.generation)
 
     def _make_breaker_listener(self, node: str):
         def on_change(br, old, new):
@@ -180,10 +258,13 @@ class WorkerClient:
         or a tripped crash-loop breaker is an explicit fatal report."""
         if self._closed:
             return False
-        i = self._index[node]
+        _, stubs, _, index = self._snapshot()
+        i = index.get(node)
+        if i is None:
+            return False     # departed between probe list and now
         try:
-            res = self._stubs[i](pb.Task(operation="worker_info"),
-                                 timeout=5.0)
+            res = stubs[i](pb.Task(operation="worker_info"),
+                           timeout=5.0)
         except Exception:
             return False
         info = self._info(res)
@@ -271,12 +352,13 @@ class WorkerClient:
             return self._dispatch(task, route_key)
 
     def _dispatch(self, task: pb.Task, route_key: Optional[str]) -> pb.Result:
-        n = len(self._stubs)
+        nodes_l, stubs, breakers, index = self._snapshot()
+        n = len(stubs)
         keyed = (route_key is not None and self.fleet.enabled and n > 1)
         if keyed:
-            order = [self._index[m]
+            order = [index[m]
                      for m in self.fleet.candidates(route_key)
-                     if m in self._index]
+                     if m in index]
         else:
             start = next(self._rr)
             order = [(start + k) % n for k in range(n)]
@@ -296,10 +378,10 @@ class WorkerClient:
                 # a cancelled request must not start (or fail over to)
                 # another RPC attempt
                 tok.check("rpc")
-            br = self._breakers[i]
+            br = breakers[i]
             if not br.allow():
                 continue
-            node = self.nodes[i]
+            node = nodes_l[i]
             started = node        # in-flight load is per dispatch target
             self.fleet.task_started(started)
             try:
@@ -310,16 +392,17 @@ class WorkerClient:
                     if (pos == 0 and keyed and self.fleet.hedge_enabled
                             and len(order) > 1):
                         res, hedge_won = self._call_hedged(
-                            task, i, order[1], timeout, md)
+                            task, i, order[1], timeout, md,
+                            nodes_l, stubs, breakers)
                         if hedge_won:
                             i = order[1]
-                            br = self._breakers[i]
-                            node = self.nodes[i]
+                            br = breakers[i]
+                            node = nodes_l[i]
                             rsp.set(node=node, hedge_won=True)
                             _note("hedge_won", node=node)
                     else:
-                        res = self._call_cancellable(i, task, timeout,
-                                                     md, tok)
+                        res = self._call_cancellable(stubs[i], task,
+                                                     timeout, md, tok)
                 dt = time.monotonic() - t0
             except Exception as e:
                 br.record_failure()
@@ -405,7 +488,7 @@ class WorkerClient:
             f"all {n} worker node(s) failed (last: {last})",
             site="worker") from last
 
-    def _call_cancellable(self, i: int, task: pb.Task, timeout: float,
+    def _call_cancellable(self, stub, task: pb.Task, timeout: float,
                           md, tok) -> pb.Result:
         """One RPC that honours the request's cancel token end-to-end:
         the token fires ``fut.cancel()``, gRPC propagates the abort to
@@ -414,9 +497,9 @@ class WorkerClient:
         as :class:`RequestCancelled` — a BaseException, so the breaker
         records neither success nor failure for work WE abandoned."""
         if tok is None:
-            return self._stubs[i](task, timeout=timeout, metadata=md)
+            return stub(task, timeout=timeout, metadata=md)
         import grpc
-        fut = self._stubs[i].future(task, timeout=timeout, metadata=md)
+        fut = stub.future(task, timeout=timeout, metadata=md)
         unhook = tok.on_cancel(lambda: fut.cancel())
         try:
             return fut.result()
@@ -427,34 +510,37 @@ class WorkerClient:
             unhook()
 
     def _call_hedged(self, task: pb.Task, i: int, j: int,
-                     timeout: float, md=None) -> Tuple[pb.Result, bool]:
+                     timeout: float, md=None,
+                     nodes_l=None, stubs=None, breakers=None
+                     ) -> Tuple[pb.Result, bool]:
         """First-candidate dispatch with a straggler hedge onto node
         ``j``.  The hedge consumes a *spare* limiter permit (or does not
         fire), spends one hedge-budget token, and whichever copy loses
         is cancelled — its permit freed immediately."""
+        if stubs is None:
+            nodes_l, stubs, breakers, _ = self._snapshot()
         fl = self.fleet
         permit = [False]
 
         def primary():
             fl.hedge.on_primary()
-            return self._stubs[i].future(task, timeout=timeout,
-                                         metadata=md)
+            return stubs[i].future(task, timeout=timeout, metadata=md)
 
         def hedge():
             # raising here just means "no hedge" to hedged_call
             if self._closed:
                 raise RuntimeError("client closed")
-            if not self._breakers[j].allow():
+            if not breakers[j].allow():
                 raise RuntimeError("hedge target circuit-open")
             if not fl.hedge.try_hedge():
                 raise RuntimeError("hedge budget exhausted")
             if not self.limiter.try_acquire():
                 raise RuntimeError("no spare permit for hedge")
             permit[0] = True
-            _note("hedge", node=self.nodes[j])
+            _note("hedge", node=nodes_l[j])
             try:
-                return self._stubs[j].future(task, timeout=timeout,
-                                             metadata=md)
+                return stubs[j].future(task, timeout=timeout,
+                                       metadata=md)
             except Exception:
                 permit[0] = False
                 self.limiter.release()
@@ -502,8 +588,9 @@ class WorkerClient:
             self.fleet.node_result(node, ok=True,
                                    draining=self._draining(r))
             return r.worker
+        nodes_l, stubs, breakers, _ = self._snapshot()
         infos = list(self._fanout.map(
-            one, zip(self.nodes, self._stubs, self._breakers)))
+            one, zip(nodes_l, stubs, breakers)))
         return [i for i in infos if i is not None]
 
     def warp(self, granule: Granule, dst_gt: GeoTransform, dst_crs: CRS,

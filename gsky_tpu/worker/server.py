@@ -26,6 +26,8 @@ import math
 import os
 import signal
 import threading
+import time
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -83,6 +85,29 @@ class WorkerService:
         self.drain = DrainController("worker-node")
         from ..pipeline.executor import WarpExecutor
         self.executor = WarpExecutor()
+        # elastic-fleet lifecycle (fleet/elastic.py): preemption state
+        # + warm-handoff bookkeeping.  advertise_addr is how THIS node
+        # names itself to peers (set by main() / GSKY_ELASTIC_SELF).
+        self.advertise_addr: Optional[str] = \
+            os.environ.get("GSKY_ELASTIC_SELF") or None
+        self._preempt_lock = threading.Lock()
+        self.preempted = False
+        self.preempt_exit = None        # graceful: unpark main()
+        self.preempt_exit_hard = None   # nograce: take the process
+        self._handoff = {"entries": 0, "filled": 0, "cold": 0,
+                         "active": 0}
+        self._warm_cache = (0.0, None)  # (monotonic ts, journal want)
+        # a node:preempt fault is delivered through the real protocol,
+        # not a bespoke test path; weakref so a dropped in-process
+        # service doesn't live on inside the faults module
+        ref = weakref.ref(self)
+
+        def _on_preempt(grace_s: float, graceful: bool) -> None:
+            svc = ref()
+            if svc is not None:
+                svc.begin_preemption(grace_s, graceful=graceful)
+
+        faults.set_preempt_handler(_on_preempt)
 
     # -- ops -----------------------------------------------------------------
 
@@ -121,13 +146,25 @@ class WorkerService:
                 # answered even while draining: this IS the drain
                 # handshake the fleet health monitor reads
                 return self._worker_info()
+            if op == "preempt":
+                # control plane, answered inline: the notice must land
+                # on a node that is busy (that's the point)
+                return self._preempt(task)
+            if op == "journal_handoff":
+                # likewise: a successor may be receiving while its own
+                # admission picture is grim — inheritance is not work
+                return self._journal_handoff(task)
+            if op == "page_fetch":
+                # outside the drain gate deliberately: a draining
+                # (preempted) node serving its resident pages to the
+                # successor during the grace window IS the warm
+                # handoff — refusing it would force a cold restage
+                return self._page_fetch(task)
             with self.drain.track():
                 if op == "warp":
                     return self._warp(task, ctx)
                 if op == "drill":
                     return self._drill(task)
-                if op == "page_fetch":
-                    return self._page_fetch(task)
                 if op in ("extent", "info", "decode"):
                     return self.pool.submit(task)
                 return pb.Result(error=f"unknown operation {op!r}")
@@ -176,8 +213,250 @@ class WorkerService:
                 info["pages"] = pages._default.stats()
         except Exception:  # no page pool in this build
             pass
+        try:
+            info["elastic"] = self._elastic_info()
+        except Exception:  # readiness is advisory; the probe still answers
+            pass
         r.info_json = json.dumps(info)
         return r
+
+    # -- elastic lifecycle (fleet/elastic.py; docs/FLEET.md) -----------------
+
+    def _elastic_info(self) -> dict:
+        """Readiness + handoff block of the ``worker_info`` probe: the
+        autoscaler's join gate reads ``ready``; ``warm_fraction`` is
+        the share of the journal's hot set already resident in this
+        node's page pool (1.0 when there is nothing to warm)."""
+        from ..fleet import elastic
+        from ..pipeline import pages
+        pool = pages._default
+        want = self._journal_want()
+        resident = 0
+        capacity = 0
+        if pool is not None:
+            try:
+                st = pool.stats()
+                resident = int(st.get("resident", 0))
+                capacity = int(st.get("capacity", 0))
+            except Exception:  # pool mid-teardown: report cold
+                pass
+        if want <= 0:
+            warm = 1.0
+        else:
+            goal = min(want, capacity) if capacity else want
+            warm = min(1.0, resident / max(goal, 1))
+        from .. import fabric
+        can_warm = fabric.pages_enabled()
+        ready = (not can_warm) or warm >= elastic.warm_fraction_target()
+        with self._preempt_lock:
+            handoff = dict(self._handoff)
+            preempted = self.preempted
+        return {"ready": bool(ready),
+                "warm_fraction": round(warm, 4),
+                "prewarm_done": True,
+                "preempted": preempted,
+                "handoff": handoff}
+
+    def _journal_want(self) -> int:
+        """Journal hot-set size, cached a few seconds — the probe fires
+        every heartbeat and replay() re-reads the whole file."""
+        now = time.monotonic()
+        ts, cached = self._warm_cache
+        if cached is not None and now - ts < 5.0:
+            return cached
+        want = 0
+        try:
+            from ..device_guard import journal
+            if journal.journal_enabled():
+                want = len(journal.replay())
+        except Exception:
+            want = 0
+        self._warm_cache = (now, want)
+        return want
+
+    def _preempt(self, task: pb.Task) -> pb.Result:
+        """The preemption notice (autoscaler scale-down, or the soak
+        playing the cloud's spot reclaim): start the drain + warm
+        journal handoff under the grace deadline.  Idempotent."""
+        try:
+            doc = json.loads(task.path or "{}")
+        except ValueError:
+            doc = {}
+        grace = doc.get("grace_s")
+        from ..fleet import elastic
+        grace_s = float(grace) if grace is not None \
+            else elastic.preempt_grace_s()
+        self.begin_preemption(
+            grace_s, graceful=bool(doc.get("graceful", True)),
+            successor=doc.get("successor") or None,
+            peers=[p for p in (doc.get("peers") or [])
+                   if isinstance(p, str)])
+        r = pb.Result()
+        r.info_json = json.dumps({"ok": True, "grace_s": grace_s})
+        return r
+
+    def begin_preemption(self, grace_s: float, graceful: bool = True,
+                         successor: Optional[str] = None,
+                         peers=()) -> bool:
+        """First notice wins; later notices (a retried RPC, a second
+        fault roll) are no-ops.  Returns True when this call started
+        the preemption."""
+        with self._preempt_lock:
+            if self.preempted:
+                return False
+            self.preempted = True
+        threading.Thread(
+            target=self._run_preemption,
+            args=(max(float(grace_s), 0.0), graceful, successor,
+                  list(peers)),
+            daemon=True, name="gsky-preempt").start()
+        return True
+
+    def _run_preemption(self, grace_s, graceful, successor, peers):
+        from ..fleet import elastic
+        deadline = time.monotonic() + grace_s
+        elastic.note_preemption(graceful and grace_s > 0)
+        if not graceful or grace_s <= 0:
+            # zero grace: flush what a local restart can use, then go
+            log.warning("preemption (no grace): flushing journal")
+            self._flush_pool_journal()
+            hard = self.preempt_exit_hard or self.preempt_exit
+            if hard is not None:
+                hard()
+            return
+        log.info("preemption notice: grace=%.1fs successor=%s",
+                 grace_s, successor or "-")
+        self.drain.start_drain()
+        self._ship_journal(successor, peers,
+                           timeout=max(min(grace_s * 0.5, 5.0), 0.5))
+        left = deadline - time.monotonic() - 0.25
+        ok = self.drain.wait_drained(max(left, 0.0))
+        if not ok:
+            # hard grace deadline: fail over the stragglers explicitly
+            # (counted; their callers see a transport failure, which
+            # the fleet router retries on another node)
+            n = self.drain.abandon_inflight()
+            log.warning("preemption grace expired with %d in flight; "
+                        "failing them over", n)
+        self._flush_pool_journal()
+        st = self.drain.stats()
+        log.info("preemption drain done: completed=%d refused=%d "
+                 "abandoned=%d", st["completed"], st["refused"],
+                 st["abandoned"])
+        # hold until the grace deadline even when the drain finished
+        # early: the successor is still pulling our pages over
+        # page_fetch, and the fleet's health probes need at least one
+        # beat of the draining state to classify this departure as a
+        # preemption rather than a crash
+        left = deadline - time.monotonic() - 0.1
+        if left > 0:
+            time.sleep(left)
+        if self.preempt_exit is not None:
+            self.preempt_exit()
+
+    def _ship_journal(self, successor, peers, timeout: float) -> None:
+        """Ship this node's hot-set journal (heat scores included) to
+        its ring successor so the pages can be pulled from our HBM
+        while the grace window keeps us alive."""
+        from ..fleet import elastic
+        try:
+            from ..device_guard import journal
+            entries = journal.export_hot(elastic.handoff_max())
+        except Exception:
+            entries = []
+        if successor is None and self.advertise_addr:
+            successor = elastic.successor_for(self.advertise_addr, peers)
+        if not entries or not successor:
+            return
+        doc = {"v": 1, "source": self.advertise_addr,
+               "peers": [p for p in peers if p != successor],
+               "entries": [[s, pi, pj, round(score, 3)]
+                           for s, pi, pj, score in entries]}
+        try:
+            elastic.control_rpc(successor, "journal_handoff", doc,
+                                timeout=timeout)
+            elastic.note_handoff_shipped(len(entries), True)
+            log.info("journal handoff: %d entries -> %s",
+                     len(entries), successor)
+        except Exception:
+            elastic.note_handoff_shipped(len(entries), False)
+            log.warning("journal handoff to %s failed", successor)
+
+    def _flush_pool_journal(self) -> None:
+        """Dump the pool's in-memory heat to the journal (the teardown
+        path already writes heat lines) so even an abandoned exit
+        leaves a replayable hot set behind."""
+        try:
+            from ..pipeline import pages
+            if pages._default is not None:
+                pages._default.teardown()
+        except Exception:
+            log.exception("journal flush on preemption failed")
+
+    def _journal_handoff(self, task: pb.Task) -> pb.Result:
+        """Successor half of the warm handoff: merge the preempted
+        node's scored hot set into our journal, then pull the pages
+        hottest-first from its still-alive HBM (and the other peers)
+        over the page RPC — in the background; the notice must return
+        within the sender's grace window."""
+        from ..device_guard import journal
+        from ..fleet import elastic
+        try:
+            doc = json.loads(task.path or "{}")
+        except ValueError:
+            return pb.Result(error="elastic: malformed handoff")
+        entries = []
+        for e in doc.get("entries") or []:
+            try:
+                s, pi, pj = int(e[0]), int(e[1]), int(e[2])
+                score = float(e[3]) if len(e) > 3 else 1.0
+            except (TypeError, ValueError, IndexError):
+                continue
+            if pi < 0 or pj < 0:      # same guard as merge_scored
+                continue
+            entries.append((s, pi, pj, score))
+        entries = entries[:elastic.handoff_max()]
+        journal.merge_scored(entries)
+        self._warm_cache = (0.0, None)   # hot set just grew
+        source = doc.get("source") or None
+        peers = [p for p in (doc.get("peers") or [])
+                 if isinstance(p, str) and p != self.advertise_addr]
+        with self._preempt_lock:
+            self._handoff["entries"] += len(entries)
+            self._handoff["active"] += 1
+        threading.Thread(
+            target=self._handoff_fill, args=(entries, source, peers),
+            daemon=True, name="gsky-handoff-fill").start()
+        r = pb.Result()
+        r.info_json = json.dumps({"accepted": len(entries)})
+        return r
+
+    def _handoff_fill(self, entries, source, peers):
+        from .. import fabric
+        from ..fleet import elastic
+        filled = 0
+        keys = [(s, pi, pj) for s, pi, pj, _ in entries]
+        try:
+            if fabric.pages_enabled() and keys:
+                from ..fabric import pagerpc
+                from ..pipeline.pages import default_page_pool
+                pool = default_page_pool()
+                missing = [k for k in keys if not pool.has_page(*k)]
+                already = len(keys) - len(missing)
+                fill_peers = [p for p in ([source] + peers) if p]
+                filled = already + pagerpc.fill_from_peers(
+                    pool, missing, peers=fill_peers, prefer=source)
+        except Exception:
+            log.exception("handoff fill failed")
+        cold = len(keys) - filled
+        elastic.note_handoff_pages("peer", filled)
+        elastic.note_handoff_pages("cold", cold)
+        with self._preempt_lock:
+            self._handoff["filled"] += filled
+            self._handoff["cold"] += cold
+            self._handoff["active"] -= 1
+        log.info("handoff fill: %d/%d pages from peers", filled,
+                 len(keys))
 
     def _page_fetch(self, task: pb.Task) -> pb.Result:
         """Cache-fabric page RPC (docs/FABRIC.md): read requested
@@ -393,6 +672,11 @@ def main(argv=None):
                     "computing on CPU", plat["probe_attempts"])
 
     svc = WorkerService(pool_size=a.pool or None, task_timeout=a.timeout)
+    if not svc.advertise_addr:
+        # how peers reach us for the page RPC / journal handoff; wildcard
+        # listen addresses advertise loopback (single-host fleets)
+        host = "127.0.0.1" if a.host in ("[::]", "0.0.0.0") else a.host
+        svc.advertise_addr = f"{host}:{a.port}"
     monitor = None
     if a.oom_threshold:
         def _oom_killed(pid: int) -> None:
@@ -441,15 +725,30 @@ def main(argv=None):
     # then the server exits.  A supervisor that can't wait will SIGKILL
     # after its own grace period; GSKY_DRAIN_TIMEOUT_S bounds ours.
     stop = threading.Event()
+    # preemption notices (the `preempt` RPC or a node:preempt fault)
+    # exit through the same park-loop as a signal drain; a no-grace
+    # preemption takes the process the way the reclaim would
+    svc.preempt_exit = stop.set
+    svc.preempt_exit_hard = lambda: os._exit(1)
 
     def _drain():
         svc.drain.start_drain()
         timeout = float(os.environ.get("GSKY_DRAIN_TIMEOUT_S", "30") or 30)
         ok = svc.drain.wait_drained(timeout)
+        if not ok:
+            # grace deadline: fail over the stragglers explicitly
+            # (counted) instead of silent in-flight loss, and flush
+            # the page journal so the restart replays warm
+            n = svc.drain.abandon_inflight()
+            log.warning("drain timed out with %d in flight; "
+                        "failing them over", n)
+            svc._flush_pool_journal()
         st = svc.drain.stats()
-        log.info("drain %s: completed=%d refused=%d inflight=%d",
+        log.info("drain %s: completed=%d refused=%d inflight=%d "
+                 "abandoned=%d",
                  "complete" if ok else "TIMED OUT",
-                 st["completed"], st["refused"], st["inflight"])
+                 st["completed"], st["refused"], st["inflight"],
+                 st["abandoned"])
         stop.set()
 
     def _on_term(signum, frame):

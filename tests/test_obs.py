@@ -950,3 +950,64 @@ def test_ingest_series_roundtrip_strict_parser():
         assert got == pytest.approx(0.1 / 0.3, rel=1e-4)
     finally:
         ingest_stats.reset()
+
+
+def test_elastic_families_render_parse_roundtrip():
+    """The elastic-fleet families — node-state gauge, direction-labelled
+    decision counter, graceful-labelled preemption counter, and the
+    source-labelled handoff-page counter — round-trip the strict
+    parser, and are ABSENT while the subsystem is dormant so a fixed
+    fleet's exposition stays byte-identical."""
+    from gsky_tpu.fleet import elastic
+    from gsky_tpu.obs.metrics import render_metrics
+
+    elastic.reset_stats()
+    base = parse_exposition(render_metrics())
+    for fam in ("gsky_elastic_nodes", "gsky_elastic_decisions_total",
+                "gsky_preemptions_total", "gsky_handoff_pages_total"):
+        assert fam not in base                 # dormant: absent
+
+    class _Scaler:                             # quacks like Autoscaler
+        name = "t-obs"
+
+        def node_counts(self):
+            return {"active": 3, "pending": 1, "leaving": 0}
+
+    scaler = _Scaler()                         # keep alive: WeakSet
+    elastic.register_autoscaler(scaler)
+    elastic.note_decision("up")
+    elastic.note_decision("up")
+    elastic.note_decision("down")
+    elastic.note_preemption(graceful=True)
+    elastic.note_preemption(graceful=False)
+    elastic.note_handoff_pages("peer", 40)
+    elastic.note_handoff_pages("cold", 8)
+    try:
+        fams = parse_exposition(render_metrics())
+
+        def val(fam, labels=()):
+            return fams[fam]["samples"].get((fam, labels))
+
+        ng = "gsky_elastic_nodes"
+        assert fams[ng]["type"] == "gauge"
+        assert val(ng, (("state", "active"),)) == 3.0
+        assert val(ng, (("state", "pending"),)) == 1.0
+        dc = "gsky_elastic_decisions_total"
+        assert fams[dc]["type"] == "counter"
+        assert val(dc, (("dir", "up"),)) == 2.0
+        assert val(dc, (("dir", "down"),)) == 1.0
+        pc = "gsky_preemptions_total"
+        assert val(pc, (("graceful", "true"),)) == 1.0
+        assert val(pc, (("graceful", "false"),)) == 1.0
+        hp = "gsky_handoff_pages_total"
+        assert val(hp, (("source", "peer"),)) == 40.0
+        assert val(hp, (("source", "cold"),)) == 8.0
+    finally:
+        elastic.reset_stats()
+    # counters zeroed and the scaler garbage-collectable -> dormant
+    # again once the registry drops it (WeakSet); force it
+    import gc
+    del scaler
+    gc.collect()
+    after = parse_exposition(render_metrics())
+    assert "gsky_elastic_decisions_total" not in after

@@ -893,12 +893,11 @@ def test_inflight_rpc_future_cancelled_by_token():
 
     c = WorkerClient.__new__(WorkerClient)   # no channels needed
     stub = FakeStub()
-    c._stubs = [stub]
     with cancel_scope() as tok:
         threading.Timer(0.05, tok.cancel, ("disconnect",)).start()
         t0 = time.monotonic()
         with pytest.raises(RequestCancelled):
-            c._call_cancellable(0, pb.Task(operation="warp"), 1.0,
+            c._call_cancellable(stub, pb.Task(operation="warp"), 1.0,
                                 None, tok)
         assert time.monotonic() - t0 < 2.0
         assert stub.fut.cancelled_

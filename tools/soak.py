@@ -197,6 +197,26 @@ ZERO pinned pages, and /metrics exposing the ``gsky_wave_gap_ms`` /
 ``gsky_wave_staged_total`` families through the strict parser.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario occupancy --seconds 20
+
+``--scenario elastic``: elastic fleet (docs/FLEET.md "Elastic
+fleet").  A two-node preemptible fleet behind the autoscaler control
+loop (local-subprocess provider): a load ramp that doubles traffic
+must push the smoothed demand signal past the scale-up threshold and
+launch capacity that joins the ring only after the warm-readiness
+probe; two nodes are then preempted mid-ramp with a short grace
+window, and each must drain, ship its scored page-residency journal
+to its ring successor, and have at least half of the inherited hot
+set refilled from peer HBM over page RPC rather than cold-staged;
+the floor is refilled without cooldown; a quiet trickle phase must
+produce at least one scale-down.  Pass criteria: zero bare 5xx or
+dropped connections across every phase, post-preemption p99 within
+budget, >= 1 scale-up and >= 1 scale-down decision, a readiness-gated
+join observed, the handoff peer-refill ratio >= 50%, a
+``GSKY_ELASTIC=0`` leg whose fixed-fleet responses are byte-identical
+with no elastic families in /metrics and no /debug block, and a
+strict /metrics parse with the elastic families present::
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario elastic --seconds 30
 """
 
 from __future__ import annotations
@@ -284,7 +304,7 @@ def _run(argv=None):
                     choices=("churn", "hot", "wcs", "chaos", "burst",
                              "fleet", "overload", "ingest",
                              "devicechaos", "wave", "mesh", "plan",
-                             "fabric", "occupancy"),
+                             "fabric", "occupancy", "elastic"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -444,6 +464,8 @@ def _run(argv=None):
         return run_fabric(args, watcher, mas_client, merc, boot)
     if args.scenario == "occupancy":
         return run_occupancy(args, watcher, mas_client, merc, boot)
+    if args.scenario == "elastic":
+        return run_elastic(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -3338,6 +3360,402 @@ def run_fabric(args, watcher, mas_client, merc, boot) -> int:
                 proc.kill()
             except Exception:  # process already exited
                 pass
+
+
+def run_elastic(args, watcher, mas_client, merc, boot) -> int:
+    """Elastic fleet: the autoscaler control loop over a preemptible
+    local-subprocess fleet — load ramp -> readiness-gated scale-up,
+    two mid-ramp preemptions with a short grace (drain + scored
+    journal handoff + >= 50% peer page refill), floor refill, quiet
+    trickle -> scale-down, and a GSKY_ELASTIC=0 byte-identity leg
+    (see module docstring for the pass criteria)."""
+    import gc
+    import threading
+
+    import numpy as np
+
+    from gsky_tpu.fleet import elastic
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+    from gsky_tpu.serving import ServingGateway
+    from gsky_tpu.worker.server import WorkerService, make_grpc_server
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    conf_dir = watcher.root
+    data_root = os.path.dirname(conf_dir)
+    journal = os.path.join(data_root, "elastic-journal.jsonl")
+    # same fabric recipe as the fabric scenario: shared pool journal +
+    # interpret-mode pallas make pages worth handing off; 1s probes so
+    # the monitor sees a draining node within a couple of beats
+    os.environ["GSKY_FABRIC"] = "1"
+    os.environ["GSKY_POOL_JOURNAL"] = journal
+    os.environ.setdefault("GSKY_PALLAS", "interpret")
+    os.environ["GSKY_ELASTIC"] = "1"
+    os.environ.setdefault("GSKY_FLEET_PROBE_S", "1.0")
+    os.environ.setdefault("GSKY_FLEET_BOUND", "2.5")
+
+    # an in-process page server fronts THIS process's page pool (the
+    # worker-less default namespace renders here and stages the seed
+    # set) so handoff refills and warm boots have a live page peer
+    peer_port = elastic.LocalSubprocessProvider.free_port()
+    peer_addr = f"127.0.0.1:{peer_port}"
+
+    provider = elastic.LocalSubprocessProvider(
+        extra_env={"PYTHONPATH": repo, "JAX_PLATFORMS": "cpu",
+                   "GSKY_FABRIC": "1", "GSKY_POOL_JOURNAL": journal,
+                   "GSKY_PALLAS": os.environ["GSKY_PALLAS"],
+                   "GSKY_FABRIC_PAGE_PEERS": peer_addr},
+        pool_size=1, log_dir=data_root)
+    autoscaler = None
+    peer_srv = None
+    try:
+        initial = [provider.launch() for _ in range(2)]
+        boot_deadline = time.time() + 600
+        for addr in initial:
+            while time.time() < boot_deadline:
+                if not provider.alive(addr):
+                    break
+                if elastic.probe_info(addr) is not None:
+                    break
+                time.sleep(0.5)
+            if elastic.probe_info(addr) is None:
+                print(json.dumps({"scenario": "elastic",
+                                  "error": f"{addr} never came up"}))
+                print("SOAK FAILED", flush=True)
+                return 1
+
+        import bench as B
+        ns_dir = os.path.join(conf_dir, "elastic")
+        os.makedirs(ns_dir, exist_ok=True)
+        with open(os.path.join(ns_dir, "config.json"), "w") as fp:
+            json.dump({
+                "service_config": {"ows_hostname": "", "mas_address": "",
+                                   "worker_nodes": initial},
+                "layers": [{
+                    "name": "landsat_elastic", "title": "elastic soak",
+                    "data_source": data_root,
+                    "rgb_products": [f"LC08_20200{110 + k}_T1"
+                                     for k in range(B.N_SCENES)],
+                    "time_generator": "mas",
+                    "wms_timeout": 120,
+                    "wcs_max_width": 4096, "wcs_max_height": 4096,
+                    "wcs_max_tile_width": 256,
+                    "wcs_max_tile_height": 256}],
+            }, fp)
+        watcher.reload()
+
+        server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                           metrics=MetricsLogger(),
+                           gateway=ServingGateway())
+        host = boot(server)
+
+        grid = 3
+        frac = np.linspace(0.0, 0.75, grid)
+        tiles = [(float(fx), float(fy)) for fx in frac for fy in frac]
+        w = merc.width * 0.25
+
+        def bbox_for(fx: float, fy: float) -> str:
+            return (f"{merc.xmin + fx * merc.width},"
+                    f"{merc.ymin + fy * merc.height},"
+                    f"{merc.xmin + fx * merc.width + w},"
+                    f"{merc.ymin + fy * merc.height + w}")
+
+        def url_for(fx: float, fy: float, salt: int = 0) -> str:
+            # salt shifts the bbox in steps of ~2 response-cache quanta
+            # (the key quantises to 1/256 px — see quantise_bbox): every
+            # driven request is a distinct cache key, so the load
+            # reaches the worker fleet and the demand signal sees it,
+            # while the few-pixel drift stays on the same staged pages
+            step = 0.25 / 256.0 / 128.0
+            fx += (salt % 997) * step
+            fy += (salt // 997 % 997) * step
+            return (f"http://{host}/ows/elastic?service=WMS"
+                    f"&request=GetMap&version=1.3.0"
+                    f"&layers=landsat_elastic&crs=EPSG:3857"
+                    f"&bbox={bbox_for(fx, fy)}&width=256&height=256"
+                    f"&format=image/png"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        def seed_url(fx: float, fy: float) -> str:
+            return (f"http://{host}/ows?service=WMS&request=GetMap"
+                    f"&version=1.3.0&layers=landsat&crs=EPSG:3857"
+                    f"&bbox={bbox_for(fx, fy)}&width=256&height=256"
+                    f"&format=image/png"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        def fetch(url: str):
+            """(class, body)."""
+            try:
+                with urllib.request.urlopen(url, timeout=180) as r:
+                    return "ok", r.read()
+            except urllib.error.HTTPError as e:
+                ctype = e.headers.get("Content-Type", "")
+                e.read()
+                if e.code == 500 or "vnd.ogc.se_xml" not in ctype:
+                    return "hard_5xx", b""
+                return "ogc_error", b""
+            except Exception:
+                return "transport", b""
+
+        # warm: first warp on each node pays jax import + XLA compiles
+        warm_end = time.time() + 420
+        while time.time() < warm_end:
+            if fetch(url_for(*tiles[0]))[0] == "ok":
+                break
+            time.sleep(2.0)
+
+        # seed the in-process pool + shared journal (twice: stage +
+        # heat), then expose it over the real page-fetch RPC
+        for fx, fy in tiles * 2:
+            fetch(seed_url(fx, fy))
+        peer_svc = WorkerService(pool_size=1)
+        peer_srv = make_grpc_server(peer_svc, f"127.0.0.1:{peer_port}")
+        peer_srv.start()
+
+        # the gateway's WorkerClient for the elastic namespace IS the
+        # routing surface being scaled
+        client = None
+        for _settings, pipe in server._pipelines.values():
+            if pipe.remote is not None:
+                client = pipe.remote
+        assert client is not None, "elastic namespace never dispatched"
+
+        autoscaler = elastic.Autoscaler(
+            provider, client, name="soak",
+            min_nodes=2, max_nodes=4, interval_s=0.5,
+            up=0.5, down=0.2, up_ticks=2, down_ticks=4,
+            cooldown_s=4.0, ready_timeout_s=150.0, drain_grace_s=8.0,
+            demand=elastic.DemandSignal(
+                admission=server.gateway.admission,
+                # per-node target of 1: soak renders are page-cache
+                # warm, so the worker RPC is a small slice of each
+                # request's wall time and sampled in-flight stays low
+                router=client.fleet, node_conc=1))
+        autoscaler.start()
+
+        counts: dict = {}
+        lats: dict = {"ramp": [], "preempt": [], "steady": []}
+        lock = threading.Lock()
+        counter = itertools.count()   # shared: no URL repeats across phases
+
+        def drive_bg(conc: int, phase: str):
+            """Background load at fixed concurrency until stopped."""
+            stop_ev = threading.Event()
+
+            def one(_):
+                i = next(counter)
+                t0 = time.time()
+                c, _b = fetch(url_for(*tiles[i % len(tiles)], salt=i))
+                dt = time.time() - t0
+                with lock:
+                    counts[c] = counts.get(c, 0) + 1
+                    if c == "ok":
+                        lats[phase].append(dt)
+
+            def loop():
+                with cf.ThreadPoolExecutor(conc) as ex:
+                    while not stop_ev.is_set():
+                        list(ex.map(one, range(conc)))
+
+            th = threading.Thread(target=loop, daemon=True)
+            th.start()
+            return stop_ev, th
+
+        def wait_for(pred, timeout_s: float) -> bool:
+            t_end = time.time() + timeout_s
+            while time.time() < t_end:
+                if pred():
+                    return True
+                time.sleep(1.0)
+            return bool(pred())
+
+        def joined() -> int:
+            return sum(1 for d in autoscaler.decisions
+                       if d["dir"] == "join")
+
+        # phase A: ramp — double traffic twice; the demand signal must
+        # cross the scale-up threshold and launch
+        ev, th = drive_bg(2, "ramp")
+        time.sleep(max(args.seconds * 0.1, 4.0))
+        ev.set()
+        th.join(30)
+        ev, th = drive_bg(4, "ramp")
+        time.sleep(max(args.seconds * 0.1, 4.0))
+        ev.set()
+        th.join(30)
+        ev, th = drive_bg(8, "ramp")
+        up_seen = wait_for(
+            lambda: any(d["dir"] == "up" for d in autoscaler.decisions),
+            60.0)
+        # keep ramp load on while the launch boots; membership join is
+        # gated on the warm-readiness probe
+        join_seen = wait_for(lambda: joined() >= 1, 300.0)
+        ev.set()
+        th.join(30)
+
+        # phase B: two preemptions mid-ramp, short grace, explicit
+        # successor.  Load stays on — every response must stay clean
+        ev, th = drive_bg(4, "preempt")
+        handoff_notes = []
+        for victim in initial:
+            # the victim must leave a live successor behind: wait for
+            # at least two ACTIVE members (joins, not just launches)
+            wait_for(lambda: len(client.nodes) >= 2, 300.0)
+            live = list(client.nodes)
+            if victim not in live:
+                break
+            succ = client.fleet.ring.successor(victim) or \
+                next((n for n in live if n != victim), None)
+            peers = [n for n in live if n != victim] + [peer_addr]
+            noticed = provider.preempt(victim, 6.0, successor=succ,
+                                       peers=peers)
+            gone = wait_for(lambda: victim not in client.nodes, 60.0)
+            handoff_notes.append({"victim": victim, "successor": succ,
+                                  "noticed": noticed, "purged": gone})
+        # recovery: the fleet must be back at (or above) the floor,
+        # with >= 3 nodes so the quiet phase has something to shed
+        refilled = wait_for(lambda: len(client.nodes) >= 2, 300.0)
+        wait_for(lambda: len(client.nodes) >= 3, 240.0)
+        ev.set()
+        th.join(30)
+
+        # aggregate the warm-handoff outcome across the surviving fleet
+        def handoff_totals() -> dict:
+            tot = {"entries": 0, "filled": 0, "cold": 0, "active": 0}
+            for n in list(client.nodes):
+                info = elastic.probe_info(n) or {}
+                h = (info.get("elastic") or {}).get("handoff") or {}
+                for k in tot:
+                    tot[k] += int(h.get(k, 0))
+            return tot
+
+        wait_for(lambda: (handoff_totals()["entries"] > 0
+                          and handoff_totals()["active"] == 0), 90.0)
+        handoff = handoff_totals()
+
+        # phase C: steady load on the recovered fleet (the p99 sample),
+        # then a quiet trickle that must produce a scale-down
+        ev, th = drive_bg(4, "steady")
+        time.sleep(max(args.seconds * 0.2, 8.0))
+        ev.set()
+        th.join(30)
+        down_seen = wait_for(
+            lambda: any(d["dir"] == "down"
+                        for d in autoscaler.decisions), 120.0)
+
+        # observability while the subsystem is live: strict exposition
+        # parse with the elastic families, and the /debug block
+        metrics = check_metrics(
+            host, require=("gsky_requests_total",
+                           "gsky_elastic_nodes",
+                           "gsky_elastic_decisions_total",
+                           "gsky_preemptions_total",
+                           "gsky_handoff_pages_total"))
+        with urllib.request.urlopen(f"http://{host}/debug",
+                                    timeout=30) as r:
+            debug_elastic = json.loads(r.read()).get("elastic")
+
+        decisions = list(autoscaler.decisions)
+        counters = elastic.counters()
+        ready_joins = [d for d in decisions
+                       if d["dir"] == "join" and d["reason"] == "ready"]
+        autoscaler.stop()
+        final_nodes = list(client.nodes)
+
+        # phase D: the escape hatch.  GSKY_ELASTIC=0 on a fixed fleet:
+        # same bytes as a server that never imported elastic, no
+        # elastic families in /metrics, no /debug block
+        os.environ["GSKY_ELASTIC"] = "0"
+        autoscaler = None                 # WeakSet registry drops it
+        elastic.reset_stats()
+        gc.collect()
+        # a retire thread may briefly keep the scaler referenced
+        t_end = time.time() + 30
+        while not elastic.dormant() and time.time() < t_end:
+            time.sleep(1.0)
+            elastic.reset_stats()
+            gc.collect()
+        host_off = boot(OWSServer(watcher,
+                                  mas_factory=lambda a: mas_client,
+                                  metrics=MetricsLogger(),
+                                  gateway=None))
+        host_plain = boot(OWSServer(watcher,
+                                    mas_factory=lambda a: mas_client,
+                                    metrics=MetricsLogger(),
+                                    gateway=None))
+        su = seed_url(*tiles[0])
+        c_off, body_off = fetch(su.replace(f"http://{host}",
+                                           f"http://{host_off}"))
+        c_plain, body_plain = fetch(su.replace(f"http://{host}",
+                                               f"http://{host_plain}"))
+        identical = (c_off == c_plain == "ok"
+                     and body_off == body_plain and len(body_off) > 0)
+        with urllib.request.urlopen(f"http://{host_off}/metrics",
+                                    timeout=30) as r:
+            off_expo = r.read().decode()
+        with urllib.request.urlopen(f"http://{host_off}/debug",
+                                    timeout=30) as r:
+            off_debug = json.loads(r.read())
+        off_dormant = ("gsky_elastic" not in off_expo
+                       and "gsky_preemptions" not in off_expo
+                       and "elastic" not in off_debug)
+
+        p99_budget_s = 90.0
+        p99 = {ph: (round(float(np.percentile(v, 99)), 3) if v
+                    else None) for ph, v in lats.items()}
+        out = {
+            "scenario": "elastic", "initial": initial,
+            "final_nodes": final_nodes,
+            "responses": counts, "p99_s": p99,
+            "decisions": [{k: d.get(k) for k in
+                           ("dir", "reason", "node")}
+                          for d in decisions],
+            "counters": counters,
+            "handoff": handoff, "handoff_notes": handoff_notes,
+            "ready_joins": len(ready_joins),
+            "elastic_off": {"identical": identical,
+                            "dormant": off_dormant},
+            "metrics": metrics,
+            "debug_elastic": bool(debug_elastic),
+        }
+        print(json.dumps(out))
+        ok = (counts.get("ok", 0) > 0
+              and counts.get("hard_5xx", 0) == 0
+              and counts.get("transport", 0) == 0
+              and up_seen and join_seen and down_seen
+              and counters["decisions"]["up"] >= 1
+              and counters["decisions"]["down"] >= 1
+              # readiness gate observed: at least one join waited for
+              # the warm probe rather than the deadline
+              and len(ready_joins) >= 1
+              and all(n["noticed"] and n["purged"]
+                      for n in handoff_notes)
+              and len(handoff_notes) == 2
+              # both injected preemptions observed; at least one was
+              # seen in its draining window (a starved host can miss
+              # the other's probe beat and classify it dead)
+              and (counters["preemptions"]["graceful"]
+                   + counters["preemptions"]["nograce"]) >= 2
+              and counters["preemptions"]["graceful"] >= 1
+              and refilled
+              # >= 50% of the inherited hot set came from peer HBM
+              and handoff["entries"] > 0
+              and handoff["filled"] >= handoff["cold"]
+              and lats["steady"]
+              and p99["steady"] is not None
+              and p99["steady"] < p99_budget_s
+              and identical and off_dormant
+              and not metrics["missing"]
+              and bool(debug_elastic))
+        print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+        return 0 if ok else 1
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        if peer_srv is not None:
+            peer_srv.stop(0)
+        provider.close()
+        os.environ["GSKY_ELASTIC"] = "0"
 
 
 if __name__ == "__main__":
