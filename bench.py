@@ -1168,6 +1168,296 @@ def bench_cfg_plan():
             os.environ.pop("GSKY_PALLAS", None)
 
 
+def _ulp_diff_f32(a, b):
+    """Element-wise f32 ULP distance (sign-magnitude int ordering)."""
+    ai = a.view(np.int32).astype(np.int64)
+    bi = b.view(np.int32).astype(np.int64)
+    ai = np.where(ai < 0, np.int64(-0x80000000) - ai, ai)
+    bi = np.where(bi < 0, np.int64(-0x80000000) - bi, bi)
+    return np.abs(ai - bi)
+
+
+def bench_cfg_algebra():
+    """Fused band-algebra A/B (GSKY_EXPR_FUSE, docs/KERNELS.md
+    "Expression epilogue"): an NDVI + ternary cloud-mask storm over a
+    two-band scene pair, rendered (a) UNFUSED — this repo's expression
+    leg before fusion: one per-call scored-mosaic dispatch per tile,
+    both bands' f32 planes handed to `evaluate_expressions`, then a
+    per-tile byte scale — and (b) FUSED — the same tiles as expression
+    wave lanes, grouped by structural fingerprint, each group ONE
+    paged program (warp + mosaic + traced expression epilogue + scale)
+    whose cross-band gather windows the autoplanner merges into
+    superblocks.  The mask storm varies its threshold per tile, so the
+    fused leg must prove distinct same-structure expressions share one
+    program.  Headlines: paged dispatches per 1000 tiles, gathered
+    pool->VMEM HBM bytes, and programs compiled per leg; acceptance
+    wants >= 50% reduction in BOTH dispatch and byte counts with f32
+    parity <= 2 ULP and byte-exact tiles after scale."""
+    import jax
+    import jax.numpy as jnp
+
+    from gsky_tpu.ops import paged
+    from gsky_tpu.ops.expr import (BandExpressions, compile_expr,
+                                   fingerprint)
+    from gsky_tpu.ops.scale import scale_to_byte
+    from gsky_tpu.pipeline import autoplan
+    from gsky_tpu.pipeline import waves as W
+    from gsky_tpu.pipeline.pages import PagePool
+    from gsky_tpu.pipeline.tile import evaluate_expressions
+
+    interp = jax.devices()[0].platform == "cpu"
+    prev_pallas = os.environ.get("GSKY_PALLAS")
+    prev_plan = os.environ.get("GSKY_PLAN")
+    prev_fuse = os.environ.get("GSKY_EXPR_FUSE")
+    if interp and not prev_pallas:
+        os.environ["GSKY_PALLAS"] = "interpret"
+    os.environ.pop("GSKY_PLAN", None)        # planner on: fused rides it
+    os.environ.pop("GSKY_EXPR_FUSE", None)
+    try:
+        B, S, h, w, step = 2, 512, 64, 64, 16
+        pr, pc = 64, 128
+        npr, npc = S // pr, S // pc              # 8 x 4 page grid
+        n_per = 16                               # tiles per expression
+        n_windows = 4                            # 2-page-row pan walk
+        rng = np.random.default_rng(29)
+        stack = rng.uniform(1.0, 4000.0, (B, S, S)).astype(np.float32)
+        stack[0, 70:110, 40:200] = np.nan        # nir cloud hole
+        stack[1, 90:140, 120:300] = np.nan       # red cloud hole
+        params = np.zeros((B, 11), np.float32)
+        for k in range(B):
+            params[k] = [0.4 * k - 0.2, 1.01, 0.02, 0.3 * k, -0.01,
+                         0.99, S, S, -999.0, 100.0 - k, k]
+        sp = np.array([10.0, 250.0, 0.0], np.float32)
+
+        # NDVI + a threshold storm: every mask tile is a DISTINCT
+        # source text but one structure — the fused leg's program
+        # count must stay at two
+        ndvi = "(nir - red) / (nir + red)"
+        masks = [f"nir > {1200.0 + 37.0 * i} ? red : nir"
+                 for i in range(n_per)]
+        srcs = [ndvi] * n_per + masks
+        n_tiles = len(srcs)
+        # granule k is variable k by first use in BOTH expressions, so
+        # the staged ns_id column doubles as the fingerprint slot id
+        fps = [fingerprint(compile_expr(s)) for s in srcs]
+        assert all(fp.slots == ("nir", "red") for fp in fps)
+
+        def grid_ctrl(wi):
+            lo = wi * pr + 6.0
+            hi = (wi + 2) * pr - 12.0
+            g = (h - 1 + step - 1) // step + 1
+            lin = np.linspace(lo, hi, g, dtype=np.float32)
+            return np.stack([lin[None, :].repeat(g, 0),
+                             lin[:, None].repeat(g, 1)])
+
+        wins = [i % n_windows for i in range(n_tiles)]
+        ctrls = [grid_ctrl(wi) for wi in wins]
+
+        def stage(pool, wi):
+            tabs = [pool.table_for(jnp.asarray(stack[k]), k + 1,
+                                   wi, wi + 1, 0, npc - 1)
+                    for k in range(B)]
+            Ssl = 1
+            while Ssl < max(t.size for t in tabs):
+                Ssl *= 2
+            tables = np.zeros((B, Ssl), np.int32)
+            p16 = np.zeros((B, paged.PARAMS_W), np.float32)
+            p16[:, :11] = params
+            for k, t in enumerate(tabs):
+                tables[k, :t.size] = t
+                p16[k, 11] = wi * pr
+                p16[k, 13] = 2 * pr
+                p16[k, 14] = npc * pc
+                p16[k, 15] = npc
+            return tables, p16
+
+        def bx(src):
+            ce = compile_expr(src)
+            return BandExpressions(
+                expressions=[ce], expr_names=["e0"],
+                var_list=list(ce.variables),
+                expr_var_ref=[list(ce.variables)],
+                expr_text=[src], passthrough=False)
+
+        def unfused_leg(pool):
+            """One scored paged dispatch per tile (both bands, f32
+            planes off-device), `evaluate_expressions`, byte scale —
+            the pre-fusion expression path, per call."""
+            paged.reset_gather_bytes()
+            outs, planes = [], []
+            t0 = time.perf_counter()
+            for i, src in enumerate(srcs):
+                tables, p16 = stage(pool, wins[i])
+                paged.note_gather(paged.table_gather_bytes(
+                    tables[None], pr, pc))
+                try:
+                    with pool.locked_pool() as parr:
+                        c, b = paged.warp_scored_paged(
+                            parr, jnp.asarray(tables[None]),
+                            jnp.asarray(p16),
+                            jnp.asarray(ctrls[i])[None], "near", B,
+                            (h, w), step,
+                            interpret=paged.pallas_interpret())
+                finally:
+                    pool.unpin(tables)
+                env = {"nir": c[0, 0], "red": c[0, 1]}
+                venv = {"nir": b[0, 0] > -jnp.inf,
+                        "red": b[0, 1] > -jnp.inf}
+                res = evaluate_expressions(bx(src), env, venv, h, w)
+                plane = jnp.asarray(res.data["e0"])
+                ok = jnp.asarray(res.valid["e0"])
+                planes.append((np.asarray(plane), np.asarray(ok)))
+                outs.append(np.asarray(scale_to_byte(
+                    plane[None], ok[None], float(sp[0]), float(sp[1]),
+                    float(sp[2]), 0, True)[0]))
+            elapsed = time.perf_counter() - t0
+            return outs, planes, paged.gather_stats(), elapsed
+
+        def fused_leg(pool):
+            """The same storm as expression wave lanes: fingerprint
+            groups, one fused paged program per group, superblock-
+            merged gathers."""
+            paged.reset_gather_bytes()
+            paged.reset_expr_fused_stats()
+            autoplan.reset_plan_state()
+            sched = W.WaveScheduler(max_entries=2 * n_tiles,
+                                    tick_ms=5000.0)
+            results = [None] * n_tiles
+            errors = []
+            ts = []
+
+            def submit(i):
+                tables, p16 = stage(pool, wins[i])
+                fp = fps[i]
+                statics = ("near", B, (h, w), step, True, 0, fp.key)
+
+                def go():
+                    try:
+                        results[i] = sched.render_expr(
+                            pool, tables, p16, ctrls[i], sp,
+                            fp.const_array(), statics,
+                            (jnp.asarray(stack), jnp.asarray(params),
+                             None, None), None)
+                    except Exception as e:   # noqa: BLE001 - reported
+                        errors.append(repr(e))
+                t = threading.Thread(target=go)
+                t.start()
+                ts.append(t)
+
+            t0 = time.perf_counter()
+            for i in range(n_tiles):
+                submit(i)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                with sched._lock:
+                    if len(sched._pending) >= n_tiles:
+                        break
+                time.sleep(0.002)
+            while sched.run_wave():
+                pass
+            for t in ts:
+                t.join(timeout=300)
+            elapsed = time.perf_counter() - t0
+            st = sched.stats()
+            sched.shutdown()
+            return (results, errors, paged.gather_stats(), elapsed,
+                    st, paged.expr_fused_stats())
+
+        u_out, u_planes, u_gather, u_s = unfused_leg(
+            PagePool(capacity=96, page_rows=pr, page_cols=pc))
+        f_out, f_err, f_gather, f_s, f_st, f_expr = fused_leg(
+            PagePool(capacity=96, page_rows=pr, page_cols=pc))
+        pst = autoplan.plan_stats()
+
+        parity_byte = (not f_err
+                       and all(b is not None and np.array_equal(a, b)
+                               for a, b in zip(u_out, f_out)))
+        # f32 plane parity: re-run ONE tile per expression structure
+        # through the fused program (no scale) against the unfused
+        # evaluate_expressions plane
+        max_ulp = 0
+        pool_p = PagePool(capacity=96, page_rows=pr, page_cols=pc)
+        for i in (0, n_per):
+            tables, p16 = stage(pool_p, wins[i])
+            try:
+                with pool_p.locked_pool() as parr:
+                    c, b = paged.warp_scored_paged(
+                        parr, jnp.asarray(tables[None]),
+                        jnp.asarray(p16),
+                        jnp.asarray(ctrls[i])[None], "near", B,
+                        (h, w), step,
+                        interpret=paged.pallas_interpret())
+                    plane, ok = paged.expr_epilogue(
+                        c, b, fps[i].key,
+                        jnp.asarray(fps[i].const_array()[None]))
+            finally:
+                pool_p.unpin(tables)
+            u_plane, u_ok = u_planes[i]
+            both = np.asarray(ok[0]) & u_ok
+            if not np.array_equal(np.asarray(ok[0]), u_ok):
+                max_ulp = 1 << 30       # valid masks must agree
+            if both.any():
+                max_ulp = max(max_ulp, int(_ulp_diff_f32(
+                    np.asarray(plane[0])[both], u_plane[both]).max()))
+
+        d_red = (1.0 - f_gather["dispatches"] / u_gather["dispatches"]
+                 if u_gather["dispatches"] else 0.0)
+        b_red = (1.0 - f_gather["bytes"] / u_gather["bytes"]
+                 if u_gather["bytes"] else 0.0)
+        out = {
+            "workload": f"{n_per} NDVI + {n_per} ternary cloud-mask "
+                        f"tiles ({h}px, {n_windows}-window pan over a "
+                        f"2-band {S}px scene pair; every mask tile a "
+                        "distinct threshold)",
+            "unit": "paged-dispatch reduction (unfused -> fused)",
+            "value": round(d_red, 3),
+            "reduction_ok": d_red >= 0.50 and b_red >= 0.50,
+            "unfused": {
+                "paged_dispatches": u_gather["dispatches"],
+                "dispatches_per_1k_tiles": round(
+                    u_gather["dispatches"] / n_tiles * 1000.0, 1),
+                "gathered_bytes": u_gather["bytes"],
+                "programs_compiled": {
+                    "scored_mosaic": 1, "byte_scale": 1,
+                    "expression_sources_traced": n_per + 1},
+                "elapsed_s": round(u_s, 3)},
+            "fused": {
+                "paged_dispatches": f_gather["dispatches"],
+                "dispatches_per_1k_tiles": round(
+                    f_gather["dispatches"] / n_tiles * 1000.0, 1),
+                "gathered_bytes": f_gather["bytes"],
+                "programs_compiled": f_expr["programs"],
+                "wave_requests": f_st["requests"],
+                "wave_dispatches": f_st["dispatches"],
+                "superblocks": pst["superblocks"],
+                "merged_lanes": pst["merged_lanes"],
+                "routes": pst["routes"],
+                "elapsed_s": round(f_s, 3)},
+            "gathered_bytes_reduction": round(b_red, 3),
+            "parity_byte_exact": parity_byte,
+            "parity_f32_max_ulp": max_ulp,
+            "parity_f32_ok": max_ulp <= 2,
+            "one_program_per_structure": f_expr["programs"] == 2,
+            "errors": f_err[:3],
+            "interpret": interp,
+        }
+        if interp:
+            out["note"] = ("interpret-mode pallas on CPU: dispatch "
+                           "counts, gathered bytes, program counts and "
+                           "parity are platform-independent; elapsed_s "
+                           "is not a hardware number")
+        return out
+    finally:
+        for key, prev in (("GSKY_PLAN", prev_plan),
+                          ("GSKY_EXPR_FUSE", prev_fuse)):
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+        if interp and not prev_pallas:
+            os.environ.pop("GSKY_PALLAS", None)
+
+
 def bench_cfg_mesh():
     """Mesh serving A/B (docs/MESH.md): the cfg_wave mosaic storm
     dispatched (a) through single-chip waves (GSKY_MESH unset) and
@@ -1724,6 +2014,7 @@ def run_all():
         "cfg_wave": bench_cfg_wave(),
         "cfg_occupancy": bench_cfg_occupancy(),
         "cfg_plan": bench_cfg_plan(),
+        "cfg_algebra": bench_cfg_algebra(),
         "cfg_mesh": bench_cfg_mesh(),
         "cfg_ingest": bench_cfg_ingest(store, utm, tmp),
     }
@@ -1827,6 +2118,25 @@ def main(argv=None):
                 "reduction": cp.get("value"),
                 "superblocks": cp["plan_on"]["superblocks"],
                 "routes": cp["plan_on"]["routes"]}
+        ca = configs.get("cfg_algebra") or {}
+        if ca.get("fused"):
+            # expression fusion belongs with the chip numbers: one
+            # paged program per structure vs a dispatch per tile, and
+            # the pool->VMEM bytes the merged cross-band gather saves
+            kernels["expr_fusion"] = {
+                "paged_dispatches_per_1k_tiles": {
+                    "unfused": ca["unfused"]["dispatches_per_1k_tiles"],
+                    "fused": ca["fused"]["dispatches_per_1k_tiles"]},
+                "gathered_hbm_bytes": {
+                    "unfused": ca["unfused"]["gathered_bytes"],
+                    "fused": ca["fused"]["gathered_bytes"],
+                    "reduction": ca.get("gathered_bytes_reduction")},
+                "programs_compiled": {
+                    "unfused": ca["unfused"]["programs_compiled"],
+                    "fused": ca["fused"]["programs_compiled"]},
+                "dispatch_reduction": ca.get("value"),
+                "parity_byte_exact": ca.get("parity_byte_exact"),
+                "parity_f32_max_ulp": ca.get("parity_f32_max_ulp")}
         cm = configs.get("cfg_mesh") or {}
         if cm.get("mesh"):
             kernels["mesh_dispatch"] = {

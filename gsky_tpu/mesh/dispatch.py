@@ -165,6 +165,48 @@ class MeshDispatcher:
             out_specs=P(MESH_AXES), check_rep=False)
         return jax.jit(fn)
 
+    def _build_wave_expr(self, method, n_ns, out_hw, step, auto,
+                         colour_scale, fpk, T, interpret):
+        """Granule-sharded fused band algebra: the local body is the
+        unchanged paged gather + expression epilogue + scale-to-byte
+        (`render_expr_paged`), so mesh tile bytes equal the
+        single-chip wave bytes exactly (same row-independence argument
+        as the byte layout)."""
+        from ..ops.paged import PARAMS_W, render_expr_paged
+
+        def local(parr, tables, params, ctrls, sps, consts):
+            n_l = tables.shape[0]
+            return render_expr_paged(
+                parr, tables, params.reshape(n_l * T, PARAMS_W), ctrls,
+                sps, consts, method, n_ns, out_hw, step, auto,
+                colour_scale, fpk, interpret=interpret)
+
+        fn = shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(), P(MESH_AXES), P(MESH_AXES), P(MESH_AXES),
+                      P(MESH_AXES), P(MESH_AXES)),
+            out_specs=P(MESH_AXES), check_rep=False)
+        return jax.jit(fn)
+
+    def _build_wave_expr_sb(self, method, n_ns, out_hw, step, auto,
+                            colour_scale, fpk, T, blk, interpret):
+        from ..ops.paged import PARAMS_W, render_expr_paged
+
+        def local(parr, tables, params, ctrls, sps, consts, sb_of):
+            n_l = params.shape[0]
+            return render_expr_paged(
+                parr, tables, params.reshape(n_l * T, PARAMS_W), ctrls,
+                sps, consts, method, n_ns, out_hw, step, auto,
+                colour_scale, fpk, interpret=interpret, blk=blk,
+                sb_of=sb_of)
+
+        fn = shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(), P(MESH_AXES), P(MESH_AXES), P(MESH_AXES),
+                      P(MESH_AXES), P(MESH_AXES), P(MESH_AXES)),
+            out_specs=P(MESH_AXES), check_rep=False)
+        return jax.jit(fn)
+
     def _build_wave_scored(self, method, n_ns, out_hw, step, T,
                            interpret):
         from ..ops.paged import PARAMS_W, warp_scored_paged
@@ -237,7 +279,7 @@ class MeshDispatcher:
         while the previous sharded program ran); only the granule
         layout stages, other layouts ignore it."""
         layout = self.layout_for(kind, es[0].key, len(es))
-        if layout == "granule" and kind in ("byte", "scored"):
+        if layout == "granule" and kind in ("byte", "scored", "expr"):
             devs = self._dispatch_wave_granule(kind, es, staged)
         elif layout == "x" and kind in ("byte", "scored"):
             devs = self._dispatch_x(kind, es)
@@ -265,7 +307,8 @@ class MeshDispatcher:
         hang queued behind a wedged kernel is attributed to the
         EXECUTING wave."""
         layout = self.layout_for(kind, es[0].key, len(es))
-        if layout != "granule" or kind not in ("byte", "scored"):
+        if layout != "granule" or kind not in ("byte", "scored",
+                                               "expr"):
             return None
         return self._stage_granule(kind, es)
 
@@ -304,10 +347,15 @@ class MeshDispatcher:
             "d_sb": None if sb_of is None else
             jax.device_put(jnp.asarray(sb_of), wav),
         }
-        if kind == "byte":
+        if kind in ("byte", "expr"):
             sps = np.stack([e.payload["sp"] for e in es]
                            + [es[0].payload["sp"]] * (Np - N))
             staged["d_sps"] = jax.device_put(jnp.asarray(sps), wav)
+        if kind == "expr":
+            consts = np.stack([e.payload["consts"] for e in es]
+                              + [es[0].payload["consts"]] * (Np - N))
+            staged["d_consts"] = jax.device_put(jnp.asarray(consts),
+                                                wav)
         return staged
 
     def _chip_counts(self, n_real: int, n_padded: int) -> List[int]:
@@ -357,6 +405,38 @@ class MeshDispatcher:
                 with pool.locked_pool() as parr:
                     out = fn(jax.device_put(parr, rep), d_tables,
                              d_params, d_ctrls, d_sps)
+                return (out[:N],)
+            if kind == "expr":
+                from ..ops.paged import note_expr_fused, \
+                    note_expr_program
+                from ..ops.expr import fingerprint_hash
+                (method, n_ns, out_hw, step, auto, colour_scale,
+                 fpk) = statics
+                note_expr_fused("mesh")
+                note_expr_program(fingerprint_hash(fpk))
+                d_sps = staged["d_sps"]
+                d_consts = staged["d_consts"]
+                if d_sb is not None:
+                    Gc = int(d_tables.shape[0]) // self.n_chips
+                    fn = self._get(
+                        ("wave_expr_sb", statics, T, S, Np, Gc, blk,
+                         interpret),
+                        lambda: self._build_wave_expr_sb(
+                            method, n_ns, out_hw, step, auto,
+                            colour_scale, fpk, T, blk, interpret))
+                    with pool.locked_pool() as parr:
+                        out = fn(jax.device_put(parr, rep), d_tables,
+                                 d_params, d_ctrls, d_sps, d_consts,
+                                 d_sb)
+                    return (out[:N],)
+                fn = self._get(
+                    ("wave_expr", statics, T, S, Np, interpret),
+                    lambda: self._build_wave_expr(
+                        method, n_ns, out_hw, step, auto, colour_scale,
+                        fpk, T, interpret))
+                with pool.locked_pool() as parr:
+                    out = fn(jax.device_put(parr, rep), d_tables,
+                             d_params, d_ctrls, d_sps, d_consts)
                 return (out[:N],)
             method, n_ns, out_hw, step = statics
             if d_sb is not None:

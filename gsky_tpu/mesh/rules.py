@@ -44,8 +44,8 @@ LAYOUTS = ("granule", "x", "time", "replicated")
 # width, every other tile wave shards its stacked granule tables
 _BUILTIN = (
     (r"kind=drill\b", "time"),
-    (r"kind=(?:byte|scored)\b.*\bw=(?:[4-9]\d{3}|\d{5,})\b", "x"),
-    (r"kind=(?:byte|scored)\b", "granule"),
+    (r"kind=(?:byte|scored|expr)\b.*\bw=(?:[4-9]\d{3}|\d{5,})\b", "x"),
+    (r"kind=(?:byte|scored|expr)\b", "granule"),
 )
 
 
@@ -117,12 +117,19 @@ def describe(kind: str, key: tuple, wave: int) -> str:
         return (f"kind=drill bands={int(shape[0])} "
                 f"pixels={int(shape[1])} "
                 f"pixel_count={int(bool(key[3]))} wave={int(wave)}")
-    # byte / scored: key = ((method, n_ns, (h, w), step[, auto,
-    # colour_scale]), id(pool))
+    # byte / scored / expr: key = ((method, n_ns, (h, w), step[, auto,
+    # colour_scale[, fp_key]]), id(pool))
     statics = key[0]
     method, n_ns, (h, w), step = statics[:4]
-    return (f"kind={kind} method={method} n_ns={int(n_ns)} "
-            f"h={int(h)} w={int(w)} step={int(step)} wave={int(wave)}")
+    line = (f"kind={kind} method={method} n_ns={int(n_ns)} "
+            f"h={int(h)} w={int(w)} step={int(step)}")
+    if kind == "expr":
+        # the fingerprint keeps structurally distinct expressions in
+        # distinct descriptors (and rule-targetable) without leaking
+        # the source text
+        from ..ops.expr import fingerprint_hash
+        line += f" fp={fingerprint_hash(statics[6])}"
+    return line + f" wave={int(wave)}"
 
 
 def match_rules(descriptor: str,

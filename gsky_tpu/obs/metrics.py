@@ -502,6 +502,43 @@ def _collect_mesh():
     return out
 
 
+def _collect_expr():
+    """Fused band-algebra surfaces (docs/KERNELS.md "Expression
+    epilogue"): compile-cache traffic, distinct fused programs, and
+    how expression renders routed.  Rendered only once the expression
+    tier has seen traffic — a process that never parses an expression
+    keeps its exposition byte-identical."""
+    out: List = []
+    try:
+        from ..ops.expr import expr_cache_stats
+        from ..ops.paged import expr_fused_stats
+        cs = expr_cache_stats()
+        fs = expr_fused_stats()
+        live = (cs.get("hits", 0) or cs.get("misses", 0)
+                or fs.get("programs", 0) or fs.get("paths"))
+        if live:
+            out.append(_c("gsky_expr_cache_hits_total",
+                          "Expression compile-cache hits.",
+                          [({}, float(cs.get("hits", 0)))]))
+            out.append(_c("gsky_expr_cache_misses_total",
+                          "Expression compile-cache misses (fresh "
+                          "parses).",
+                          [({}, float(cs.get("misses", 0)))]))
+            out.append(_g("gsky_expr_programs",
+                          "Distinct expression fingerprints with a "
+                          "fused paged program this process.",
+                          [({}, float(fs.get("programs", 0)))]))
+            paths = fs.get("paths") or {}
+            if paths:
+                out.append(_c("gsky_expr_fused_total",
+                              "Expression renders by dispatch path.",
+                              [({"path": k}, float(v))
+                               for k, v in sorted(paths.items())]))
+    except Exception:  # subsystem unbooted - skip its families, a scrape never fails
+        pass
+    return out
+
+
 def _collect_tsan():
     """Lockset race-sanitizer surfaces (docs/ANALYSIS.md): only the
     race count — a non-zero value fails the GSKY_TSAN=1 CI soak leg,
@@ -594,8 +631,8 @@ def _collect_elastic():
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
             _collect_runtime, _collect_batcher, _collect_overload,
             _collect_ingest, _collect_device, _collect_waves,
-            _collect_mesh, _collect_tsan, _collect_fabric,
-            _collect_elastic):
+            _collect_mesh, _collect_expr, _collect_tsan,
+            _collect_fabric, _collect_elastic):
     _REG.register_collector(_fn)
 
 

@@ -18,13 +18,26 @@ is valid in EVERY variable the expression references.
 
 from __future__ import annotations
 
+import hashlib
 import math
+import os
 import re
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+
+
+def expr_fuse_enabled() -> bool:
+    """`GSKY_EXPR_FUSE` gates the fused band-algebra path (default on):
+    expression layers evaluate as a traced epilogue inside the paged
+    program instead of a separate post-warp stage.  ``0`` restores the
+    per-band scored-mosaic + `evaluate_expressions` leg byte-for-byte."""
+    return os.environ.get("GSKY_EXPR_FUSE", "1").lower() not in (
+        "0", "false", "off", "no")
 
 _TOKEN_RE = re.compile(r"""
     (?P<num>\d+\.\d*(?:[eE][-+]?\d+)?|\.\d+(?:[eE][-+]?\d+)?|\d+(?:[eE][-+]?\d+)?)
@@ -241,6 +254,117 @@ def _emit(node, env, xp):
     raise ValueError(tag)
 
 
+# --------------------------------------------------------------------------
+# Structural fingerprints — the fused epilogue's compile key.
+#
+# Two expressions that differ only in variable NAMES or literal VALUES
+# ("(nir-red)/(nir+red)" vs "(b5-b4)/(b5+b4)", "a>1?1:0" vs "a>2?1:0")
+# share one normalized AST: variables become slot indices in first-use
+# order, numeric literals become const indices in occurrence order
+# (NO value dedup — constants are a traced operand, so structure must not
+# depend on their values).  The normalized tuple is hashable and serves as
+# the jit static argument; same structure => same compiled program.
+# --------------------------------------------------------------------------
+
+def _normalize(node, slots: Dict[str, int], consts: List[float]):
+    tag = node[0]
+    if tag == "num":
+        consts.append(float(node[1]))
+        return ("const", len(consts) - 1)
+    if tag == "var":
+        if node[1] not in slots:
+            slots[node[1]] = len(slots)
+        return ("slot", slots[node[1]])
+    if tag == "un":
+        return ("un", node[1], _normalize(node[2], slots, consts))
+    if tag == "bin":
+        a = _normalize(node[2], slots, consts)
+        b = _normalize(node[3], slots, consts)
+        return ("bin", node[1], a, b)
+    if tag == "tern":
+        return ("tern",) + tuple(
+            _normalize(n, slots, consts) for n in node[1:])
+    if tag == "call":
+        return ("call", node[1], tuple(
+            _normalize(n, slots, consts) for n in node[2]))
+    raise ValueError(tag)
+
+
+@dataclass(frozen=True)
+class ExprFingerprint:
+    """Normalized expression structure.  `key` is the hashable normalized
+    AST (jit-static); `slots` maps slot index -> variable name (first-use
+    order, identical to `CompiledExpr.variables`); `consts` carries the
+    lifted literals in occurrence order (traced operand, f32); `hash` is
+    the 12-hex digest that joins the kernel-ledger token and the mesh
+    wave-group descriptor."""
+
+    key: tuple
+    slots: Tuple[str, ...]
+    consts: Tuple[float, ...]
+    hash: str
+
+    @property
+    def n_slots(self) -> int:
+        return len(self.slots)
+
+    def const_array(self) -> np.ndarray:
+        """Lifted literals as a dense (C,) f32 row — the per-lane traced
+        operand of the fused epilogue (padded/stacked by the caller)."""
+        return np.asarray(self.consts, np.float32).reshape(len(self.consts))
+
+
+def _fp_eval_ast(key):
+    """Rebuild an `_emit`-compatible AST from a normalized key: slot i
+    reads env["s{i}"], const k reads env["c{k}"].  Re-using `_emit`
+    guarantees the fused epilogue runs the exact jnp op sequence of the
+    unfused interpreter — bit-identical f32."""
+    tag = key[0]
+    if tag == "const":
+        return ("var", f"c{key[1]}")
+    if tag == "slot":
+        return ("var", f"s{key[1]}")
+    if tag == "un":
+        return ("un", key[1], _fp_eval_ast(key[2]))
+    if tag == "bin":
+        return ("bin", key[1], _fp_eval_ast(key[2]), _fp_eval_ast(key[3]))
+    if tag == "tern":
+        return ("tern",) + tuple(_fp_eval_ast(n) for n in key[1:])
+    if tag == "call":
+        return ("call", key[1], [_fp_eval_ast(n) for n in key[2]])
+    raise ValueError(tag)
+
+
+def eval_fingerprint(key: tuple, planes: Sequence, consts: Sequence, xp=jnp):
+    """Evaluate a normalized fingerprint: `planes[i]` feeds slot i,
+    `consts[k]` feeds const k (scalars or arrays broadcastable against the
+    planes).  Returns the raw f32 result; validity is the caller's."""
+    env = {f"s{i}": p for i, p in enumerate(planes)}
+    for k, c in enumerate(consts):
+        env[f"c{k}"] = c
+    return _emit(_fp_eval_ast(key), env, xp)
+
+
+def fingerprint_hash(key: tuple) -> str:
+    """12-hex digest of a normalized fingerprint key — the form that
+    joins the `ex1` ledger token and the mesh wave-group descriptor."""
+    return hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+
+
+def fingerprint(ce: "CompiledExpr") -> ExprFingerprint:
+    """Fingerprint of a compiled expression (cached on the instance)."""
+    fp = getattr(ce, "_fp", None)
+    if fp is not None:
+        return fp
+    slots: Dict[str, int] = {}
+    consts: List[float] = []
+    key = _normalize(ce._ast, slots, consts)
+    names = tuple(sorted(slots, key=slots.get))
+    fp = ExprFingerprint(key, names, tuple(consts), fingerprint_hash(key))
+    ce._fp = fp
+    return fp
+
+
 @dataclass
 class CompiledExpr:
     """A compiled band expression: callable on dicts of arrays."""
@@ -248,6 +372,8 @@ class CompiledExpr:
     src: str
     variables: List[str]
     _ast: tuple = field(repr=False, default=None)
+    _fp: Optional[ExprFingerprint] = field(
+        repr=False, compare=False, default=None)
 
     def __call__(self, env: Dict[str, "jnp.ndarray"], xp=jnp):
         missing = [v for v in self.variables if v not in env]
@@ -270,20 +396,68 @@ class CompiledExpr:
         return xp.where(ok, out, 0.0), ok
 
 
-_cache: Dict[str, CompiledExpr] = {}
+# Module-level LRU keyed by SOURCE STRING, not config identity — a SIGHUP
+# reload that re-parses the same `rgb_products` text hits the cache and
+# hands back the same CompiledExpr (with its memoized fingerprint), so
+# fused programs survive config reloads.
+_CACHE_CAP = 512
+_cache: "OrderedDict[str, CompiledExpr]" = OrderedDict()
+_cache_lock = threading.Lock()
+_cache_hits = 0
+_cache_misses = 0
+
+
+def _cache_cap() -> int:
+    """`GSKY_EXPR_CACHE` caps the compile LRU (default 512, floor 1) —
+    read per insert so tests and operators can resize live."""
+    try:
+        return max(1, int(os.environ.get("GSKY_EXPR_CACHE",
+                                         _CACHE_CAP)))
+    except ValueError:
+        return _CACHE_CAP
 
 
 def compile_expr(src: str) -> CompiledExpr:
-    if src in _cache:
-        return _cache[src]
+    global _cache_hits, _cache_misses
+    with _cache_lock:
+        ce = _cache.get(src)
+        if ce is not None:
+            _cache.move_to_end(src)
+            _cache_hits += 1
+            return ce
+        _cache_misses += 1
     ast = _Parser(tokenize(src)).parse()
     vars_ = []
     _collect_vars(ast, vars_)
     seen = set()
     uniq = [v for v in vars_ if not (v in seen or seen.add(v))]
     ce = CompiledExpr(src, uniq, ast)
-    _cache[src] = ce
+    with _cache_lock:
+        prior = _cache.get(src)
+        if prior is not None:          # raced another compiler: keep first
+            _cache.move_to_end(src)
+            return prior
+        _cache[src] = ce
+        cap = _cache_cap()
+        while len(_cache) > cap:
+            _cache.popitem(last=False)
     return ce
+
+
+def expr_cache_stats() -> Dict[str, int]:
+    """Compile-cache counters for `/debug` and the obs exporter."""
+    with _cache_lock:
+        return {"size": len(_cache), "cap": _cache_cap(),
+                "hits": _cache_hits, "misses": _cache_misses}
+
+
+def reset_expr_cache() -> None:
+    """Test hook: drop all cached compiles and zero the counters."""
+    global _cache_hits, _cache_misses
+    with _cache_lock:
+        _cache.clear()
+        _cache_hits = 0
+        _cache_misses = 0
 
 
 @dataclass

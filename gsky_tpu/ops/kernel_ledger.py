@@ -55,6 +55,11 @@ SCHEMA_VERSION = 1
 TOKEN_VERSIONS = {
     "warp_scored_paged": "pg1",
     "warp_render_paged": "pg1",
+    # fused expression epilogue (ops/paged.py::render_expr_paged): the
+    # token also carries the expression's structural fingerprint hash,
+    # so same-structure expressions share verdicts and a normalization
+    # change bumps ex1 wholesale
+    "render_expr_paged": "ex1",
     # autoplan's block-shape cost model (pipeline/autoplan.py): the
     # chosen shape is encoded IN the token (verdict always "promoted"),
     # so a costed shape is decided once per process lineage and

@@ -61,6 +61,12 @@ from .pallas_tpu import (_HAVE_PLTPU, _WARP_BLK, _WARP_VMEM_BUDGET,
 # verdicts are skipped instead of replayed onto a different kernel
 PAGED_TOKEN_VERSION = "pg1"
 
+# token scheme version for the fused expression-epilogue program
+# (`render_expr_paged`): the token additionally carries the expression's
+# structural fingerprint hash, so same-structure expressions share race
+# verdicts and a grammar/normalization change invalidates them wholesale
+EXPR_TOKEN_VERSION = "ex1"
+
 # params row width: slots 0..10 are the bucketed kernel's contract
 # (affine, true extent, nodata, priority, ns id), 11/12 the page-grid
 # window origin, 13/14 the page-aligned window extent, 15 the page
@@ -396,6 +402,134 @@ def render_byte_paged(pool, tables, params, ctrls, sps,
                                          colour_scale))(canv, best, sps)
 
 
+# --- fused expression epilogue (GSKY_EXPR_FUSE) -----------------------
+#
+# An expression lane carries MULTIPLE input namespaces per output pixel:
+# slot i of the scored mosaic (canv[:, i] / best[:, i]) is expression
+# variable i (ns_id rows were assigned in fingerprint slot order by the
+# executor), so the epilogue is pure traced jnp on planes the paged
+# program already holds — zero extra HBM round-trips between
+# interpolation and scale-to-byte.  Lifted literals arrive as a traced
+# (N, C) operand, so "nir > 0.3" and "nir > 0.7" are ONE program.
+
+_EXPR_LOCK = __import__("threading").Lock()
+_EXPR_FPS: set = set()
+_EXPR_FUSED: dict = {}
+
+
+def note_expr_program(fp_hash: str) -> None:
+    """Record a fingerprint dispatched through the fused epilogue —
+    `len` of the set is the gsky_expr_programs gauge (distinct
+    structures, i.e. distinct compiled programs modulo shape axes)."""
+    with _EXPR_LOCK:
+        _EXPR_FPS.add(str(fp_hash))
+
+
+def note_expr_fused(path: str) -> None:
+    """Count one expression request routed through ``path`` (percall /
+    wave / mesh / bucketed / unfused)."""
+    with _EXPR_LOCK:
+        _EXPR_FUSED[path] = _EXPR_FUSED.get(path, 0) + 1
+
+
+def expr_fused_stats() -> dict:
+    with _EXPR_LOCK:
+        return {"programs": len(_EXPR_FPS), "paths": dict(_EXPR_FUSED)}
+
+
+def reset_expr_fused_stats() -> None:
+    """Zero the fused-path accounting — bench/soak A/B legs only."""
+    with _EXPR_LOCK:
+        _EXPR_FPS.clear()
+        _EXPR_FUSED.clear()
+
+
+def _fp_slot_ids(key) -> set:
+    """Slot indices referenced by a normalized fingerprint key —
+    contiguous 0..n-1 by construction (first-use numbering), but walked
+    rather than assumed so validity never silently widens."""
+    tag = key[0]
+    if tag == "slot":
+        return {key[1]}
+    if tag == "const":
+        return set()
+    if tag == "un":
+        return _fp_slot_ids(key[2])
+    if tag == "bin":
+        return _fp_slot_ids(key[2]) | _fp_slot_ids(key[3])
+    if tag == "tern":
+        out = set()
+        for n in key[1:]:
+            out |= _fp_slot_ids(n)
+        return out
+    if tag == "call":
+        out = set()
+        for n in key[2]:
+            out |= _fp_slot_ids(n)
+        return out
+    raise ValueError(tag)
+
+
+def expr_epilogue(canv, best, fp: tuple, consts):
+    """The fused expression epilogue on a scored mosaic block: canv /
+    best (N, n_ns, h, w) f32 (slot i of the mosaic is expression
+    variable i), consts (N, C) f32 lifted literals -> (plane (N, h, w)
+    f32, ok (N, h, w) bool).
+
+    Evaluation reconstructs the `_emit` op sequence of the unfused
+    `evaluate_expressions` leg (`ops.expr.eval_fingerprint`), so the
+    f32 planes are bit-identical.  Nodata follows the merger: a pixel
+    is valid iff valid in EVERY referenced slot and the result is
+    finite (`CompiledExpr.eval_masked` semantics, op for op)."""
+    from .expr import eval_fingerprint
+    slot_ids = _fp_slot_ids(fp)
+    n_slots = (max(slot_ids) + 1) if slot_ids else 0
+    planes = [canv[:, i] for i in range(n_slots)]
+    cbs = [consts[:, k][:, None, None] for k in range(consts.shape[1])]
+    out = jnp.asarray(eval_fingerprint(fp, planes, cbs), jnp.float32)
+    N, _, h, w = canv.shape
+    out = jnp.broadcast_to(out, (N, h, w))
+    ok = None
+    for i in sorted(slot_ids):
+        m = best[:, i] > -jnp.inf
+        ok = m if ok is None else ok & m
+    if ok is None:
+        ok = jnp.ones((N, h, w), bool)
+    ok = ok & jnp.isfinite(out)
+    return jnp.where(ok, out, 0.0), ok
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("method", "n_ns", "out_hw", "step",
+                                    "auto", "colour_scale", "fp",
+                                    "interpret", "blk"))
+def render_expr_paged(pool, tables, params, ctrls, sps, consts,
+                      method: str = "near", n_ns: int = 1,
+                      out_hw=(256, 256), step: int = 16,
+                      auto: bool = True, colour_scale: int = 0,
+                      fp: tuple = ("const", 0), interpret: bool = False,
+                      blk=None, sb_of=None):
+    """Fused paged warp + mosaic + EXPRESSION EPILOGUE + byte scale.
+
+    Operands match `render_byte_paged` plus ``consts`` (N, C) f32 — the
+    expression's lifted literals per lane (C may be 0).  ``fp`` (static)
+    is the normalized fingerprint key from `ops.expr.fingerprint`; the
+    jit key therefore holds the expression's STRUCTURE, never its
+    source text or constants, so "nir > 0.3" and "nir > 0.7" are one
+    program.  The byte tail is `scale_to_byte` per lane — exactly the
+    call the unfused ows leg makes on `evaluate_expressions` output.
+    Returns PNG-ready uint8 (N, h, w) tiles."""
+    from .scale import scale_to_byte
+    canv, best = _paged_scored(pool, tables, params, ctrls, method,
+                               n_ns, tuple(out_hw), step, interpret,
+                               blk, sb_of)
+    plane, ok = expr_epilogue(canv, best, fp, consts)
+    return jax.vmap(
+        lambda d, o, sp: scale_to_byte(d, o, sp[0], sp[1], sp[2],
+                                       colour_scale, auto))(plane, ok,
+                                                            sps)
+
+
 @jax.jit
 def pool_inf_counts(pool):
     """Per-slot ±inf population of the page pool: (capacity,) int32.
@@ -476,6 +610,45 @@ def render_byte_paged_raced(pool, tables, params, ctrls, sps, method,
                          extra=(bool(auto), int(colour_scale))
                          + _plan_extras(pool, tables, blk, sb_of))
     return run_with_fallback("warp_render_paged", _pallas, xla_thunk,
+                             sync_token=token)
+
+
+def _expr_token(pool, tables, method, n_ns, out_hw, step, auto,
+                colour_scale, fp_hash, extra=()):
+    """`ex1`-versioned race token for the fused expression program: the
+    paged shape axes plus the scale statics and the expression's
+    STRUCTURAL fingerprint hash — not its source text — so
+    "nir > 0.3 ? 1 : 0" and "nir > 0.7 ? 1 : 0" share one verdict."""
+    return (EXPR_TOKEN_VERSION, int(tables.shape[0]),
+            int(tables.shape[1]), int(tables.shape[2]),
+            int(pool.shape[1]), int(pool.shape[2]), str(method),
+            int(n_ns), (int(out_hw[0]), int(out_hw[1])), int(step),
+            bool(auto), int(colour_scale), str(fp_hash)) + tuple(extra)
+
+
+def render_expr_paged_raced(pool, tables, params, ctrls, sps, consts,
+                            method, n_ns, out_hw, step, auto,
+                            colour_scale, fp, fp_hash, xla_thunk,
+                            blk=None, sb_of=None):
+    """uint8 (N, h, w) tiles — the fused paged warp+mosaic+expression+
+    scale program raced against the caller's unfused XLA closure (which
+    must produce byte-identical tiles via the per-band mosaic +
+    `evaluate_expressions` + `scale_to_byte` reference)."""
+    note_gather(table_gather_bytes(tables, pool.shape[1],
+                                   pool.shape[2]))
+    note_expr_program(fp_hash)
+
+    def _pallas():
+        return render_expr_paged(pool, tables, params, ctrls, sps,
+                                 consts, method, n_ns, out_hw, step,
+                                 auto, colour_scale, fp,
+                                 interpret=pallas_interpret(),
+                                 blk=blk, sb_of=sb_of)
+
+    token = _expr_token(pool, tables, method, n_ns, out_hw, step, auto,
+                        colour_scale, fp_hash,
+                        extra=_plan_extras(pool, tables, blk, sb_of))
+    return run_with_fallback("render_expr_paged", _pallas, xla_thunk,
                              sync_token=token)
 
 

@@ -409,6 +409,34 @@ def _note_route(path: str):
         pass
 
 
+def union_lane_spans(spans, cap: int, maxnpg: int):
+    """Cross-band gather-window merge for one EXPRESSION lane: the
+    lane's granules are different bands of the same bbox, so their page
+    rects overlap near-totally — unioning them makes every band's
+    page-table row the same shape (params16[11:16] identical down the T
+    axis), which is the cheapest superblock the planner ever sees: the
+    between-lane clusterer then matches expression lanes row for row.
+
+    ``spans`` is `_paged_from_group`'s per-granule (i0, i1, j0, j1)
+    list (None = padding/off-scene); all spans in a scene group share
+    one bucket shape, so the union of clipped rects stays clipped.
+    Returns (merged spans, new maxnpg) — unchanged when merging would
+    exceed the page budget or bump the slot pow2 (never trade a bigger
+    program for the merge)."""
+    live = [s for s in spans if s is not None]
+    if len(live) < 2:
+        return spans, maxnpg
+    i0 = min(s[0] for s in live)
+    i1 = max(s[1] for s in live)
+    j0 = min(s[2] for s in live)
+    j1 = max(s[3] for s in live)
+    npg = (i1 - i0 + 1) * (j1 - j0 + 1)
+    if npg > cap or _pow2(npg) != _pow2(maxnpg):
+        return spans, maxnpg
+    u = (i0, i1, j0, j1)
+    return [u if s is not None else None for s in spans], npg
+
+
 def plan_wave_group(kind: str, es, stage: str = "dispatch"
                     ) -> Optional[Plan]:
     """Plan one drained wave group.  Under the synchronous ticker this
@@ -422,7 +450,8 @@ def plan_wave_group(kind: str, es, stage: str = "dispatch"
     gather, or nothing improves; otherwise a `Plan` whose route the
     dispatcher follows.  Never raises into the wave path: any planner
     defect degrades to the unplanned dispatch."""
-    if not plan_enabled() or kind not in ("byte", "scored") or not es:
+    if not plan_enabled() or kind not in ("byte", "scored", "expr") \
+            or not es:
         return None
     if stage == "assembly":
         with _LOCK:
@@ -487,7 +516,8 @@ def plan_sharded(kind: str, es, n_chips: int, Np: int) -> Optional[Plan]:
     which the wave sharding splits back into Gc rows per chip;
     ``sb_of`` values are chip-LOCAL indices.  Returns None when no
     chip merges anything (the unplanned mesh dispatch runs)."""
-    if not plan_enabled() or kind not in ("byte", "scored") or not es:
+    if not plan_enabled() or kind not in ("byte", "scored", "expr") \
+            or not es:
         return None
     try:
         statics = es[0].key[0]

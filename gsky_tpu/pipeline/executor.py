@@ -736,6 +736,97 @@ class WarpExecutor:
                                       win=win, win0_dev=_dev_win0(win0)))
         return _prefetch(out)
 
+    def render_expr_byte(self, granules, ns_ids: Sequence[int],
+                         prios: Sequence[float], dst_gt: GeoTransform,
+                         dst_crs: CRS, height: int, width: int,
+                         n_slots: int, fp, method: str = "near",
+                         offset: float = 0.0, scale: float = 0.0,
+                         clip: float = 0.0, colour_scale: int = 0,
+                         auto: bool = True, cache=None):
+        """Fused band-algebra fast path (GSKY_EXPR_FUSE): cached scenes
+        -> one paged program that gathers EVERY referenced band's
+        window, interpolates each, evaluates the expression as a traced
+        epilogue and scales to byte — no per-band mosaic dispatches, no
+        f32 plane round-trips through HBM.
+
+        ``ns_ids`` are fingerprint SLOT indices (variable i of ``fp``
+        is mosaic slot i); ``fp`` is the `ops.expr.ExprFingerprint`.
+        Returns a uint8 (H, W) array or None — the caller then runs
+        the unfused `evaluate_expressions` leg (multi-CRS granule sets,
+        page budget, SPMD compat mode)."""
+        made = self._scene_inputs(granules, ns_ids, prios, dst_gt,
+                                  dst_crs, height, width, cache)
+        if made is None:
+            return None
+        stack, ctrl, params, step, skey, ctrl_dev, win, win0, win_raw, \
+            *_ = made
+        if compat_spmd() is not None:
+            return None     # mesh compat routing has no expr epilogue
+        if not paged_enabled():
+            return None
+        n_pad = _bucket_pow2(n_slots)
+        made_p = self._paged_from_group(made, n_pad, lane_union=True)
+        if made_p is None:
+            self._note_paged(False)
+            return None
+        pool, tables, params16, real_pages = made_p
+        self._note_paged(True)
+        sp = np.array([offset, scale, clip], np.float32)
+        consts = fp.const_array()
+        statics = (method, n_pad, (height, width), step, auto,
+                   colour_scale, fp.key)
+        from ..ops.paged import expr_epilogue, note_expr_fused
+
+        def _unfused_xla():
+            # the race/fallback reference: bucketed scored mosaic +
+            # the SAME epilogue + scale — `evaluate_expressions`
+            # semantics op for op
+            from ..ops.scale import scale_to_byte
+            from ..ops.warp import warp_scenes_ctrl_scored
+            c, b = warp_scenes_ctrl_scored(
+                stack, ctrl_dev, jnp.asarray(params), method, n_pad,
+                (height, width), step, win=win, win0=_dev_win0(win0))
+            plane, ok = expr_epilogue(c[None], b[None], fp.key,
+                                      jnp.asarray(consts[None]))
+            return scale_to_byte(plane, ok, offset, scale, clip,
+                                 colour_scale, auto)
+
+        from .waves import default_waves, waves_enabled
+        if waves_enabled():
+            # wave path: expression lanes coalesce with every other
+            # lane of the tick that shares (statics, fingerprint, pool)
+            self._count("render_expr_wave", tables.shape)
+            note_expr_fused("wave")
+            from .. import device_guard
+
+            def _percall():
+                out = device_guard.run("dispatch.bucketed",
+                                       _unfused_xla)
+                return np.asarray(out[0])
+
+            return default_waves().render_expr(
+                pool, tables, params16, ctrl, sp, consts, statics,
+                (stack, params, win, win0), _percall)
+        self._count("render_expr_paged", tables.shape)
+        note_expr_fused("percall")
+        from ..ops.paged import render_expr_paged_raced
+        from .. import device_guard
+
+        def _dispatch():
+            with pool.locked_pool() as parr:
+                return render_expr_paged_raced(
+                    parr, jnp.asarray(tables[None]),
+                    jnp.asarray(params16), ctrl_dev[None],
+                    jnp.asarray(sp[None]), jnp.asarray(consts[None]),
+                    method, n_pad, (height, width), step, auto,
+                    colour_scale, fp.key, fp.hash, _unfused_xla)
+
+        try:
+            out = device_guard.run("dispatch.paged", _dispatch)
+        finally:
+            pool.unpin(tables)
+        return _prefetch(out[0])
+
     def render_bands_byte(self, granules, ns_ids: Sequence[int],
                           prios: Sequence[float], dst_gt: GeoTransform,
                           dst_crs: CRS, height: int, width: int,
@@ -867,7 +958,8 @@ class WarpExecutor:
             else:
                 self.paged_declined += 1
 
-    def _paged_from_group(self, group, n_pad: int):
+    def _paged_from_group(self, group, n_pad: int,
+                          lane_union: bool = False):
         """Page tables + 16-wide kernel params for one scene group
         (`_scene_groups` tuple), or None when the paged path can't
         serve it — page budget exceeded, pool full of pinned pages, or
@@ -879,7 +971,11 @@ class WarpExecutor:
         `_granule_bounds` margins the bucketed window uses, so both
         paths gather identical taps; table slots come back PINNED and
         the caller must `pool.unpin(tables)` once its dispatch is
-        enqueued."""
+        enqueued.  ``lane_union`` (expression lanes) merges the
+        per-granule page rects across the lane's bands
+        (`autoplan.union_lane_spans`) so every band row shares one
+        window shape — widened taps stay correct because off-window
+        coords are oob-poisoned before the rebase."""
         from ..ops.paged import page_slots, paged_vmem_ok
         from .pages import default_page_pool
         (_, ctrl, _, _, _, _, _, _, _, gs, params64) = group
@@ -927,6 +1023,9 @@ class WarpExecutor:
                 return None
             maxnpg = max(maxnpg, npg)
             spans.append((i0, i1, j0, j1))
+        if lane_union:
+            from .autoplan import union_lane_spans
+            spans, maxnpg = union_lane_spans(spans, cap, maxnpg)
         S = _bucket_pow2(maxnpg)
         if not paged_vmem_ok(S, n_pad, pr, pc):
             return None

@@ -231,12 +231,16 @@ class TilePipeline:
         """Qualification + ONE index pass for the fused composite path:
         (granules, ns_ids, prio, n_ns) or None.  Split from the dispatch
         half so the staged tile pipeline can run indexing, scene decode
-        and device dispatch as separately bounded stages."""
+        and device dispatch as separately bounded stages.
+
+        Expression-bearing requests (non-trivial band algebra) return
+        the 5-tuple `_expr_prep` form instead — granules stay at
+        index 0, so stage consumers are agnostic."""
         if self.remote is not None or req.mask is not None:
             return None
         exprs = req.band_exprs
         if any(ce._ast[0] != "var" for ce in exprs.expressions):
-            return None
+            return self._expr_prep(req, exprs, stats, spans)
         granules = self._timed_index(req, spans)
         if not granules:
             return None
@@ -246,10 +250,74 @@ class TilePipeline:
         ns_names, ns_ids, prio = ns_prio(granules)
         return granules, ns_ids, prio, len(ns_names)
 
+    def _expr_prep(self, req: GeoTileRequest, exprs: BandExpressions,
+                   stats: Optional[Dict[str, int]] = None,
+                   spans: Optional[Dict[str, float]] = None):
+        """Fused band-algebra qualification (GSKY_EXPR_FUSE): ONE index
+        pass, variables resolved to namespaces with the same rules as
+        `evaluate_expressions` (exact match, else unique `var#axis`
+        candidate), granules mapped to fingerprint SLOT ids.  Returns
+        (granules, ns_ids, prio, n_slots, fp) or None — the unfused
+        post-warp leg then runs, byte-identically (the GSKY_EXPR_FUSE=0
+        escape hatch is this None, unconditionally)."""
+        from ..ops.expr import expr_fuse_enabled, fingerprint
+        if len(exprs.expressions) != 1:
+            return None
+        ce = exprs.expressions[0]
+        if ce._ast[0] == "var" or not ce.variables:
+            return None
+        if not expr_fuse_enabled():
+            # a render that WOULD have fused rides the post-warp leg;
+            # the counter keeps the escape hatch observable
+            from ..ops.paged import note_expr_fused
+            note_expr_fused("unfused")
+            return None
+        granules = self._timed_index(req, spans)
+        if not granules:
+            return None
+        if stats is not None:
+            stats["granules"] = len(granules)
+            stats["files"] = len({g.path for g in granules})
+        fp = fingerprint(ce)
+        names = {g.namespace for g in granules}
+        slot_of: Dict[str, int] = {}
+        for i, var in enumerate(fp.slots):
+            if var in names:
+                slot_of[var] = i
+                continue
+            cands = [k for k in names if k.split("#")[0] == var]
+            if len(cands) == 1:
+                slot_of[cands[0]] = i
+            # unresolved slot: no granules ever map to it, so it stays
+            # all-invalid — exactly the unfused leg's missing-band
+            # zeros/invalid output after scale-to-byte
+        # granules of unreferenced namespaces are dropped: the output
+        # is independent of them, and subset re-ranking preserves each
+        # kept namespace's relative priority order (same mosaic winners)
+        kept = [g for g in granules if g.namespace in slot_of]
+        if not kept:
+            return None
+        ns_ids = [slot_of[g.namespace] for g in kept]
+        order = M.priority_order([g.timestamp for g in kept])
+        prio = [0.0] * len(kept)
+        for rank, i in enumerate(order):
+            prio[i] = float(len(kept) - rank)
+        return kept, ns_ids, prio, len(fp.slots), fp
+
     def composite_dispatch(self, req: GeoTileRequest, made,
                            offset: float = 0.0, scale: float = 0.0,
                            clip: float = 0.0, colour_scale: int = 0,
                            auto: bool = True):
+        if len(made) == 5:      # `_expr_prep` form: fused band algebra
+            granules, ns_ids, prio, n_slots, fp = made
+            out = self.executor.render_expr_byte(
+                granules, ns_ids, prio, req.dst_gt(), req.crs,
+                req.height, req.width, n_slots, fp, req.resample,
+                offset, scale, clip, colour_scale, auto)
+            if out is None:
+                from ..ops.paged import note_expr_fused
+                note_expr_fused("unfused")
+            return out
         granules, ns_ids, prio, n_ns = made
         return self.executor.render_byte_scenes(
             granules, ns_ids, prio, req.dst_gt(), req.crs,

@@ -697,7 +697,7 @@ class WaveScheduler:
                 "mesh.stage",
                 lambda: md.stage_wave(self, kind, es))
             return _StagedWave(kind, key, es, mesh=md, dev=dev)
-        if kind in ("byte", "scored"):
+        if kind in ("byte", "scored", "expr"):
             try:
                 from . import autoplan
                 plan = autoplan.plan_wave_group(kind, es,
@@ -717,10 +717,14 @@ class WaveScheduler:
                 "ctrls": np.stack([e.payload["ctrl"] for e in es]
                                   + [es[0].payload["ctrl"]] * (Np - N))
             }
-            if kind == "byte":
+            if kind in ("byte", "expr"):
                 host["sps"] = np.stack(
                     [e.payload["sp"] for e in es]
                     + [es[0].payload["sp"]] * (Np - N))
+            if kind == "expr":
+                host["consts"] = np.stack(
+                    [e.payload["consts"] for e in es]
+                    + [es[0].payload["consts"]] * (Np - N))
             if plan is not None and plan.route == "superblock":
                 host["tables"] = np.asarray(plan.tables)
                 host["params"] = np.asarray(plan.params)
@@ -879,6 +883,8 @@ class WaveScheduler:
             return self._dispatch_byte(es, plan, staged)
         if kind == "scored":
             return self._dispatch_scored(es, plan, staged)
+        if kind == "expr":
+            return self._dispatch_expr(es, plan, staged)
         if kind == "drill":
             return self._dispatch_drill(es, staged)
         raise ValueError(f"unknown wave kind {kind!r}")
@@ -1035,6 +1041,87 @@ class WaveScheduler:
             for e in es:
                 e.cleanup_once()
 
+    def _dispatch_expr(self, es: List[_Entry], plan=None, staged=None):
+        """Expression wave: every lane shares one fused paged program
+        (the group key carries the fingerprint, so all lanes evaluate
+        the same STRUCTURE; constants ride as a traced (Np, C) row).
+        The body mirrors `_dispatch_byte` — same planner routes, same
+        ring discipline — with `render_expr_paged_raced` at the
+        bottom."""
+        from ..ops import paged
+        from ..ops.expr import fingerprint_hash
+        from ..ops.paged import render_expr_paged_raced
+        pool = es[0].payload["pool"]
+        (method, n_ns, out_hw, step, auto, colour_scale,
+         fp) = es[0].key[0]
+        try:
+            N = len(es)
+            Np = _pow2(N)
+
+            def _xla():
+                # per-tile unfused legs (bucketed scored mosaic + the
+                # same epilogue + scale) stacked to the wave contract
+                from ..ops.paged import expr_epilogue
+                from ..ops.scale import scale_to_byte
+                from ..ops.warp import warp_scenes_ctrl_scored
+                from .executor import _dev_win0    # lazy: avoids cycle
+                outs = []
+                for e in es:
+                    stack, bparams, bwin, bwin0 = e.payload["xla"]
+                    c, b = warp_scenes_ctrl_scored(
+                        stack, jnp.asarray(e.payload["ctrl"]),
+                        jnp.asarray(bparams), method, n_ns, out_hw,
+                        step, win=bwin, win0=_dev_win0(bwin0))
+                    plane, ok = expr_epilogue(
+                        c[None], b[None], fp,
+                        jnp.asarray(e.payload["consts"][None]))
+                    sp = e.payload["sp"]
+                    outs.append(scale_to_byte(
+                        plane[0], ok[0], float(sp[0]), float(sp[1]),
+                        float(sp[2]), colour_scale, auto))
+                outs += [outs[0]] * (Np - N)
+                return jnp.stack(outs)
+
+            if plan is not None and plan.route == "bucketed":
+                paged.note_gather(plan.bucketed_bytes)
+                dev = _xla()
+                return (self.ring.put(dev),)
+            blk = plan.blk if plan is not None else None
+            sb_of = None
+            if staged is not None:
+                tables = staged["tables"]
+                params = staged["params"]
+                ctrls = staged["ctrls"]
+                sps = staged["sps"]
+                consts = staged["consts"]
+                sb_of = staged.get("sb_of")
+            else:
+                ctrls = jnp.asarray(np.stack(
+                    [e.payload["ctrl"] for e in es]
+                    + [es[0].payload["ctrl"]] * (Np - N)))
+                sps = jnp.asarray(np.stack(
+                    [e.payload["sp"] for e in es]
+                    + [es[0].payload["sp"]] * (Np - N)))
+                consts = jnp.asarray(np.stack(
+                    [e.payload["consts"] for e in es]
+                    + [es[0].payload["consts"]] * (Np - N)))
+                if plan is not None and plan.route == "superblock":
+                    tables = jnp.asarray(plan.tables)
+                    params = jnp.asarray(plan.params)
+                    sb_of = jnp.asarray(plan.sb_of)
+                else:
+                    t_h, p_h = self._stack_tables(es, Np)
+                    tables, params = jnp.asarray(t_h), jnp.asarray(p_h)
+            with pool.locked_pool() as parr:
+                dev = render_expr_paged_raced(
+                    parr, tables, params, ctrls, sps, consts, method,
+                    n_ns, out_hw, step, auto, colour_scale, fp,
+                    fingerprint_hash(fp), _xla, blk=blk, sb_of=sb_of)
+            return (self.ring.put(dev),)
+        finally:
+            for e in es:
+                e.cleanup_once()
+
     def _dispatch_drill(self, es: List[_Entry], staged=None):
         from ..ops.paged import wave_drill_stats
         clip_lo, clip_hi, pix = es[0].key[1:]
@@ -1069,6 +1156,25 @@ class WaveScheduler:
                    {"pool": pool, "tables": np.asarray(tables),
                     "params16": np.asarray(params16),
                     "ctrl": np.asarray(ctrl), "sp": np.asarray(sp),
+                    "xla": xla_item},
+                   percall, current_token(),
+                   cleanup=lambda: pool.unpin(tables))
+        return self._wait(self._submit(e))
+
+    def render_expr(self, pool, tables, params16, ctrl, sp, consts,
+                    statics: tuple, xla_item, percall) -> np.ndarray:
+        """Submit one fused expression render (`render_byte` contract
+        plus ``consts``, the lane's lifted literals (C,) f32).  The
+        group key includes the fingerprint (statics[-1]), so lanes
+        coalesce exactly when they share structure — mixed expression
+        storms still wave within each structure.  Blocks; returns host
+        uint8 (H, W)."""
+        from ..resilience import current_token
+        e = _Entry("expr", (tuple(statics), id(pool)),
+                   {"pool": pool, "tables": np.asarray(tables),
+                    "params16": np.asarray(params16),
+                    "ctrl": np.asarray(ctrl), "sp": np.asarray(sp),
+                    "consts": np.asarray(consts, np.float32),
                     "xla": xla_item},
                    percall, current_token(),
                    cleanup=lambda: pool.unpin(tables))

@@ -351,6 +351,17 @@ class OWSServer:
         except Exception:  # autoplanner optional in this build
             pass
         try:
+            # fused band algebra (GSKY_EXPR_FUSE, docs/KERNELS.md):
+            # compile-cache hit rate, distinct fused programs, and how
+            # expression renders routed (percall/wave/mesh/unfused)
+            from ..ops.expr import expr_cache_stats, expr_fuse_enabled
+            from ..ops.paged import expr_fused_stats
+            doc["expr"] = {"fuse": expr_fuse_enabled(),
+                           "cache": expr_cache_stats(),
+                           **expr_fused_stats()}
+        except Exception:  # expr tier optional in this build
+            pass
+        try:
             from ..pipeline.drill_cache import default_drill_cache as dc
             from ..pipeline.executor import default_executor as ex
             from ..pipeline.scene_cache import default_scene_cache as sc

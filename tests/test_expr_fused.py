@@ -1,0 +1,633 @@
+"""Fused band algebra (`ops/expr.py` fingerprints + the expression
+epilogue in `ops/paged.py`, routed by `pipeline/tile.py` and the wave
+scheduler): interpret-mode byte parity of the fused paged program
+against the production unfused leg (`evaluate_expressions` +
+`ops.scale.scale_to_byte`) across the full expression grammar, nodata
+intersection with disjoint per-band validity, page-boundary-straddling
+multi-band windows, wave and mesh byte identity vs per-call, the `ex1`
+ledger token scheme, fingerprint normalization, the compile-cache LRU,
+and the GSKY_EXPR_FUSE=0 escape hatch."""
+
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+import test_paged
+from gsky_tpu.ops import kernel_ledger, paged
+from gsky_tpu.ops.expr import (BandExpressions, compile_expr,
+                               eval_fingerprint, expr_cache_stats,
+                               expr_fuse_enabled, fingerprint,
+                               fingerprint_hash,
+                               reset_expr_cache)
+from gsky_tpu.ops.scale import scale_to_byte
+from gsky_tpu.ops.warp import warp_scenes_ctrl_scored
+from gsky_tpu.pipeline import waves as W
+from gsky_tpu.pipeline.tile import TilePipeline, evaluate_expressions
+
+
+@pytest.fixture(autouse=True)
+def _tmp_ledger(tmp_path, monkeypatch):
+    """Hermetic race ledger per test (same rule as tests/test_paged.py)."""
+    monkeypatch.setenv("GSKY_KERNEL_LEDGER",
+                       str(tmp_path / "ledger.jsonl"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_expr_stats():
+    paged.reset_expr_fused_stats()
+    yield
+    paged.reset_expr_fused_stats()
+
+
+# the parity matrix: every grammar production the parser accepts —
+# comparisons, && || !, ternary, functions, unary minus, % and **.
+# Literals appear only against variables (never const-const): the
+# unfused interpreter folds const-const subexpressions in python
+# doubles at trace time, which is the one known (<= 2 ulp) divergence
+# from the f32 traced constants of the fused epilogue.
+GRAMMAR = [
+    "(a - b) / (a + b)",                            # NDVI shape
+    "a > 1200 ? a : b",                             # ternary + compare
+    "(a >= 800 && b < 2500) ? a - b : -b",          # && + unary minus
+    "a < 600 || b != 0 ? max(a, b) : min(a, b)",    # || + 2-arg funcs
+    "sqrt(abs(a - b)) + log10(b)",                  # nested calls
+    "!(a > b) * 254",                               # ! yields 0/1
+    "a % 97 + pow(b, 0.5)",                         # modulo + pow
+    "floor(a / 16) * 16 == a ? 1 : a",              # == yields 0/1
+]
+
+
+def _bx(srcs):
+    """BandExpressions for raw expression strings.  Comparisons contain
+    '=' so the `name = expr` config split can't carry them — this is the
+    `compile_expr` construction the VRT/WPS callers use."""
+    ces = [compile_expr(s) for s in srcs]
+    return BandExpressions(
+        expressions=ces, expr_names=[f"e{i}" for i in range(len(ces))],
+        var_list=sorted({v for ce in ces for v in ce.variables}),
+        expr_var_ref=[list(ce.variables) for ce in ces],
+        expr_text=list(srcs), passthrough=False)
+
+
+def _expr_tile(seed, S=96, h=64, w=64, step=16, lo=1.0, hi=4000.0,
+               nan_a=((10, 30), (10, 30)), nan_b=((20, 44), (24, 48))):
+    """Two-variable expression tile: one granule per variable (var 'a'
+    = granule 0, 'b' = granule 1), overlapping-but-distinct NaN patches
+    so the nodata intersection has all four valid/invalid quadrants."""
+    rng = np.random.default_rng(seed)
+    stack = rng.uniform(lo, hi, (2, S, S)).astype(np.float32)
+    if nan_a is not None:
+        stack[0, nan_a[0][0]:nan_a[0][1], nan_a[1][0]:nan_a[1][1]] = \
+            np.nan
+    if nan_b is not None:
+        stack[1, nan_b[0][0]:nan_b[0][1], nan_b[1][0]:nan_b[1][1]] = \
+            np.nan
+    gh = (h - 1 + step - 1) // step + 1
+    gw = (w - 1 + step - 1) // step + 1
+    ctrl = np.stack([
+        np.linspace(4.0, S - 12.0, gw,
+                    dtype=np.float32)[None, :].repeat(gh, 0),
+        np.linspace(4.0, S - 12.0, gh,
+                    dtype=np.float32)[:, None].repeat(gw, 1)])
+    params = np.zeros((2, 11), np.float32)
+    for k in range(2):
+        # the affine carries a per-seed jitter: distinct tiles must not
+        # share a params[:11] block, or the planner's superblock
+        # clusterer would (correctly, per its content-keyed-pool
+        # contract) treat them as reading identical pages
+        params[k] = [0.4 * k - 0.2 + 0.003 * seed, 1.01, 0.02,
+                     0.3 * k + 0.002 * seed, -0.01, 0.99,
+                     S, S, -999.0, 100.0 - k, k]
+    return (jnp.asarray(stack), jnp.asarray(ctrl),
+            jnp.asarray(params), h, w, step)
+
+
+def _slot_params(params, fp, var_of_granule=("a", "b")):
+    """Re-map granule ns ids onto the fingerprint's slot order (slot k
+    = k-th distinct variable by first use — `_expr_prep`'s contract)."""
+    slot = {v: i for i, v in enumerate(fp.slots)}
+    p = np.asarray(params).copy()
+    for k, var in enumerate(var_of_granule[:p.shape[0]]):
+        p[k, 10] = slot[var]
+    return jnp.asarray(p)
+
+
+def _ref_byte(src, stack, ctrl, params, h, w, step, sp, auto=True,
+              cs=0, names=("a", "b")):
+    """The production UNFUSED leg: per-namespace scored warp + mosaic,
+    `evaluate_expressions` (the tile merger's stage), byte scaling."""
+    exprs = _bx([src])
+    n_ns = len(names)
+    canv, best = warp_scenes_ctrl_scored(stack, ctrl, params, "near",
+                                         n_ns, (h, w), step)
+    data_env = {n: np.asarray(canv[i]) for i, n in enumerate(names)}
+    valid_env = {n: np.asarray(best[i]) > -np.inf
+                 for i, n in enumerate(names)}
+    res = evaluate_expressions(exprs, data_env, valid_env, h, w)
+    name = exprs.expr_names[0]
+    out = scale_to_byte(jnp.asarray(res.data[name])[None],
+                        jnp.asarray(res.valid[name])[None],
+                        float(sp[0]), float(sp[1]), float(sp[2]),
+                        cs, auto)
+    return np.asarray(out[0])
+
+
+def _fused_byte(pool, src, stack, ctrl, params, h, w, step, sp,
+                auto=True, cs=0, serial0=100,
+                var_of_granule=("a", "b")):
+    """The fused leg: stage pages, one `render_expr_paged` dispatch."""
+    from gsky_tpu.pipeline.executor import _bucket_pow2
+    ce = compile_expr(src)
+    fp = fingerprint(ce)
+    # `_expr_prep` drops granules whose namespace the expression never
+    # references — mirror that before staging.
+    keep = [k for k in range(np.asarray(params).shape[0])
+            if var_of_granule[k] in fp.slots]
+    stack = jnp.asarray(stack)[np.asarray(keep)]
+    params = jnp.asarray(params)[np.asarray(keep)]
+    kept_vars = tuple(var_of_granule[k] for k in keep)
+    p = _slot_params(params, fp, kept_vars)
+    tables, p16 = test_paged._stage_full(pool, stack, p, serial0)
+    n_ns = _bucket_pow2(fp.n_slots)
+    consts = fp.const_array()
+    with pool.locked_pool() as parr:
+        out = paged.render_expr_paged(
+            parr, jnp.asarray(tables[None]), jnp.asarray(p16),
+            jnp.asarray(ctrl)[None], jnp.asarray(sp[None]),
+            jnp.asarray(consts[None]), "near", n_ns, (h, w), step,
+            auto, cs, fp.key, interpret=True)
+    pool.unpin(tables)
+    return np.asarray(out[0])
+
+
+class TestFusedParityMatrix:
+    @pytest.mark.parametrize("src", GRAMMAR)
+    def test_grammar_byte_exact_auto(self, src):
+        stack, ctrl, params, h, w, step = _expr_tile(0)
+        pool = test_paged._pool()
+        sp = np.zeros(3, np.float32)
+        fused = _fused_byte(pool, src, stack, ctrl, params, h, w, step,
+                            sp)
+        ref = _ref_byte(src, stack, ctrl, params, h, w, step, sp)
+        np.testing.assert_array_equal(ref, fused)
+
+    @pytest.mark.parametrize("src", GRAMMAR[:3])
+    def test_fixed_scale_byte_exact(self, src):
+        stack, ctrl, params, h, w, step = _expr_tile(1)
+        pool = test_paged._pool()
+        sp = np.array([10.0, 0.05, 0.0], np.float32)
+        fused = _fused_byte(pool, src, stack, ctrl, params, h, w, step,
+                            sp, auto=False)
+        ref = _ref_byte(src, stack, ctrl, params, h, w, step, sp,
+                        auto=False)
+        np.testing.assert_array_equal(ref, fused)
+
+    def test_f32_plane_parity_2ulp(self):
+        """The pre-scaling f32 plane itself: the fingerprint evaluator
+        over interpolated canvases is bit-identical to the unfused
+        interpreter (`CompiledExpr.eval_masked`) — same `_emit` op
+        sequence, traced constants."""
+        src = "(a >= 800 && b < 2500) ? a - b : -b"
+        stack, ctrl, params, h, w, step = _expr_tile(2)
+        ce = compile_expr(src)
+        fp = fingerprint(ce)
+        canv, best = warp_scenes_ctrl_scored(stack, ctrl, params,
+                                             "near", 2, (h, w), step)
+        env = {"a": canv[0], "b": canv[1]}
+        venv = {"a": best[0] > -jnp.inf, "b": best[1] > -jnp.inf}
+        o_ref, ok_ref = ce.eval_masked(env, venv)
+        plane, ok = paged.expr_epilogue(
+            canv[None], best[None], fp.key,
+            jnp.asarray(fp.const_array()[None]))
+        np.testing.assert_array_equal(np.asarray(ok_ref),
+                                      np.asarray(ok[0]))
+        ref = np.where(np.asarray(ok_ref), np.asarray(o_ref), 0.0)
+        np.testing.assert_array_almost_equal_nulp(
+            ref.astype(np.float32), np.asarray(plane[0]), nulp=2)
+
+
+class TestNodataSemantics:
+    def test_disjoint_validity_intersects(self):
+        """Valid iff valid in EVERY referenced variable: disjoint NaN
+        patches per band, plus mixed valid/invalid quadrants — the
+        fused bytes match the merger's intersection exactly, nodata
+        pixels are 255, and real data survives where both bands do."""
+        src = "(a - b) / (a + b)"
+        stack, ctrl, params, h, w, step = _expr_tile(
+            3, nan_a=((0, 48), (0, 48)), nan_b=((24, 80), (24, 80)))
+        pool = test_paged._pool()
+        sp = np.zeros(3, np.float32)
+        fused = _fused_byte(pool, src, stack, ctrl, params, h, w, step,
+                            sp)
+        ref = _ref_byte(src, stack, ctrl, params, h, w, step, sp)
+        np.testing.assert_array_equal(ref, fused)
+        assert (fused == 255).any()         # intersection lost pixels
+        assert (fused != 255).any()         # but not all of them
+
+    def test_missing_variable_all_invalid(self):
+        """A referenced variable with NO granules (unresolvable band):
+        the fused slot gathers nothing -> every pixel invalid, byte-
+        identical to `evaluate_expressions`' missing-band zeros."""
+        src = "(a - b) / (a + b)"
+        stack, ctrl, params, h, w, step = _expr_tile(4)
+        pool = test_paged._pool()
+        sp = np.zeros(3, np.float32)
+        # keep only granule 0 (var 'a'); slot 1 stays empty
+        fused = _fused_byte(pool, src, stack[:1], ctrl, params[:1], h,
+                            w, step, sp, var_of_granule=("a",))
+        exprs = _bx([src])
+        res = evaluate_expressions(
+            exprs, {"a": np.zeros((h, w), np.float32)},
+            {"a": np.zeros((h, w), bool)}, h, w)
+        name = exprs.expr_names[0]
+        ref = np.asarray(scale_to_byte(
+            jnp.asarray(res.data[name])[None],
+            jnp.asarray(res.valid[name])[None], 0.0, 0.0, 0.0, 0,
+            True)[0])
+        np.testing.assert_array_equal(ref, fused)
+        assert (fused == 255).all()
+
+
+class TestPageWalkMultiBand:
+    def test_page_boundary_straddling_two_band_windows(self):
+        """256-px scenes over 64x128 pages: BOTH variables' gathers
+        walk 4x2 page grids with taps crossing page boundaries in both
+        axes, and the fused bytes still match the unfused leg."""
+        src = "a > 1200 ? a : b"
+        stack, ctrl, params, h, w, step = _expr_tile(5, S=256)
+        pool = test_paged._pool()
+        sp = np.zeros(3, np.float32)
+        ce = compile_expr(src)
+        fp = fingerprint(ce)
+        p = _slot_params(params, fp)
+        tables, _ = test_paged._stage_full(pool, stack, p, serial0=900)
+        assert tables.shape[1] >= 8         # really a multi-page walk
+        pool.unpin(tables)
+        fused = _fused_byte(pool, src, stack, ctrl, params, h, w, step,
+                            sp, serial0=900)
+        ref = _ref_byte(src, stack, ctrl, params, h, w, step, sp)
+        np.testing.assert_array_equal(ref, fused)
+
+
+class TestFingerprint:
+    def test_structure_shared_across_names_and_consts(self):
+        a = fingerprint(compile_expr("(nir - red) / (nir + red)"))
+        b = fingerprint(compile_expr("(b5 - b4) / (b5 + b4)"))
+        assert a.key == b.key and a.hash == b.hash
+        c = fingerprint(compile_expr("a > 1 ? 1 : 0"))
+        d = fingerprint(compile_expr("a > 2 ? 1 : 0"))
+        assert c.key == d.key
+        assert c.consts == (1.0, 1.0, 0.0)
+        assert d.consts == (2.0, 1.0, 0.0)   # occurrence order, no dedup
+        e = fingerprint(compile_expr("a >= 1 ? 1 : 0"))
+        assert e.key != c.key                # structure differs
+
+    def test_slots_first_use_order(self):
+        fp = fingerprint(compile_expr("b4 < b8 ? b8 : b4"))
+        assert fp.slots == ("b4", "b8")
+        ce = compile_expr("b4 < b8 ? b8 : b4")
+        assert tuple(ce.variables) == fp.slots   # env order == slots
+
+    def test_eval_fingerprint_matches_interpreter(self):
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.uniform(1, 100, (8, 8)).astype(np.float32))
+        y = jnp.asarray(rng.uniform(1, 100, (8, 8)).astype(np.float32))
+        for src in GRAMMAR:
+            ce = compile_expr(src)
+            fp = fingerprint(ce)
+            ref = ce({"a": x, "b": y})
+            planes = [x if v == "a" else y for v in fp.slots]
+            consts = [jnp.float32(c) for c in fp.consts]
+            got = eval_fingerprint(fp.key, planes, consts)
+            np.testing.assert_array_equal(np.asarray(ref),
+                                          np.asarray(got))
+
+    def test_compile_cache_lru_counts(self):
+        reset_expr_cache()
+        compile_expr("x + 1")
+        compile_expr("x + 1")
+        compile_expr("x + 2")
+        st = expr_cache_stats()
+        assert st["hits"] == 1 and st["misses"] == 2
+        assert st["size"] == 2 and st["cap"] >= 2
+        reset_expr_cache()
+        assert expr_cache_stats() == {"size": 0, "cap": st["cap"],
+                                      "hits": 0, "misses": 0}
+
+    def test_cache_cap_env_evicts_lru(self, monkeypatch):
+        monkeypatch.setenv("GSKY_EXPR_CACHE", "2")
+        reset_expr_cache()
+        try:
+            compile_expr("x + 1")
+            compile_expr("x + 2")
+            compile_expr("x + 1")        # refresh: x+2 is now LRU
+            compile_expr("x + 3")        # evicts x+2
+            st = expr_cache_stats()
+            assert st["size"] == 2 and st["cap"] == 2
+            compile_expr("x + 1")        # still resident
+            compile_expr("x + 2")        # evicted: recompiles
+            st = expr_cache_stats()
+            assert st["hits"] == 2 and st["misses"] == 4
+        finally:
+            reset_expr_cache()
+
+
+class TestLedgerToken:
+    def test_expr_tokens_lead_with_ex1(self):
+        pool_arr = jnp.zeros((2, test_paged.PR, test_paged.PC),
+                             jnp.float32)
+        tables = jnp.zeros((1, 2, 2), jnp.int32)
+        tok = paged._expr_token(pool_arr, tables, "near", 2, (64, 64),
+                                16, True, 0, "abcdef123456")
+        assert tok[0] == paged.EXPR_TOKEN_VERSION == "ex1"
+        assert "abcdef123456" in tok
+        assert kernel_ledger.token_version_ok("render_expr_paged", tok)
+        # foreign schemes rejected both ways
+        assert not kernel_ledger.token_version_ok(
+            "render_expr_paged", ((8, 512, 512), "near"))
+        assert not kernel_ledger.token_version_ok(
+            "render_expr_paged", ("pg1", 1, 4, 2))
+        assert not kernel_ledger.token_version_ok(
+            "warp_scored_paged", tok)
+
+    def test_verdict_roundtrip_by_fingerprint(self):
+        """An `ex1` verdict persists and reloads onto the SAME kernel
+        + token (fingerprint included) while stale schemes stay out."""
+        from gsky_tpu.ops import pallas_tpu as pt
+        pool_arr = jnp.zeros((2, test_paged.PR, test_paged.PC),
+                             jnp.float32)
+        tables = jnp.zeros((1, 2, 2), jnp.int32)
+        tok = paged._expr_token(pool_arr, tables, "near", 2, (64, 64),
+                                16, True, 0, "abcdef123456")
+        stale = ("pg1", 1, 4, 2)
+        kernel_ledger.record("render_expr_paged", tok, "demoted",
+                             1.0, 2.0)
+        kernel_ledger.record("render_expr_paged", stale, "demoted",
+                             1.0, 2.0)
+        saved = set(pt._SLOW)
+        try:
+            assert pt.reload_ledger() >= 1
+            assert ("render_expr_paged", tok) in pt._SLOW
+            assert ("render_expr_paged", stale) not in pt._SLOW
+        finally:
+            pt._SLOW.clear()
+            pt._SLOW.update(saved)
+
+
+def _prep_pipe(granules):
+    """A TilePipeline shell whose index stage returns crafted granules —
+    drives the real `_expr_prep` qualification + slot mapping."""
+    p = TilePipeline.__new__(TilePipeline)
+    p.remote = None
+    p._timed_index = lambda req, spans=None: list(granules)
+    return p
+
+
+def _g(ns, ts):
+    return SimpleNamespace(namespace=ns, timestamp=ts, path=f"/{ns}")
+
+
+def _req(srcs):
+    return SimpleNamespace(mask=None, band_exprs=_bx(srcs))
+
+
+class TestPrepQualification:
+    def test_slots_resolution_and_unreferenced_drop(self):
+        gs = [_g("red", 1.0), _g("nir", 2.0), _g("nir", 3.0),
+              _g("cloud", 4.0)]
+        pipe = _prep_pipe(gs)
+        made = pipe.composite_prep(_req(["(nir - red) / (nir + red)"]))
+        assert made is not None and len(made) == 5
+        kept, ns_ids, prio, n_slots, fp = made
+        assert n_slots == 2 and fp.slots == ("nir", "red")
+        assert [g.namespace for g in kept] == ["red", "nir", "nir"]
+        assert ns_ids == [1, 0, 0]          # slot 0 = nir (first use)
+        # newest-first priorities survive the unreferenced-drop re-rank
+        assert prio[2] > prio[1] > prio[0]
+
+    def test_axis_suffix_unique_candidate_resolves(self):
+        gs = [_g("nir#t=1", 1.0), _g("red#t=1", 2.0)]
+        made = _prep_pipe(gs).composite_prep(
+            _req(["(nir - red) / (nir + red)"]))
+        assert made is not None and len(made) == 5
+        assert made[1] == [0, 1]
+        # ambiguous candidates stay unresolved: those granules drop
+        gs2 = [_g("nir#t=1", 1.0), _g("nir#t=2", 2.0), _g("red", 3.0)]
+        made2 = _prep_pipe(gs2).composite_prep(
+            _req(["(nir - red) / (nir + red)"]))
+        assert [g.namespace for g in made2[0]] == ["red"]
+        assert made2[1] == [1]
+
+    def test_bare_var_keeps_legacy_4_tuple(self):
+        gs = [_g("red", 1.0)]
+        made = _prep_pipe(gs).composite_prep(_req(["red"]))
+        assert made is not None and len(made) == 4
+
+    def test_escape_hatch_and_disqualifiers(self, monkeypatch):
+        gs = [_g("nir", 1.0), _g("red", 2.0)]
+        src = ["(nir - red) / (nir + red)"]
+        assert _prep_pipe(gs).composite_prep(_req(src)) is not None
+        monkeypatch.setenv("GSKY_EXPR_FUSE", "0")
+        assert not expr_fuse_enabled()
+        assert _prep_pipe(gs).composite_prep(_req(src)) is None
+        monkeypatch.delenv("GSKY_EXPR_FUSE")
+        assert expr_fuse_enabled()
+        # multiple expressions / no granules: unfused leg
+        assert _prep_pipe(gs).composite_prep(
+            _req(["nir - red", "nir + red"])) is None
+        assert _prep_pipe([]).composite_prep(_req(src)) is None
+
+
+class TestExprWaves:
+    @pytest.fixture(autouse=True)
+    def _fresh_waves(self):
+        W.reset_waves()
+        yield
+        W.reset_waves()
+
+    def _submit(self, sched, pool, src, tile, sp, results, errors, i,
+                serial0, percall=None):
+        from gsky_tpu.pipeline.executor import _bucket_pow2
+        stack, ctrl, params, h, w, step = tile
+        ce = compile_expr(src)
+        fp = fingerprint(ce)
+        p = _slot_params(params, fp)
+        tables, p16 = test_paged._stage_full(pool, stack, p, serial0)
+        n_ns = _bucket_pow2(fp.n_slots)
+        statics = ("near", n_ns, (h, w), step, True, 0, fp.key)
+
+        def go():
+            try:
+                results[i] = sched.render_expr(
+                    pool, tables, p16, np.asarray(ctrl), sp,
+                    fp.const_array(), statics,
+                    (stack, p, None, None), percall)
+            except Exception as e:   # noqa: BLE001 - asserted by caller
+                errors[i] = e
+        t = threading.Thread(target=go)
+        t.start()
+        return t
+
+    def test_wave_byte_identity_and_fp_grouping(self, monkeypatch):
+        """Two same-structure expressions (different literals) join ONE
+        wave group (the fingerprint key groups them); a structurally
+        different third gets its own program.  Every lane's bytes equal
+        its per-call fused render and the unfused reference."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        pool = test_paged._pool(cap=64)
+        sched = W.WaveScheduler(tick_ms=5000.0)   # stepped manually
+        sp = np.zeros(3, np.float32)
+        srcs = ["a > 1200 ? a : b", "a > 900 ? a : b",
+                "(a - b) / (a + b)"]
+        tiles = [_expr_tile(s) for s in range(3)]
+        results = [None] * 3
+        errors = [None] * 3
+        ts = [self._submit(sched, pool, srcs[i], tiles[i], sp, results,
+                           errors, i, serial0=100 * (i + 1))
+              for i in range(3)]
+        import time
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10:
+            with sched._lock:
+                if len(sched._pending) >= 3:
+                    break
+            time.sleep(0.002)
+        assert sched.run_wave() == 3
+        for t in ts:
+            t.join(timeout=60)
+        assert errors == [None] * 3
+        st = sched.stats()
+        assert st["requests"] == 3
+        assert st["dispatches"] == 2        # fp-grouped: 2 programs
+        for i, src in enumerate(srcs):
+            stack, ctrl, params, h, w, step = tiles[i]
+            ref = _ref_byte(src, stack, ctrl, params, h, w, step, sp)
+            np.testing.assert_array_equal(ref, results[i])
+            per = _fused_byte(test_paged._pool(cap=32), src, stack,
+                              ctrl, params, h, w, step, sp)
+            np.testing.assert_array_equal(per, results[i])
+        assert pool.stats()["pinned"] == 0
+        sched.shutdown()
+
+    def test_incident_fails_over_per_entry(self, monkeypatch):
+        """A device incident during the expr wave dispatch re-renders
+        each entry through its own per-call leg (the scheduler's
+        failover contract extends to the expr kind)."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        pool = test_paged._pool(cap=64)
+        sched = W.WaveScheduler(tick_ms=5000.0)
+        monkeypatch.setattr(
+            sched, "_dispatch_group",
+            lambda kind, es: (_ for _ in ()).throw(
+                RuntimeError("injected device incident")))
+        sp = np.zeros(3, np.float32)
+        tile = _expr_tile(0)
+        sentinel = np.full((tile[3], tile[4]), 33, np.uint8)
+        results = [None]
+        errors = [None]
+        t = self._submit(sched, pool, "a > 1200 ? a : b", tile, sp,
+                         results, errors, 0, serial0=70,
+                         percall=lambda: sentinel)
+        import time
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10:
+            with sched._lock:
+                if len(sched._pending) >= 1:
+                    break
+            time.sleep(0.002)
+        sched.run_wave()
+        t.join(timeout=30)
+        assert errors == [None]
+        np.testing.assert_array_equal(results[0], sentinel)
+        assert sched.stats()["fallbacks"] == 1
+        assert pool.stats()["pinned"] == 0
+        sched.shutdown()
+
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 2,
+    reason="mesh parity needs the multi-device host platform")
+
+
+class TestExprMesh:
+    @pytest.fixture(autouse=True)
+    def _fresh(self, monkeypatch):
+        from gsky_tpu.mesh import dispatch as MD
+        for var in ("GSKY_MESH", "GSKY_MESH_RULES"):
+            monkeypatch.delenv(var, raising=False)
+        W.reset_waves()
+        MD.reset_mesh()
+        yield
+        W.reset_waves()
+        MD.reset_mesh()
+
+    def test_expr_descriptor_routes_granule(self):
+        from gsky_tpu.mesh import rules as MR
+        fp = fingerprint(compile_expr("(a - b) / (a + b)"))
+        key = (("near", 2, (64, 64), 16, True, 0, fp.key), 1)
+        desc = MR.describe("expr", key, 3)
+        assert f"fp={fp.hash}" in desc and "kind=expr" in desc
+        assert MR.match_rules(desc) == "granule"
+        wide = (("near", 2, (64, 4096), 16, True, 0, fp.key), 1)
+        assert MR.match_rules(MR.describe("expr", wide, 2)) == "x"
+
+    @needs_mesh
+    def test_mesh_byte_identity_vs_single_chip(self, monkeypatch):
+        """The SAME two expr submissions with GSKY_MESH=1 (granule-
+        sharded fused program over the fake 8-device host mesh) and
+        with the mesh off return identical bytes — and the mesh books
+        the dispatch on the granule layout + the `mesh` fused path."""
+        from gsky_tpu.mesh import dispatch as MD
+
+        def run(mesh_on):
+            monkeypatch.setenv("GSKY_PALLAS", "interpret")
+            if mesh_on:
+                monkeypatch.setenv("GSKY_MESH", "1")
+            else:
+                monkeypatch.delenv("GSKY_MESH", raising=False)
+            MD.reset_mesh()
+            paged.reset_expr_fused_stats()
+            pool = test_paged._pool(cap=64)
+            sched = W.WaveScheduler(tick_ms=5000.0)
+            sp = np.zeros(3, np.float32)
+            tiles = [_expr_tile(0), _expr_tile(1)]
+            results = [None] * 2
+            errors = [None] * 2
+            tw = TestExprWaves()
+            ts = [tw._submit(sched, pool, "a > 1200 ? a : b", tiles[i],
+                             sp, results, errors, i,
+                             serial0=100 * (i + 1))
+                  for i in range(2)]
+            import time
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 10:
+                with sched._lock:
+                    if len(sched._pending) >= 2:
+                        break
+                time.sleep(0.002)
+            assert sched.run_wave() == 2
+            for t in ts:
+                t.join(timeout=60)
+            assert errors == [None, None]
+            assert pool.stats()["pinned"] == 0
+            sched.shutdown()
+            return results
+
+        mesh = run(True)
+        st = MD.mesh_stats()
+        assert st["entries_by_layout"].get("granule", 0) == 2
+        assert paged.expr_fused_stats()["paths"].get("mesh", 0) == 1
+        single = run(False)
+        for m, s in zip(mesh, single):
+            np.testing.assert_array_equal(m, s)
+        sp = np.zeros(3, np.float32)
+        for i in range(2):
+            stack, ctrl, params, h, w, step = _expr_tile(i)
+            ref = _ref_byte("a > 1200 ? a : b", stack, ctrl, params, h,
+                            w, step, sp)
+            np.testing.assert_array_equal(ref, mesh[i])

@@ -366,6 +366,48 @@ def test_fabric_families_render_parse_roundtrip(monkeypatch):
         replicate.stats()["replica_pages"])
 
 
+def test_expr_families_render_parse_roundtrip():
+    """The fused band-algebra families — compile-cache counters, the
+    distinct-program gauge and the path-labelled dispatch counter —
+    render only once the expression tier has seen traffic (an
+    expression-free process keeps its exposition byte-identical) and
+    round-trip the strict parser."""
+    from gsky_tpu.obs.metrics import render_metrics
+    from gsky_tpu.ops import paged
+    from gsky_tpu.ops.expr import compile_expr, reset_expr_cache
+    reset_expr_cache()
+    paged.reset_expr_fused_stats()
+    base = parse_exposition(render_metrics())
+    assert "gsky_expr_programs" not in base
+    assert "gsky_expr_cache_hits_total" not in base
+    assert "gsky_expr_fused_total" not in base
+    try:
+        compile_expr("a / (b + 1.5)")           # miss
+        compile_expr("a / (b + 1.5)")           # hit
+        paged.note_expr_program("cafe01234567")
+        paged.note_expr_fused("wave")
+        paged.note_expr_fused("wave")
+        paged.note_expr_fused("unfused")
+        fams = parse_exposition(render_metrics())
+    finally:
+        reset_expr_cache()
+        paged.reset_expr_fused_stats()
+    hits = "gsky_expr_cache_hits_total"
+    miss = "gsky_expr_cache_misses_total"
+    assert fams[hits]["type"] == "counter"
+    assert fams[hits]["samples"][(hits, ())] == 1.0
+    assert fams[miss]["type"] == "counter"
+    assert fams[miss]["samples"][(miss, ())] == 1.0
+    prog = "gsky_expr_programs"
+    assert fams[prog]["type"] == "gauge"
+    assert fams[prog]["samples"][(prog, ())] == 1.0
+    fused = "gsky_expr_fused_total"
+    assert fams[fused]["type"] == "counter"
+    assert fams[fused]["samples"][(fused, (("path", "wave"),))] == 2.0
+    assert fams[fused]["samples"][
+        (fused, (("path", "unfused"),))] == 1.0
+
+
 # ---------------------------------------------------------------------------
 # trace context
 

@@ -407,6 +407,29 @@ class TestPrewarm:
         assert ("cubic", 1, True, 0) in specs
         assert ("bilinear", 3, True, 0) in specs
 
+    def test_layer_expr_specs_parse_config_entries(self):
+        """Config algebra entries are `name = expr` — the spec sweep
+        must apply the same split the request path does, and dedup
+        structurally identical expressions to one fingerprint."""
+        from gsky_tpu.server.config import Config, Layer
+        from gsky_tpu.server.prewarm import layer_expr_specs
+        lay = Layer.from_json({
+            "name": "algebra", "data_source": "/tmp",
+            "rgb_products": ["ndvi = (a - b) / (a + b)"],
+            "styles": [
+                # same structure, different variable names: one spec
+                {"name": "same",
+                 "rgb_products": ["nd2 = (x - y) / (x + y)"]},
+                {"name": "mask",
+                 "rgb_products": ["m = a > 1200 ? a : b"]},
+                # bare band name: trivial, rides the byte path
+                {"name": "plain", "rgb_products": ["a"]},
+            ]})
+        specs = layer_expr_specs({"": Config(layers=[lay])})
+        assert len(specs) == 2
+        assert {fp.slots for _, _, _, fp in specs} == {
+            ("a", "b"), ("x", "y")}
+
     def test_prewarm_then_render_zero_recompile(self, env):
         """After prewarming the configured layers at a tile size no
         other test uses (128 px), rendering that exact shape through

@@ -217,6 +217,25 @@ with no elastic families in /metrics and no /debug block, and a
 strict /metrics parse with the elastic families present::
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario elastic --seconds 30
+
+``--scenario algebra``: fused band algebra (docs/KERNELS.md
+"Expression epilogue").  ``GSKY_PALLAS=interpret`` engages the
+paged+wave pipeline on CPU with ``GSKY_EXPR_FUSE`` on; a storm
+rotates across WMS styles carrying 12 single-entry ``name = expr``
+band-algebra sources (10 structurally DISTINCT shapes — two styles
+are constant/variable-renamed twins of others) plus a WPS drill
+minority whose data source also carries expressions.  Pass criteria:
+compiles stay bounded (the expression compile cache absorbs the
+storm: misses <= the distinct source count, hits dominate) and the
+fused epilogue shares programs by structural fingerprint (distinct
+fused programs <= distinct structures, so the twins provably share),
+a concurrent volley re-fetched under ``GSKY_EXPR_FUSE=0`` returns
+the SAME PNG bytes (escape-hatch byte identity) while actually
+taking the unfused leg, every response is a clean 200 (zero bare
+5xx), the page pool ends with ZERO pinned pages, and /metrics
+exposes the ``gsky_expr_*`` families through the strict parser.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario algebra --seconds 20
 """
 
 from __future__ import annotations
@@ -304,7 +323,8 @@ def _run(argv=None):
                     choices=("churn", "hot", "wcs", "chaos", "burst",
                              "fleet", "overload", "ingest",
                              "devicechaos", "wave", "mesh", "plan",
-                             "fabric", "occupancy", "elastic"),
+                             "fabric", "occupancy", "elastic",
+                             "algebra"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -349,6 +369,28 @@ def _run(argv=None):
     mas_client = MASClient(store)
     conf_dir = os.path.join(root, "conf")
     os.makedirs(conf_dir)
+    # algebra twin: single-entry `name = expr` styles over two product
+    # namespaces — the fused expression epilogue (GSKY_EXPR_FUSE).
+    # Ten structurally distinct shapes across twelve sources: nd_rev
+    # and mask2 are twins of nd / mask1 (renamed variables, shifted
+    # constant) and must SHARE a fused program — the fingerprint, not
+    # the source text, keys the compile
+    p0, p1 = "LC08_20200110_T1", "LC08_20200111_T1"
+    algebra_styles = [
+        {"name": name, "rgb_products": [src]} for name, src in (
+            ("nd_rev", f"nd_rev = ({p1} - {p0}) / ({p1} + {p0})"),
+            ("mask1", f"mask1 = {p0} > 1200 ? {p1} : {p0}"),
+            ("mask2", f"mask2 = {p0} > 1800 ? {p1} : {p0}"),
+            ("blend", f"blend = 0.5 * {p0} + 0.5 * {p1}"),
+            ("root", f"root = sqrt({p0} * {p1})"),
+            ("dif", f"dif = abs({p0} - {p1})"),
+            ("logr", f"logr = log({p0} + 1000)"),
+            ("gate", f"gate = {p0} > 500 && {p1} > 500 "
+                     f"? {p0} + {p1} : 0"),
+            ("quant", f"quant = floor({p0} / 16) * 16"),
+            ("clip", f"clip = min(max({p0}, 400), 2600)"),
+            ("curve", f"curve = pow({p0} / 3000, 2) * 3000"),
+        )]
     with open(os.path.join(conf_dir, "config.json"), "w") as fp:
         json.dump({
             "service_config": {"ows_hostname": "", "mas_address": ""},
@@ -385,7 +427,13 @@ def _run(argv=None):
                 "time_generator": "mas",
                 "wcs_max_width": 4096, "wcs_max_height": 4096,
                 "wcs_max_tile_width": 256,
-                "wcs_max_tile_height": 256}],
+                "wcs_max_tile_height": 256},
+                {
+                "name": "landsat_algebra", "title": "algebra soak",
+                "data_source": root,
+                "rgb_products": [f"nd = ({p0} - {p1}) / ({p0} + {p1})"],
+                "time_generator": "mas",
+                "styles": algebra_styles}],
             # wave scenario: WPS geometryDrill gives the storm a second
             # result KIND, so drill reductions ride the same scheduler
             # ticks as the tile renders (one stacked dispatch per kind)
@@ -397,6 +445,19 @@ def _run(argv=None):
                     "data_source": root,
                     "rgb_products": [f"LC08_20200{110 + k}_T1"
                                      for k in range(B.N_SCENES)]}],
+                "approx": False},
+                # algebra scenario: the drill minority evaluates band
+                # expressions per date, so the compile cache absorbs
+                # WPS traffic too, not just the styled GetMaps
+                {
+                "identifier": "algebraDrill",
+                "title": "Band-algebra drill",
+                "max_area": 10000,
+                "data_sources": [{
+                    "data_source": root,
+                    "rgb_products": [
+                        f"nd = ({p0} - {p1}) / ({p0} + {p1})",
+                        f"dif = abs({p0} - {p1})"]}],
                 "approx": False}],
         }, fp)
     watcher = ConfigWatcher(conf_dir, mas_factory=lambda a: mas_client,
@@ -466,6 +527,8 @@ def _run(argv=None):
         return run_occupancy(args, watcher, mas_client, merc, boot)
     if args.scenario == "elastic":
         return run_elastic(args, watcher, mas_client, merc, boot)
+    if args.scenario == "algebra":
+        return run_algebra(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -3756,6 +3819,264 @@ def run_elastic(args, watcher, mas_client, merc, boot) -> int:
             peer_srv.stop(0)
         provider.close()
         os.environ["GSKY_ELASTIC"] = "0"
+
+
+def run_algebra(args, watcher, mas_client, merc, boot) -> int:
+    """Fused band algebra: a styled-expression GetMap storm plus a WPS
+    drill minority must keep compiles bounded (the compile cache and
+    structural-fingerprint sharing absorb the source variety), stay
+    byte-identical under GSKY_EXPR_FUSE=0, and leave zero pinned pages
+    (see module docstring for the pass criteria)."""
+    import threading
+    import urllib.parse
+
+    import numpy as np
+
+    from gsky_tpu.geo.crs import EPSG3857, EPSG4326
+    from gsky_tpu.geo.transform import transform_bbox
+    from gsky_tpu.ops import paged
+    from gsky_tpu.ops.expr import (expr_cache_stats, fingerprint,
+                                   parse_band_expressions,
+                                   reset_expr_cache)
+    from gsky_tpu.pipeline.waves import wave_stats
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    # interpret engages paged+wave serving on CPU; a wide tick lets
+    # concurrent styled tiles with one structural fingerprint stack
+    # into a single fused wave dispatch
+    env_overrides = {
+        "GSKY_PALLAS": "interpret",
+        "GSKY_WAVES": "1",
+        "GSKY_WAVE_MAX": "8",
+        "GSKY_WAVE_TICK_MS": "100",
+        "GSKY_EXPR_FUSE": "1",
+        "GSKY_PAGE_SLOTS": "16",
+    }
+    saved_env = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    reset_expr_cache()
+    paged.reset_expr_fused_stats()
+    paged.reset_gather_bytes()
+    try:
+        # gateway off: a response-cache hit would bypass the pipeline
+        # and the bounded-compile claim would measure the cache
+        server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                           metrics=MetricsLogger(), gateway=None)
+        host = boot(server)
+
+        # the storm's source inventory comes from the shared config —
+        # the soak can't drift from what the server actually serves
+        cfg = next(iter(watcher.configs.values()))
+        lay = cfg.layer("landsat_algebra")
+        styles = [""] + [s.name for s in lay.styles]
+        sources = ([lay.rgb_products[0]]
+                   + [s.rgb_products[0] for s in lay.styles])
+        drill_sources = list(
+            cfg.process("algebraDrill").data_sources[0].rgb_products)
+        n_structures = len({
+            fingerprint(parse_band_expressions([s]).expressions[0]).hash
+            for s in sources})
+        n_sources = len(set(sources) | set(drill_sources))
+
+        # tiles sit where BOTH referenced scenes have data (the scenes
+        # anchor at ymax and step diagonally, so the pair's overlap is
+        # the middle of the cluster): fused nodata semantics — valid
+        # iff valid in every referenced variable — still leaves real
+        # pixels on every tile
+        w = merc.width * 0.12
+        xs = np.arange(0.30, 0.62, 0.04)
+        ys = (0.32, 0.44, 0.56, 0.68)
+        tiles = [(float(fx), float(fy)) for fy in ys for fx in xs]
+
+        def getmap_url(style: str, fx: float, fy: float) -> str:
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + w},"
+                  f"{merc.ymin + fy * merc.height + w}")
+            return (f"http://{host}/ows?service=WMS&request=GetMap"
+                    f"&version=1.3.0&layers=landsat_algebra"
+                    f"&styles={style}"
+                    f"&crs=EPSG:3857&bbox={bb}"
+                    f"&width=256&height=256&format=image/png"
+                    f"&time=2020-01-10T00:00:00.000Z")
+
+        # one small drill polygon inside the scene-pair overlap
+        ll = transform_bbox(merc, EPSG3857, EPSG4326)
+        d = 0.03
+        x0 = ll.xmin + 0.40 * (ll.xmax - ll.xmin)
+        y0 = ll.ymax - 0.45 * (ll.ymax - ll.ymin)
+        geom = json.dumps({
+            "type": "FeatureCollection", "features": [{
+                "type": "Feature", "geometry": {
+                    "type": "Polygon", "coordinates": [[
+                        [x0, y0], [x0 + d, y0], [x0 + d, y0 + d],
+                        [x0, y0 + d], [x0, y0]]]}}]})
+        drill_q = urllib.parse.quote(geom)
+        drill_url = (f"http://{host}/ows?service=WPS&request=Execute"
+                     f"&identifier=algebraDrill"
+                     f"&datainputs=geometry={drill_q}")
+
+        lock = threading.Lock()
+        counter = itertools.count()
+        errors: list = []
+
+        def fetch(url: str, kind: str):
+            """(ok, body) — no faults run in this scenario, so
+            anything but a clean 200 with the right body fails."""
+            try:
+                with urllib.request.urlopen(url, timeout=300) as r:
+                    body = r.read()
+                    if r.status != 200:
+                        return False, body
+                    if kind == "map":
+                        return body[:8] == b"\x89PNG\r\n\x1a\n", body
+                    return b"ProcessSucceeded" in body, body
+            except Exception as exc:  # noqa: BLE001 - reported below
+                with lock:
+                    if len(errors) < 5:
+                        errors.append(f"{kind}: {exc!r:.200}")
+                return False, b""
+
+        # warm lap: every style once (each structure compiles its one
+        # fused program here) plus one drill
+        warm_ok = all(fetch(getmap_url(s, *tiles[k]), "map")[0]
+                      for k, s in enumerate(styles))
+        warm_ok = fetch(drill_url, "drill")[0] and warm_ok
+
+        bad = [0]
+        n_req = {"map": 0, "drill": 0}
+
+        def one():
+            i = next(counter)
+            # the drill minority rides the same compile cache; the
+            # map majority rotates styles so concurrent arrivals mix
+            # fingerprints and the scheduler groups them per structure
+            if i % 16 == 7:
+                kind, url = "drill", drill_url
+            else:
+                kind, url = "map", getmap_url(
+                    styles[i % len(styles)], *tiles[i % len(tiles)])
+            ok, _ = fetch(url, kind)
+            with lock:
+                n_req[kind] += 1
+                if not ok:
+                    bad[0] += 1
+
+        conc = max(args.conc, 12)
+        t_end = time.time() + args.seconds
+
+        def storm_worker():
+            while time.time() < t_end:
+                one()
+
+        storm = [threading.Thread(target=storm_worker)
+                 for _ in range(conc)]
+        for t in storm:
+            t.start()
+        for t in storm:
+            t.join()
+
+        cs = expr_cache_stats()
+        ef = paged.expr_fused_stats()
+        fused_n = sum(v for k, v in ef["paths"].items()
+                      if k != "unfused")
+        # bounded compiles: the cache's miss count is the number of
+        # DISTINCT sources ever compiled — a storm that recompiled per
+        # request would blow far past it; the fused program count is
+        # capped by structural identity, so the twin styles provably
+        # shared a program instead of minting their own
+        compiles_bounded = (0 < cs["misses"] <= n_sources
+                            and cs["hits"] > cs["misses"])
+        sharing_ok = 1 <= ef["programs"] <= n_structures
+
+        # -- escape hatch: the SAME concurrent styled volley with
+        # fusion off must be byte-identical and actually take the
+        # unfused leg (the counter moves)
+        probe = [(styles[k % len(styles)], tiles[(5 + 3 * k) %
+                                                 len(tiles)])
+                 for k in range(6)]
+
+        def volley():
+            bodies: list = [None] * len(probe)
+
+            def grab(k, s, t):
+                bodies[k] = fetch(getmap_url(s, *t), "map")[1]
+            ths = [threading.Thread(target=grab, args=(k, s, t))
+                   for k, (s, t) in enumerate(probe)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            return bodies
+
+        bodies_on = volley()
+        unfused_before = ef["paths"].get("unfused", 0)
+        os.environ["GSKY_EXPR_FUSE"] = "0"
+        bodies_off = volley()
+        os.environ["GSKY_EXPR_FUSE"] = "1"
+        unfused_after = paged.expr_fused_stats()["paths"].get(
+            "unfused", 0)
+        byte_identical = (all(b for b in bodies_on)
+                          and bodies_on == bodies_off)
+        unfused_engaged = unfused_after > unfused_before
+
+        # every page the storm pinned must be back once waves drain
+        from gsky_tpu.pipeline import pages
+        pinned = -1
+        t_end = time.time() + 15
+        while time.time() < t_end:
+            pool = pages._default
+            pinned = (pool.stats().get("pinned", -1)
+                      if pool is not None else 0)
+            if pinned == 0:
+                break
+            time.sleep(0.5)
+
+        ws = wave_stats()
+        metrics = check_metrics(host, require=(
+            "gsky_requests_total", "gsky_wave_dispatches_total",
+            "gsky_expr_fused_total", "gsky_expr_cache_hits_total",
+            "gsky_expr_programs"))
+
+        n_done = sum(n_req.values())
+        out = {
+            "scenario": "algebra",
+            "warm_ok": warm_ok,
+            "requests": n_req, "failed": bad[0],
+            "errors": errors,
+            "sources": n_sources, "structures": n_structures,
+            "expr_cache": cs,
+            "fused": {"programs": ef["programs"], "paths": ef["paths"],
+                      "dispatches": fused_n},
+            "compiles_bounded": compiles_bounded,
+            "fingerprint_sharing_ok": sharing_ok,
+            "escape_hatch_byte_identical": byte_identical,
+            "escape_hatch_unfused_engaged": unfused_engaged,
+            "pool_pinned": pinned,
+            "waves": {"dispatches": ws.get("dispatches", 0),
+                      "requests": ws.get("requests", 0)},
+            "metrics": metrics,
+        }
+        print(json.dumps(out))
+        ok = (warm_ok and n_done > 0 and bad[0] == 0
+              and fused_n > 0
+              and compiles_bounded
+              and sharing_ok
+              and byte_identical
+              and unfused_engaged
+              and pinned == 0
+              and not metrics["missing"])
+        print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+        return 0 if ok else 1
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        reset_expr_cache()
+        paged.reset_expr_fused_stats()
 
 
 if __name__ == "__main__":
