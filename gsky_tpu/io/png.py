@@ -12,10 +12,12 @@ import asyncio
 import contextvars
 import io
 import os
+import struct
 import threading
 import time
+import zlib
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 from PIL import Image
@@ -244,6 +246,125 @@ def decode_png(data: bytes) -> np.ndarray:
     resizer `utils/empty_tile.go:14`)."""
     img = Image.open(io.BytesIO(data)).convert("RGBA")
     return np.asarray(img)
+
+
+# -- APNG assembly -----------------------------------------------------------
+# The temporal wave path (docs/PERF.md "Temporal waves") renders every
+# animation frame to ordinary PNG bytes on the encode pool, then splices
+# the frames into one Animated PNG container.  Assembly is pure chunk
+# surgery — no pixel decode, no re-compression — so frame 0's IDAT
+# stream rides VERBATIM: the animation's first frame and the equivalent
+# single-timestep GetMap are the same compressed bytes.
+
+_PNG_SIG = b"\x89PNG\r\n\x1a\n"
+
+
+def _png_chunks(data: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    """Iterate (type, payload) over one PNG byte stream."""
+    if data[:8] != _PNG_SIG:
+        raise ValueError("not a PNG stream")
+    off = 8
+    n = len(data)
+    while off + 12 <= n:
+        ln = struct.unpack(">I", data[off:off + 4])[0]
+        typ = data[off + 4:off + 8]
+        yield typ, data[off + 8:off + 8 + ln]
+        off += 12 + ln
+
+
+def _png_chunk(typ: bytes, payload: bytes) -> bytes:
+    return (struct.pack(">I", len(payload)) + typ + payload
+            + struct.pack(">I", zlib.crc32(typ + payload) & 0xFFFFFFFF))
+
+
+class ApngAssembler:
+    """Incremental APNG container builder over pre-encoded PNG frames.
+
+    ``frame(png)`` returns the wire bytes for that frame — the caller
+    (the OWS animation handler) streams them as each frame's encode
+    completes, so the client sees frame 0 while later timesteps are
+    still on the device.  Frame 0 contributes the header: its IHDR,
+    palette and transparency chunks verbatim, plus the ``acTL``
+    animation control chunk; every frame gets an ``fcTL`` (full-frame,
+    no blending — each timestep replaces the last) and its IDAT data
+    (re-typed ``fdAT`` after frame 0).  All frames must share frame
+    0's geometry and palette — true by construction for one GetMap
+    sequence.  ``trailer()`` closes the stream."""
+
+    def __init__(self, num_frames: int, delay_ms: int = 500,
+                 num_plays: int = 0):
+        if num_frames < 1:
+            raise ValueError("APNG needs at least one frame")
+        self.num_frames = int(num_frames)
+        self.delay_ms = max(1, min(65535, int(delay_ms)))
+        self.num_plays = int(num_plays)
+        self._seq = 0
+        self._n = 0
+        self._w = 0
+        self._h = 0
+
+    def _next_seq(self) -> int:
+        s = self._seq
+        self._seq += 1
+        return s
+
+    def _fctl(self) -> bytes:
+        # full-canvas frame at (0,0), dispose none, blend source
+        return _png_chunk(b"fcTL", struct.pack(
+            ">IIIIIHHBB", self._next_seq(), self._w, self._h, 0, 0,
+            self.delay_ms, 1000, 0, 0))
+
+    def frame(self, png: bytes) -> bytes:
+        """Splice one encoded PNG in; returns its container bytes."""
+        if self._n >= self.num_frames:
+            raise ValueError("more frames than declared in acTL")
+        head: List[Tuple[bytes, bytes]] = []
+        idats: List[bytes] = []
+        for typ, payload in _png_chunks(png):
+            if typ == b"IDAT":
+                idats.append(payload)
+            elif typ != b"IEND" and not idats:
+                head.append((typ, payload))
+        if not idats or not head or head[0][0] != b"IHDR":
+            raise ValueError("malformed PNG frame")
+        parts: List[bytes] = []
+        if self._n == 0:
+            ihdr = head[0][1]
+            self._w = struct.unpack(">I", ihdr[0:4])[0]
+            self._h = struct.unpack(">I", ihdr[4:8])[0]
+            parts.append(_PNG_SIG)
+            parts.append(_png_chunk(b"IHDR", ihdr))
+            # acTL must precede the first IDAT; right after IHDR keeps
+            # the frame's own ancillary chunk order untouched
+            parts.append(_png_chunk(b"acTL", struct.pack(
+                ">II", self.num_frames, self.num_plays)))
+            for typ, payload in head[1:]:
+                parts.append(_png_chunk(typ, payload))
+            parts.append(self._fctl())
+            for payload in idats:
+                parts.append(_png_chunk(b"IDAT", payload))
+        else:
+            parts.append(self._fctl())
+            for payload in idats:
+                parts.append(_png_chunk(
+                    b"fdAT",
+                    struct.pack(">I", self._next_seq()) + payload))
+        self._n += 1
+        return b"".join(parts)
+
+    def trailer(self) -> bytes:
+        if self._n != self.num_frames:
+            raise ValueError(
+                f"assembled {self._n} of {self.num_frames} frames")
+        return _png_chunk(b"IEND", b"")
+
+
+def encode_apng(frames: Sequence[bytes], delay_ms: int = 500,
+                num_plays: int = 0) -> bytes:
+    """Whole-container convenience over `ApngAssembler` (tests/bench;
+    the server streams per-frame instead)."""
+    asm = ApngAssembler(len(frames), delay_ms, num_plays)
+    return b"".join([asm.frame(f) for f in frames] + [asm.trailer()])
 
 
 def empty_tile_png(width: int, height: int,

@@ -12,7 +12,12 @@ decides how the stacked program spreads over the mesh:
   chips, page pool replicated) feeding ONE `shard_map` program whose
   local body is the unchanged paged kernel.  Paged rows are
   bit-independent (ns_id -1 padding, test_waves parity), so the mesh
-  tile bytes equal the single-chip wave bytes exactly.
+  tile bytes equal the single-chip wave bytes exactly.  Animation
+  frame lanes (GSKY_ANIM, docs/PERF.md "Temporal waves") ride this
+  layout too: each lane carries its timestep's granule ``serials`` and
+  the sharded planner (autoplan.plan_sharded) merges same-serial lanes
+  into shared-halo superblocks per chip — the `temporal_lanes` stat
+  below counts how many mesh lanes were temporal.
 - ``x`` — each entry re-renders through the mesh-owned `SpmdRenderer`
   (granule x width `shard_map`): intra-tile parallelism for the 4K+
   WCS export blocks that would serialise a whole chip.
@@ -92,6 +97,9 @@ class MeshDispatcher:
         # counters (under _lock)
         self.waves_by_layout: Dict[str, int] = {}
         self.entries_by_layout: Dict[str, int] = {}
+        # animation frame lanes (payload carries granule serials):
+        # how much of the mesh traffic is temporal, per layout
+        self.temporal_by_layout: Dict[str, int] = {}
         self.skew_ms_last = 0.0
         from ..obs import tsan
         if tsan.enabled():
@@ -597,11 +605,16 @@ class MeshDispatcher:
     # -- accounting ----------------------------------------------------
 
     def _note(self, layout: str, es: List):
+        n_temporal = sum(1 for e in es
+                         if e.payload.get("serials") is not None)
         with self._lock:
             self.waves_by_layout[layout] = \
                 self.waves_by_layout.get(layout, 0) + 1
             self.entries_by_layout[layout] = \
                 self.entries_by_layout.get(layout, 0) + len(es)
+            if n_temporal:
+                self.temporal_by_layout[layout] = \
+                    self.temporal_by_layout.get(layout, 0) + n_temporal
         try:
             MESH_WAVES.labels(layout=layout).inc()
         except Exception:  # prom telemetry only
@@ -644,6 +657,7 @@ class MeshDispatcher:
                     "rules": [(r.source, r.layout) for r in self.rules],
                     "waves_by_layout": dict(self.waves_by_layout),
                     "entries_by_layout": dict(self.entries_by_layout),
+                    "temporal_lanes": dict(self.temporal_by_layout),
                     "skew_ms_last": round(self.skew_ms_last, 3),
                     "programs": len(self._fns)
                     + len(self.spmd._fns)}

@@ -17,6 +17,7 @@ Everything registers against ``prom.default_registry()``; the OWS
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Iterable, List, Tuple
 
 from .prom import default_registry, log_buckets
@@ -628,11 +629,98 @@ def _collect_elastic():
     return out
 
 
+# -- temporal serving (animation waves + streamed DAP4) ----------------
+#
+# Recorded by the OWS animation handler and the DAP4 streaming leg
+# (docs/PERF.md "Temporal waves"); collected at scrape time from this
+# one copy.  A process that never served an animation or a streamed
+# DAP4 response keeps its exposition byte-identical.
+
+_TEMPORAL_LOCK = threading.Lock()
+_TEMPORAL: Dict[str, float] = {
+    "sequences": 0, "frames": 0, "waves": 0, "cancelled": 0,
+    "degraded": 0, "dap_streams": 0, "dap_streamed_bytes": 0,
+    "dap_peak_buffer_bytes": 0}
+
+
+def record_anim_sequence(frames: int, waves: int,
+                         degraded: bool = False,
+                         cancelled: bool = False) -> None:
+    """One animation sequence completed: ``frames`` rendered across
+    ``waves`` wave dispatches (the amortisation the temporal path
+    exists for)."""
+    with _TEMPORAL_LOCK:
+        _TEMPORAL["sequences"] += 1
+        _TEMPORAL["frames"] += int(frames)
+        _TEMPORAL["waves"] += int(waves)
+        if degraded:
+            _TEMPORAL["degraded"] += 1
+        if cancelled:
+            _TEMPORAL["cancelled"] += 1
+
+
+def record_dap_stream(nbytes: int, peak_buffer: int) -> None:
+    """One streamed DAP4 response: bytes on the wire and the largest
+    resident buffer the rechunker held (the bounded-RSS evidence)."""
+    with _TEMPORAL_LOCK:
+        _TEMPORAL["dap_streams"] += 1
+        _TEMPORAL["dap_streamed_bytes"] += int(nbytes)
+        _TEMPORAL["dap_peak_buffer_bytes"] = max(
+            _TEMPORAL["dap_peak_buffer_bytes"], int(peak_buffer))
+
+
+def temporal_stats() -> Dict[str, float]:
+    """The /debug ``temporal`` block (and the test hook)."""
+    with _TEMPORAL_LOCK:
+        st = dict(_TEMPORAL)
+    st["frames_per_wave"] = round(
+        st["frames"] / st["waves"], 4) if st["waves"] else 0.0
+    return st
+
+
+def reset_temporal() -> None:
+    """Test hook: zero the temporal counters."""
+    with _TEMPORAL_LOCK:
+        for k in _TEMPORAL:
+            _TEMPORAL[k] = 0
+
+
+def _collect_temporal():
+    """Temporal-serving surfaces (docs/PERF.md "Temporal waves"):
+    animation sequence/frame amortisation and streamed-DAP4 volume.
+    Rendered only once either path has served — exposition stays
+    byte-identical otherwise."""
+    out: List = []
+    try:
+        st = temporal_stats()
+        if not (st["sequences"] or st["dap_streams"]):
+            return out
+        out.append(_c("gsky_anim_sequences_total",
+                      "Animation sequences served by the temporal "
+                      "wave path, by outcome.",
+                      [({"outcome": "ok"},
+                        float(st["sequences"] - st["cancelled"])),
+                       ({"outcome": "cancelled"},
+                        float(st["cancelled"]))]))
+        out.append(_g("gsky_anim_frames_per_wave",
+                      "Mean animation frames amortised per wave "
+                      "dispatch (frames / waves, process lifetime).",
+                      [({}, float(st["frames_per_wave"]))]))
+        out.append(_c("gsky_dap_streamed_bytes_total",
+                      "Bytes streamed by the bounded-RSS DAP4 export "
+                      "leg (GSKY_DAP_STREAM).",
+                      [({}, float(st["dap_streamed_bytes"]))]))
+    except Exception:
+        # scrape-time collectors must never break /metrics
+        pass
+    return out
+
+
 for _fn in (_collect_caches, _collect_fleet, _collect_resilience,
             _collect_runtime, _collect_batcher, _collect_overload,
             _collect_ingest, _collect_device, _collect_waves,
             _collect_mesh, _collect_expr, _collect_tsan,
-            _collect_fabric, _collect_elastic):
+            _collect_fabric, _collect_elastic, _collect_temporal):
     _REG.register_collector(_fn)
 
 

@@ -326,14 +326,23 @@ def _cluster_and_merge(es, rows, n_ns: int, pr: int, pc: int, blk):
     blocks — same scenes, same affine, same priorities) and merge each
     cluster into superblocks.  Lanes that merge MUST read identical
     page content at shared positions; the content-keyed pool
-    guarantees it for identical granule lists."""
+    guarantees it for identical granule lists.  The signature
+    therefore ALSO carries the lane's scene-serial key when the
+    submitter provided one (``payload["serials"]``, executor wave
+    lanes): two timesteps of one layer share every param — same
+    affine, same priorities — yet hold different pixels, and merging
+    them would gather one timestep's pages for both.  Temporal waves
+    merge exactly the frames whose requested times resolved to the
+    SAME underlying data (WMS-T nearest semantics), which is where the
+    animation path's gather amortisation comes from."""
     from ..ops.paged import page_slots, paged_vmem_ok
     halo = plan_halo_max()
     slot_cap = page_slots()
     clusters: Dict[tuple, List[int]] = {}
     for i, e in enumerate(es):
         p16 = np.asarray(e.payload["params16"], np.float32)
-        key = (p16.shape[0], p16[:, :11].tobytes())
+        key = (p16.shape[0], p16[:, :11].tobytes(),
+               e.payload.get("serials"))
         clusters.setdefault(key, []).append(i)
     sbs = []
     for idxs in clusters.values():

@@ -568,7 +568,8 @@ class WarpExecutor:
                         c, v = default_waves().warp_scored(
                             pool, tables, params16, ctrl_host,
                             (method, n_pad, (height, width), step),
-                            (stack, params, win, win0), _percall)
+                            (stack, params, win, win0), _percall,
+                            serials=groups[0][4])
                         return jnp.asarray(c), jnp.asarray(v)
                     self._count("scene_mosaic_paged", tables.shape)
                     from ..ops.paged import warp_scored_paged_raced
@@ -679,7 +680,8 @@ class WarpExecutor:
 
                     return default_waves().render_byte(
                         pool, tables, params16, ctrl, sp, statics,
-                        (stack, params, win, win0), _percall)
+                        (stack, params, win, win0), _percall,
+                        serials=skey)
                 if batching_enabled():
                     # the paged batch key carries NO stack/shape
                     # identity: tiles over different scene sets and
@@ -806,7 +808,7 @@ class WarpExecutor:
 
             return default_waves().render_expr(
                 pool, tables, params16, ctrl, sp, consts, statics,
-                (stack, params, win, win0), _percall)
+                (stack, params, win, win0), _percall, serials=skey)
         self._count("render_expr_paged", tables.shape)
         note_expr_fused("percall")
         from ..ops.paged import render_expr_paged_raced

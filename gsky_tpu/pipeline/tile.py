@@ -10,6 +10,7 @@ the merger is a vectorised mosaic + jit'd expressions.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -303,6 +304,58 @@ class TilePipeline:
         for rank, i in enumerate(order):
             prio[i] = float(len(kept) - rank)
         return kept, ns_ids, prio, len(fp.slots), fp
+
+    def animation_prep(self, req: GeoTileRequest,
+                       times: Sequence[float],
+                       stats: Optional[Dict[str, int]] = None,
+                       spans: Optional[Dict[str, float]] = None):
+        """ONE index pass for a TIME-range animation: the whole
+        sequence is resolved with a single MAS query over
+        [min(times), max(times)] and partitioned per frame with the
+        same point semantics as a single-timestep request
+        (`granule._select_time_indices`: |timestamp - t| < 1s, untimed
+        granules in every frame), so frame k's granule set — and hence
+        its rendered bytes — matches what a lone GetMap at times[k]
+        would have produced.  A frame with no exact match takes the
+        nearest available timestep (WMS-T nearest-value semantics).
+
+        Returns a list aligned with ``times`` of `composite_prep`-form
+        tuples (granules, ns_ids, prio, n_ns), or None when the
+        request doesn't qualify for the fused composite path (mask
+        band, remote workers, non-trivial band algebra) — callers then
+        render each frame independently."""
+        if self.remote is not None or req.mask is not None:
+            return None
+        exprs = req.band_exprs
+        if any(ce._ast[0] != "var" for ce in exprs.expressions):
+            return None
+        span_req = dataclasses.replace(
+            req, start_time=min(times), end_time=max(times) + 1.0)
+        granules = self._timed_index(span_req, spans)
+        if not granules:
+            return None
+        if stats is not None:
+            stats["granules"] = len(granules)
+            stats["files"] = len({g.path for g in granules})
+        untimed = [g for g in granules if g.timestamp == 0.0]
+        timed = [g for g in granules if g.timestamp != 0.0]
+        frames = []
+        for t in times:
+            fg = [g for g in timed if abs(g.timestamp - t) < 1.0]
+            if not fg and timed:
+                # nearest-available fallback: consecutive frames
+                # between source timesteps resolve to the SAME granule
+                # set, which is what lets the autoplanner merge their
+                # superblocks and gather shared pages once per sequence
+                best = min(abs(g.timestamp - t) for g in timed)
+                fg = [g for g in timed if abs(g.timestamp - t) == best]
+            fg = fg + untimed
+            if not fg:
+                frames.append(None)
+                continue
+            ns_names, ns_ids, prio = ns_prio(fg)
+            frames.append((fg, ns_ids, prio, len(ns_names)))
+        return frames
 
     def composite_dispatch(self, req: GeoTileRequest, made,
                            offset: float = 0.0, scale: float = 0.0,

@@ -1145,24 +1145,32 @@ class WaveScheduler:
     # -- public enqueue API --------------------------------------------
 
     def render_byte(self, pool, tables, params16, ctrl, sp,
-                    statics: tuple, xla_item, percall) -> np.ndarray:
+                    statics: tuple, xla_item, percall,
+                    serials=None) -> np.ndarray:
         """Submit one byte-tile render (windows already staged in the
         page pool, ``tables`` PINNED — the wave unpins after enqueue).
         ``xla_item`` is (stack, params11, win, win0) for the race's
         stacked bucketed leg; ``percall`` re-renders this tile alone
-        (incident failover).  Blocks; returns host uint8 (H, W)."""
+        (incident failover).  ``serials`` is the lane's scene-content
+        identity (the executor's scene-serial key): the autoplanner
+        only superblock-merges lanes whose serials match, so temporal
+        waves carrying DIFFERENT timesteps of one layer — identical
+        params, different page content — never share a union gather
+        table.  Blocks; returns host uint8 (H, W)."""
         from ..resilience import current_token
         e = _Entry("byte", (tuple(statics), id(pool)),
                    {"pool": pool, "tables": np.asarray(tables),
                     "params16": np.asarray(params16),
                     "ctrl": np.asarray(ctrl), "sp": np.asarray(sp),
-                    "xla": xla_item},
+                    "xla": xla_item,
+                    "serials": tuple(serials) if serials else None},
                    percall, current_token(),
                    cleanup=lambda: pool.unpin(tables))
         return self._wait(self._submit(e))
 
     def render_expr(self, pool, tables, params16, ctrl, sp, consts,
-                    statics: tuple, xla_item, percall) -> np.ndarray:
+                    statics: tuple, xla_item, percall,
+                    serials=None) -> np.ndarray:
         """Submit one fused expression render (`render_byte` contract
         plus ``consts``, the lane's lifted literals (C,) f32).  The
         group key includes the fingerprint (statics[-1]), so lanes
@@ -1175,13 +1183,14 @@ class WaveScheduler:
                     "params16": np.asarray(params16),
                     "ctrl": np.asarray(ctrl), "sp": np.asarray(sp),
                     "consts": np.asarray(consts, np.float32),
-                    "xla": xla_item},
+                    "xla": xla_item,
+                    "serials": tuple(serials) if serials else None},
                    percall, current_token(),
                    cleanup=lambda: pool.unpin(tables))
         return self._wait(self._submit(e))
 
     def warp_scored(self, pool, tables, params16, ctrl,
-                    statics: tuple, xla_item, percall):
+                    statics: tuple, xla_item, percall, serials=None):
         """Submit one scored mosaic (the warp_mosaic_scenes paged
         contract).  Blocks; returns host (canv (n_ns, h, w) f32,
         valid (n_ns, h, w) bool) — the -inf best plane is folded to
@@ -1190,7 +1199,8 @@ class WaveScheduler:
         e = _Entry("scored", (tuple(statics), id(pool)),
                    {"pool": pool, "tables": np.asarray(tables),
                     "params16": np.asarray(params16),
-                    "ctrl": np.asarray(ctrl), "xla": xla_item},
+                    "ctrl": np.asarray(ctrl), "xla": xla_item,
+                    "serials": tuple(serials) if serials else None},
                    percall, current_token(),
                    cleanup=lambda: pool.unpin(tables))
         return self._wait(self._submit(e))

@@ -20,10 +20,11 @@ from __future__ import annotations
 
 import datetime as dt
 import math
+import os
 import re
 import struct
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -431,3 +432,140 @@ def encode_dap4(band_names: List[str],
 
 
 CONTENT_TYPE = "application/vnd.opendap.org.dap4.data"
+
+
+# ---------------------------------------------------------------------------
+# streamed encoder (bounded-RSS leg, docs/PERF.md "Temporal waves")
+# ---------------------------------------------------------------------------
+# `encode_dap4` materialises every band canvas AND the whole response
+# body in RAM — fine for thumbnails, quadratic pain for production
+# subsets.  The streamed leg routes the render through the staged
+# export engine (`pipeline/export.py`) into a band-major float32 spool
+# file, then replays the spool through a MAX_CHUNK rechunker row-batch
+# by row-batch.  The wire bytes are IDENTICAL to `encode_dap4` (same
+# DMR, same axis chunks, same chunk boundaries — the rechunker only
+# emits at exact MAX_CHUNK multiples within a band); only the peak
+# resident set changes.
+
+
+def dap_stream_enabled() -> bool:
+    """GSKY_DAP_STREAM gate (default on), read per request so the
+    parity tests and bench can A/B without a restart.  ``0`` restores
+    the in-RAM `encode_dap4` leg byte-identically."""
+    return os.environ.get("GSKY_DAP_STREAM", "1") != "0"
+
+
+class CoverageSpool:
+    """Band-major ``<f4`` scratch file between the export engine and
+    the DAP4 rechunker.
+
+    ``write_region`` implements the writer interface `ExportPipeline`
+    expects (the GeoTIFF streaming writer's contract): nodata-filled
+    (n_bands, th, tw) float32 blocks at output offsets, written with
+    positioned I/O so the engine's encode workers never contend on a
+    shared file cursor.  ``read_rows`` hands row batches back to the
+    streamer in on-the-wire byte order — the spool stores exactly the
+    little-endian bytes the response will carry."""
+
+    def __init__(self, path: str, n_bands: int, height: int,
+                 width: int):
+        self.path = path
+        self.n_bands = int(n_bands)
+        self.height = int(height)
+        self.width = int(width)
+        self.fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_TRUNC,
+                          0o600)
+        os.ftruncate(self.fd, self.n_bands * self.height
+                     * self.width * 4)
+
+    def write_region(self, ox: int, oy: int, block) -> None:
+        b = np.ascontiguousarray(
+            np.asarray(block, np.float32).astype("<f4", copy=False))
+        _n, th, tw = b.shape
+        for i in range(min(self.n_bands, b.shape[0])):
+            for r in range(th):
+                off = ((i * self.height + oy + r) * self.width
+                       + ox) * 4
+                os.pwrite(self.fd, b[i, r].tobytes(), off)
+
+    def read_rows(self, band: int, row0: int, nrows: int) -> bytes:
+        off = (band * self.height + row0) * self.width * 4
+        return os.pread(self.fd, nrows * self.width * 4, off)
+
+    def close(self) -> None:
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class _Rechunker:
+    """Re-slice an arbitrary byte feed into `encode_dap4`'s chunking:
+    emit a full chunk at every exact MAX_CHUNK boundary, flush the
+    remainder at band end.  ``peak`` records the largest resident
+    buffer — the bounded-RSS evidence `/debug`'s temporal block and
+    the parity test assert on."""
+
+    def __init__(self):
+        self.buf = bytearray()
+        self.peak = 0
+
+    def push(self, data: bytes) -> bytes:
+        self.buf += data
+        if len(self.buf) > self.peak:
+            self.peak = len(self.buf)
+        out = []
+        while len(self.buf) >= MAX_CHUNK:
+            out.append(_chunk(bytes(self.buf[:MAX_CHUNK])))
+            del self.buf[:MAX_CHUNK]
+        return b"".join(out)
+
+    def flush(self) -> bytes:
+        if not self.buf:
+            return b""
+        out = _chunk(bytes(self.buf))
+        self.buf.clear()
+        return out
+
+
+def stream_dap4(band_names: List[str], spool: CoverageSpool,
+                stats: Optional[Dict] = None,
+                row_batch: Optional[int] = None) -> Iterator[bytes]:
+    """Yield the DAP4 response for a spooled float32 coverage,
+    byte-identical to ``encode_dap4(band_names, arrays)`` over the
+    same canvases, holding at most one row batch + one partial chunk
+    resident.  ``stats`` (mutated at exhaustion) gets ``peak_buffer``
+    and ``bytes`` folded in for the temporal metrics."""
+    var_names, axis_names, axis_vals = split_dimensions(band_names)
+    # the spool is float32 by contract — the dtype the in-RAM leg's
+    # canvases carry, so the DMR matches
+    yield _chunk(build_dmr(axis_names, axis_vals, var_names,
+                           "Float32", spool.width, spool.height))
+    for ns in axis_names:
+        yield _chunk(np.asarray(axis_vals[ns], "<f8").tobytes())
+    if row_batch is None:
+        # ~1 MiB of rows per read keeps the replay syscall-cheap while
+        # the resident bound stays row_batch + MAX_CHUNK
+        row_batch = max(1, min(spool.height,
+                               (1 << 20) // max(1, spool.width * 4)))
+    rc = _Rechunker()
+    total = 0
+    for bi in range(len(band_names)):
+        for r0 in range(0, spool.height, row_batch):
+            nr = min(row_batch, spool.height - r0)
+            out = rc.push(spool.read_rows(bi, r0, nr))
+            if out:
+                total += len(out)
+                yield out
+        out = rc.flush()
+        if out:
+            total += len(out)
+            yield out
+    yield last_chunk()
+    if stats is not None:
+        stats["peak_buffer"] = rc.peak
+        stats["bytes"] = total

@@ -44,8 +44,8 @@ from ..index.client import MASClient
 from ..index.store import fmt_time, parse_time
 from ..io.geotiff import GeoTIFF, write_geotiff
 from ..io.netcdf import write_netcdf3
-from ..io.png import (empty_tile_png, encode_async, encode_jpeg,
-                      encode_png, encode_rgba_png)
+from ..io.png import (ApngAssembler, empty_tile_png, encode_async,
+                      encode_jpeg, encode_png, encode_rgba_png)
 from ..ops.palette import gradient_palette, with_nodata_entry
 from ..ops.raster import DTYPE_NP
 from ..ops.scale import scale_params_auto, scale_to_byte
@@ -75,6 +75,46 @@ log = logging.getLogger("gsky.ows")
 # GetCoverage outputs beyond this many pixels stream tiles to disk via
 # GeoTIFFWriter instead of accumulating whole-coverage arrays in RAM
 WCS_STREAM_PIXELS = 16 << 20
+
+# output formats served by the temporal wave path (docs/PERF.md
+# "Temporal waves"); video/mp4 is an APNG-container stub for now
+_ANIM_FORMATS = ("image/apng", "video/mp4")
+
+
+def anim_enabled() -> bool:
+    """GSKY_ANIM=0 disables temporal wave serving: a TIME-range GetMap
+    with an animation format falls through to the existing single-image
+    ladder (temporal mosaic over the range), byte-identically."""
+    return os.environ.get("GSKY_ANIM", "1") != "0"
+
+
+def _anim_delay_ms() -> int:
+    """Per-frame display delay in the APNG container
+    (GSKY_ANIM_DELAY_MS, default 500)."""
+    try:
+        return max(1, int(os.environ.get("GSKY_ANIM_DELAY_MS", "500")))
+    except ValueError:
+        return 500
+
+
+def _anim_max_frames() -> int:
+    """Sequence-length cap (GSKY_ANIM_MAX_FRAMES, default 64; <= 0 =
+    uncapped).  Over-long TIME lists are truncated — and labelled
+    degraded — rather than rejected."""
+    try:
+        return int(os.environ.get("GSKY_ANIM_MAX_FRAMES", "64"))
+    except ValueError:
+        return 64
+
+
+def _anim_workers() -> int:
+    """Concurrent frame-submission threads (GSKY_ANIM_WORKERS,
+    default 8): frames must be IN FLIGHT together for the wave
+    scheduler to coalesce them into one device program."""
+    try:
+        return max(1, int(os.environ.get("GSKY_ANIM_WORKERS", "8")))
+    except ValueError:
+        return 8
 
 
 @functools.lru_cache(maxsize=1)
@@ -431,6 +471,14 @@ class OWSServer:
                 doc["elastic"] = _elastic.elastic_stats()
         except Exception:  # elastic optional in this build
             pass
+        try:
+            # temporal wave serving (docs/PERF.md "Temporal waves"):
+            # animation sequences, frames-per-wave amortisation, and
+            # streamed-DAP4 byte/peak-buffer counters
+            from ..obs.metrics import temporal_stats
+            doc["temporal"] = temporal_stats()
+        except Exception:  # temporal tier optional in this build
+            pass
         doc["drain"] = self.drain.stats()
         doc["cancel"] = cancel_stats()
         doc["pressure"] = _pressure.default_monitor().stats()
@@ -706,6 +754,7 @@ class OWSServer:
         host = _host_of(request, cfg)
         ns_path = request.path
         if req_name == "getcapabilities" or not req_name:
+            await self._ensure_layer_dates(cfg)
             return _xml(T.wms_capabilities(cfg, ns_path, host))
         if req_name == "describelayer":
             layers = [cfg.layer(n) for n in p.layers]
@@ -721,6 +770,33 @@ class OWSServer:
                 return await self._feature_info(cfg, p)
         raise OWSError(f"WMS request {p.request!r} not supported",
                        "OperationNotSupported")
+
+    async def _ensure_layer_dates(self, cfg: Config) -> None:
+        """Populate empty per-layer date lists from the live index so
+        GetCapabilities advertises `<Dimension name="time">` extents
+        for on-demand layers too (the eager strategies resolved at
+        config load).  Advisory: a MAS outage leaves the dimension out
+        rather than failing the capabilities document; resolved lists
+        cache on the layer until the next config reload."""
+        lays = [l for l in cfg.layers
+                if not l.dates and l.data_source
+                and not l.service_disabled("wms")]
+        if not lays:
+            return
+        try:
+            mas = self._mas(cfg)
+        except Exception:  # no MAS configured: nothing to resolve from
+            return
+        from .config import get_layer_dates
+        for lay in lays:
+            try:
+                await asyncio.to_thread(get_layer_dates, lay, mas)
+                for s in lay.styles:
+                    s.dates = lay.dates
+                    s.effective_start_date = lay.effective_start_date
+                    s.effective_end_date = lay.effective_end_date
+            except Exception:  # per-layer resolution is advisory
+                pass
 
     def _resolve_layer(self, cfg: Config, name: str, styles: List[str],
                        service: str) -> Tuple[Layer, Layer]:
@@ -818,8 +894,14 @@ class OWSServer:
             # continuations predicted from this stream warm the scene
             # cache ahead of the client's next tile (docs/INGEST.md)
             self._note_prefetch(cfg, p)
+        # animation sequences are streamed and never cached: the frames
+        # are large, degraded variants (brownout halving) must not be
+        # replayed, and the StreamResponse can't be frozen anyway
+        is_anim = anim_enabled() and len(p.times) > 1 \
+            and p.format.lower() in _ANIM_FORMATS
         if self.gateway is not None and p.layers and p.bbox is not None \
-                and p.crs is not None and p.width > 0 and p.height > 0:
+                and p.crs is not None and p.width > 0 and p.height > 0 \
+                and not is_anim:
             lay, style = self._resolve_layer(cfg, p.layers[0], p.styles,
                                              "wms")
             if lay.cache_max_age > 0:
@@ -829,7 +911,7 @@ class OWSServer:
                         lay.cache_max_age)
         return await self._serve_gated(
             request, "WMS", key, meta, collector,
-            lambda: self._getmap(cfg, p, collector))
+            lambda: self._getmap(cfg, p, collector, request=request))
 
     def _note_prefetch(self, cfg: Config, p) -> None:
         """Feed one resolvable GetMap key to the prefetch planner,
@@ -932,7 +1014,7 @@ class OWSServer:
         except Exception:  # pool prewarm is advisory - a miss stages on demand
             pass
 
-    async def _getmap(self, cfg: Config, p, collector):
+    async def _getmap(self, cfg: Config, p, collector, request=None):
         if not p.layers:
             raise OWSError("no layers requested", "LayerNotDefined")
         if p.bbox is None or p.crs is None:
@@ -975,6 +1057,17 @@ class OWSServer:
                 use = _best_overview(lay, res * (2.0 ** bl))
                 if use is not None:
                     source = use
+
+        # temporal wave serving (docs/PERF.md "Temporal waves"): a TIME
+        # range/list with an animation output format resolves all
+        # frames in ONE index pass and renders the sequence as lanes of
+        # one wave — the autoplanner merges consecutive frames'
+        # near-identical windows into shared superblocks, so shared
+        # granule pages are gathered once per sequence, not per frame
+        if len(p.times) > 1 and p.format.lower() in _ANIM_FORMATS \
+                and anim_enabled() and not lay.input_layers:
+            return await self._getmap_animation(request, cfg, p, lay,
+                                                source, style, collector)
 
         req = self._tile_request(cfg, source, style, p, p.width, p.height,
                                  lay.wms_polygon_segments)
@@ -1146,6 +1239,187 @@ class OWSServer:
             encode_png, scaled, palette,
             compress_level=_png_level(lay, style), spans=spans))
 
+    async def _getmap_animation(self, request, cfg: Config, p, lay,
+                                source, style, collector):
+        """GetMap TIME-range animation: ONE index pass
+        (`TilePipeline.animation_prep`), every frame a lane of the
+        same wave group, APNG container assembled on the encode pool
+        and streamed.  Degrade = frame-count halving under brownout;
+        the response is never cached (see `_getmap_gated`)."""
+        from ..obs import metrics as _om
+        from ..pipeline import waves as _waves
+        times = list(p.times)
+        maxf = _anim_max_frames()
+        if maxf > 0 and len(times) > maxf:
+            times = times[:maxf]
+            mark_degraded("anim-cap")
+        bl = brownout_level()
+        if bl:
+            # quality before availability: halve the frame count per
+            # brownout level (frame 0 always survives); the degraded
+            # label was already set by _getmap's brownout block
+            times = times[::2] if bl == 1 else times[::4]
+        req = self._tile_request(cfg, source, style, p, p.width,
+                                 p.height, lay.wms_polygon_segments)
+        pipe = self._pipeline(cfg)
+        auto = scale_params_auto(style.offset_value, style.scale_value,
+                                 style.clip_value)
+        t0 = time.time()
+        w0 = _waves.wave_stats().get("dispatches", 0)
+        # one budget for the whole sequence, scaled by frame count:
+        # every stage and every frame lane draws from what is left
+        with deadline_scope(Deadline(lay.wms_timeout
+                                     * max(1, len(times)))) as dl:
+            stats: Dict[str, int] = {}
+            made = await asyncio.wait_for(
+                asyncio.to_thread(pipe.animation_prep, req, times,
+                                  stats),
+                timeout=dl.remaining())
+            if made is not None:
+                planes = await asyncio.wait_for(
+                    asyncio.to_thread(self._anim_frames_wave, pipe,
+                                      req, times, made, style, auto),
+                    timeout=dl.remaining())
+            else:
+                planes = await asyncio.wait_for(
+                    asyncio.to_thread(self._anim_frames_serial, pipe,
+                                      req, times, lay, cfg, style,
+                                      auto),
+                    timeout=dl.remaining())
+            collector.info["indexer"]["num_granules"] = \
+                stats.get("granules", 0)
+            collector.info["indexer"]["num_files"] = \
+                stats.get("files", 0)
+            collector.info["device"]["platform"] = _jax_platform()
+            palette = None
+            if all(len(pl) == 1 for pl in planes) \
+                    and (style.palette or lay.palette):
+                spec = style.palette or lay.palette
+                palette = with_nodata_entry(
+                    gradient_palette(spec.colours, spec.interpolate))
+            level = _png_level(lay, style)
+            pngs = await asyncio.wait_for(
+                asyncio.gather(*(self._encode_tile(
+                    encode_png, pl, palette, compress_level=level)
+                    for pl in planes)),
+                timeout=dl.remaining())
+        # dispatch amortisation, telemetry only (concurrent requests
+        # can inflate the delta; the bench isolates the true count)
+        wave_n = max(1, _waves.wave_stats().get("dispatches", 0) - w0)
+        collector.info["rpc"]["duration"] = int((time.time() - t0) * 1e9)
+        headers = {"X-Gsky-Anim-Frames": str(len(pngs))}
+        if p.format.lower() == "video/mp4":
+            # mp4 muxing is out of scope: the stub ships the same APNG
+            # bytes, honestly labelled, so clients can fall back
+            headers["X-Gsky-Anim-Container"] = "apng-stub"
+        asm = ApngAssembler(len(pngs), delay_ms=_anim_delay_ms())
+
+        def _record(cancelled=False):
+            try:
+                _om.record_anim_sequence(
+                    len(pngs), wave_n,
+                    degraded=bool(degraded_reasons()),
+                    cancelled=cancelled)
+            except Exception:  # animation metrics are telemetry only
+                pass
+
+        if request is None:
+            body = b"".join(asm.frame(b_) for b_ in pngs) \
+                + asm.trailer()
+            _record()
+            return web.Response(body=body, content_type="image/apng",
+                                headers=headers)
+        resp = web.StreamResponse(status=200, headers=headers)
+        resp.content_type = "image/apng"
+        await resp.prepare(request)
+        try:
+            for b_ in pngs:
+                await resp.write(asm.frame(b_))
+            await resp.write(asm.trailer())
+        except BaseException:
+            # client gone / teardown mid-container: count the sequence
+            # cancelled and unwind normally (the request scope cancels
+            # the token, releasing scene pins and staging slots)
+            _record(cancelled=True)
+            raise
+        await resp.write_eof()
+        _record()
+        return resp
+
+    def _anim_frames_wave(self, pipe, req, times, made, style, auto):
+        """Render the sequence's frames as concurrent lanes of one
+        wave group: each frame submits `composite_dispatch` on its
+        pre-resolved granule set from a small pool — inside the
+        caller's cancellation/deadline context via `copy_context` — so
+        the wave scheduler sees all lanes together and the autoplanner
+        merges same-serial frames into shared-halo superblocks.
+        Returns one [byte-plane] list per frame."""
+        import concurrent.futures as cf
+        import contextvars
+        n = len(times)
+        outs: List = [None] * n
+
+        def one(i):
+            fr = dataclasses.replace(req, start_time=times[i],
+                                     end_time=None)
+            dev = None
+            if made[i] is not None:
+                dev = pipe.composite_dispatch(
+                    fr, made[i], style.offset_value, style.scale_value,
+                    style.clip_value, style.colour_scale, auto)
+                if dev is None:
+                    # scenes not device-cacheable: this frame renders
+                    # on its own serial pass (correctness over
+                    # amortisation; the rest of the wave still merges)
+                    dev = pipe.render_composite_byte(
+                        fr, style.offset_value, style.scale_value,
+                        style.clip_value, style.colour_scale, auto)
+            if dev is None:
+                return np.full((req.height, req.width), 255, np.uint8)
+            return device_guard.guarded_readback(
+                "anim.readback", lambda dev=dev: np.asarray(dev))
+
+        with cf.ThreadPoolExecutor(
+                max_workers=min(n, _anim_workers()),
+                thread_name_prefix="gsky-anim") as ex:
+            futs = {}
+            for i in range(n):
+                ctx = contextvars.copy_context()
+                futs[ex.submit(ctx.run, one, i)] = i
+            for f in cf.as_completed(futs):
+                outs[futs[f]] = f.result()
+        return [[a] for a in outs]
+
+    def _anim_frames_serial(self, pipe, req, times, lay, cfg, style,
+                            auto):
+        """Per-frame fallback (mask band, fused band algebra, remote
+        workers): each frame renders through the modular pipeline on
+        its own index pass; the output container is still one APNG."""
+        frames = []
+        for t in times:
+            fr = dataclasses.replace(req, start_time=t, end_time=None)
+            res = _render_with_fusion(pipe, fr, lay, cfg, self)
+            bands = [res.data[n] for n in res.namespaces
+                     if n in res.data]
+            valids = [res.valid[n] for n in res.namespaces
+                      if n in res.valid]
+            if not bands:
+                frames.append([np.full((fr.height, fr.width), 255,
+                                       np.uint8)])
+                continue
+            scaled = []
+            for b, v in zip(bands[:4], valids[:4]):
+                sb = scale_to_byte(jnp.asarray(b), jnp.asarray(v),
+                                   offset=style.offset_value,
+                                   scale=style.scale_value,
+                                   clip=style.clip_value,
+                                   colour_scale=style.colour_scale,
+                                   auto=auto)
+                scaled.append(device_guard.guarded_readback(
+                    "anim.readback", lambda sb=sb: np.asarray(sb)))
+            frames.append(scaled)
+        return frames
+
     async def _encode_tile(self, fn, *args, spans=None, **kw):
         """PNG/JPEG encode off the event loop on io/png's sized pool
         when the staged tile path is on; inline under the
@@ -1240,7 +1514,10 @@ class OWSServer:
             raise OWSError(f"Failed to parse dap4.ce: {e}",
                            "InvalidParameterValue")
         p = dap4.dap_to_wcs(ce, cfg)
-        return await self._getcoverage(cfg, p, collector)
+        # the request rides along so multi-tile coverages can stream
+        # chunk-by-chunk off the export spool (GSKY_DAP_STREAM)
+        return await self._getcoverage(cfg, p, collector,
+                                       request=request)
 
     # -- WCS (`ows.go:568-1221`) --------------------------------------------
 
@@ -1288,7 +1565,8 @@ class OWSServer:
                                       is_shard=is_shard))
 
     async def _getcoverage(self, cfg: Config, p, collector, q=None,
-                           path: str = "/ows", is_shard: bool = False):
+                           path: str = "/ows", is_shard: bool = False,
+                           request=None):
         if not p.coverages:
             raise OWSError("no coverage requested", "CoverageNotDefined")
         lay, style = self._resolve_layer(cfg, p.coverages[0], p.styles,
@@ -1337,9 +1615,19 @@ class OWSServer:
             and width * height > WCS_STREAM_PIXELS
             and lay.wcs_max_tile_width % 256 == 0
             and lay.wcs_max_tile_height % 256 == 0)
-        out = {} if stream_tif else \
+        # streamed DAP4 (docs/PERF.md): multi-tile coverages route
+        # through the staged export engine into a disk spool instead of
+        # whole-coverage RAM canvases, then the response body streams
+        # chunk-by-chunk with bounded peak RSS.  serve_dap only (q is
+        # None: no shard re-entry, no gateway freeze of the stream);
+        # GSKY_DAP_STREAM=0 keeps the in-RAM leg, byte-identically.
+        stream_dap = (
+            fmt == "dap4" and request is not None and q is None
+            and dap4.dap_stream_enabled() and len(tiles) > 1
+            and not lay.input_layers and export_pipeline_enabled())
+        out = {} if stream_tif or stream_dap else \
             {n: np.zeros((height, width), np.float32) for n in ns_names}
-        valid = {} if stream_tif else \
+        valid = {} if stream_tif or stream_dap else \
             {n: np.zeros((height, width), bool) for n in ns_names}
 
         nodata = -9999.0
@@ -1355,6 +1643,14 @@ class OWSServer:
             writer = GeoTIFFWriter(stream_path, len(ns_names), height,
                                    width, np.float32, gt, p.crs,
                                    nodata=nodata)
+        elif stream_dap:
+            # band-major float32 spool in temp_dir: tiles land via the
+            # same write_region interface the GeoTIFF stream uses, and
+            # the response later reads it back row-batch by row-batch
+            stream_path = os.path.join(self.temp_dir,
+                                       f"dap_{stamp}_{id(p)}.raw")
+            writer = dap4.CoverageSpool(stream_path, len(ns_names),
+                                        height, width)
 
         async def render_tile(tb, ox, oy, tw, th):
             req = dataclasses.replace(
@@ -1516,6 +1812,31 @@ class OWSServer:
                 except OSError:
                     pass
             raise
+        if stream_dap:
+            # the coverage is complete on disk; the DAP4 body now
+            # streams spool row-batches through the chunk framer, so
+            # peak RSS is one row batch + one chunk, not the canvases
+            stats_d: Dict[str, int] = {}
+            gen = dap4.stream_dap4(ns_names, writer, stats=stats_d)
+            resp = web.StreamResponse(status=200)
+            resp.content_type = dap4.CONTENT_TYPE
+            await resp.prepare(request)
+            try:
+                while True:
+                    chunk = await asyncio.to_thread(next, gen, None)
+                    if chunk is None:
+                        break
+                    await resp.write(chunk)
+            finally:
+                await asyncio.to_thread(writer.close)
+            try:
+                from ..obs import metrics as _om
+                _om.record_dap_stream(stats_d.get("bytes", 0),
+                                      stats_d.get("peak_buffer", 0))
+            except Exception:  # stream metrics are telemetry only
+                pass
+            await resp.write_eof()
+            return resp
         if writer is not None:
             await asyncio.to_thread(writer.close)
             fname = f"{lay.name}_{stamp}.tif"

@@ -70,16 +70,24 @@ def infer_service(q: Dict[str, str]) -> str:
 
 
 def parse_times(value: str) -> List[float]:
-    """`time=` may be a comma list; ISO8601 entries."""
+    """`time=` may be a comma list; ISO8601 entries.  Duplicates are
+    dropped and the result is chronologically sorted, so an unordered
+    client list still renders (and animates) front-to-back in time and
+    never pays for the same frame twice."""
     out = []
+    seen = set()
     for tok in value.split(","):
         tok = tok.strip()
         if not tok or tok.lower() in ("current", "now"):
             continue
         try:
-            out.append(parse_time(tok))
+            t = parse_time(tok)
         except ValueError:
             raise OWSError(f"invalid time format: {tok!r}")
+        if t not in seen:
+            seen.add(t)
+            out.append(t)
+    out.sort()
     return out
 
 

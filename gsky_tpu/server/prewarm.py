@@ -48,7 +48,13 @@ algebra is live (GSKY_EXPR_FUSE, default on) the lattice gains an
 expression-fingerprint axis: every structurally distinct expression
 the configured layers/styles can dispatch compiles its fused paged
 program — gather + traced epilogue + scale-to-byte — over the same
-wave-size ladder, verdict and all (`ex1` ledger token).
+wave-size ladder, verdict and all (`ex1` ledger token).  When temporal
+animation serving is live (GSKY_ANIM, server/ows.py) the lattice gains
+a time-wave axis: the superblock-broadcast byte program — G union
+gathers shared by W frame lanes via ``sb_of`` — compiles at the
+animation shape (~4 consecutive frames per timestep superblock), so
+the first TIME-range GetMap after a deploy rides a warm program
+(docs/PERF.md "Temporal waves").
 
 Knobs: GSKY_PREWARM=0 disables; GSKY_PREWARM_SIZES (tile edges,
 default "256"), GSKY_PREWARM_BUCKET (scene bucket edge, default 512),
@@ -286,7 +292,9 @@ def prewarm(configs: Dict,
     from ..ops.warp import (render_rgba_ctrl, render_scenes_bands_ctrl,
                             render_scenes_ctrl, warp_scenes_ctrl_scored)
     from ..pipeline.executor import _bucket_pow2
+    from .ows import anim_enabled
 
+    anim_on = anim_enabled()
     install_compile_probe()
     t0 = time.perf_counter()
     c0 = compile_count()
@@ -408,6 +416,32 @@ def prewarm(configs: Dict,
                                         tables, p16w, ctrls, method,
                                         n_pad, (hw, hw), step,
                                         _xla_scored, blk=blk)
+                                # time-wave lattice axis (GSKY_ANIM,
+                                # server/ows.py animation serving):
+                                # temporal waves dispatch the
+                                # superblock-broadcast program — G
+                                # union tables shared by W frame lanes
+                                # via sb_of — so the animation shape
+                                # (consecutive frames resolving to the
+                                # same timestep, ~4 lanes per
+                                # superblock) compiles here, not on
+                                # the first TIME-range GetMap after a
+                                # deploy
+                                if anim_on and W >= 4:
+                                    G = max(1, W // 4)
+                                    Gp = 1
+                                    while Gp < G:
+                                        Gp *= 2
+                                    sb = jnp.asarray(
+                                        (np.arange(W) * G // W)
+                                        .astype(np.int32))
+                                    sbt = jnp.zeros((Gp, B, S),
+                                                    jnp.int32)
+                                    run(render_byte_paged_raced, parr,
+                                        sbt, p16w, ctrls, sps, method,
+                                        n_pad, (hw, hw), step, auto,
+                                        colour_scale, _xla_byte,
+                                        sb_of=sb)
                             # output-ring lattice: the dispatcher
                             # pushes FULL pow2 result blocks through
                             # the donated ring, so put+take compile

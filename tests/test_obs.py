@@ -285,6 +285,48 @@ def test_mesh_families_render_parse_roundtrip():
     assert fams[ent]["samples"][(ent, (("layout", "granule"),))] == 3.0
 
 
+def test_temporal_families_render_parse_roundtrip():
+    """The temporal-serving families — outcome-labelled animation
+    sequence counter, frames-per-wave gauge and streamed-DAP4 byte
+    counter — render only once either path has served, and round-trip
+    the strict parser with correct types and values."""
+    from gsky_tpu.obs.metrics import (record_anim_sequence,
+                                      record_dap_stream, render_metrics,
+                                      reset_temporal, temporal_stats)
+    reset_temporal()
+    try:
+        base = parse_exposition(render_metrics())
+        # liveness gating: no sequence and no stream served -> the
+        # exposition carries none of the temporal families
+        for fam in ("gsky_anim_sequences_total",
+                    "gsky_anim_frames_per_wave",
+                    "gsky_dap_streamed_bytes_total"):
+            assert fam not in base
+        record_anim_sequence(24, 2)
+        record_anim_sequence(12, 1, degraded=True, cancelled=True)
+        record_dap_stream(1 << 20, 4096)
+        record_dap_stream(1 << 10, 65536)
+        fams = parse_exposition(render_metrics())
+        seq = "gsky_anim_sequences_total"
+        assert fams[seq]["type"] == "counter"
+        assert fams[seq]["samples"][(seq, (("outcome", "ok"),))] == 1.0
+        assert fams[seq]["samples"][
+            (seq, (("outcome", "cancelled"),))] == 1.0
+        fpw = "gsky_anim_frames_per_wave"
+        assert fams[fpw]["type"] == "gauge"
+        assert fams[fpw]["samples"][(fpw, ())] == 12.0   # 36 / 3
+        dap = "gsky_dap_streamed_bytes_total"
+        assert fams[dap]["type"] == "counter"
+        assert fams[dap]["samples"][(dap, ())] == float(
+            (1 << 20) + (1 << 10))
+        st = temporal_stats()
+        assert st["frames_per_wave"] == 12.0
+        assert st["dap_peak_buffer_bytes"] == 65536   # max-tracked
+        assert st["degraded"] == 1
+    finally:
+        reset_temporal()
+
+
 def test_plan_families_render_parse_roundtrip():
     """The autoplanner families — superblock/bytes-saved counters plus
     the shape- and path-labelled decision counters — must round-trip

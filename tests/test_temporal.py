@@ -1,0 +1,529 @@
+"""Temporal wave serving (docs/PERF.md "Temporal waves"): TIME-range
+animation as one mesh wave + streamed DAP4.  Covers the serial-aware
+superblock merge (parity vs per-frame dispatch for every resample
+mode), the APNG container round-trip including first-frame byte
+identity vs a single-timestep GetMap, mid-animation cancellation
+reclaiming pins, brownout frame halving, both escape hatches, and
+streamed-vs-in-RAM DAP4 byte parity with the bounded-RSS assertion."""
+
+import asyncio
+import json
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+import test_paged
+from gsky_tpu.io.png import ApngAssembler, encode_apng, encode_png
+from gsky_tpu.obs import metrics as om
+from gsky_tpu.ops import paged
+from gsky_tpu.ops.warp import render_scenes_ctrl
+from gsky_tpu.pipeline import waves as W
+from gsky_tpu.resilience import CancelToken, RequestCancelled, \
+    cancel_scope
+from gsky_tpu.server import dap4
+from gsky_tpu.server.params import parse_times
+
+from fixtures import make_archive
+
+DATES = ["2020-01-10T00:00:00.000Z", "2020-01-11T00:00:00.000Z",
+         "2020-01-12T00:00:00.000Z"]
+BBOX = "147.6,-36.4,149.4,-34.6"
+
+
+@pytest.fixture(autouse=True)
+def _tmp_ledger(tmp_path, monkeypatch):
+    """Hermetic race ledger per test (same rule as tests/test_paged.py)."""
+    monkeypatch.setenv("GSKY_KERNEL_LEDGER",
+                       str(tmp_path / "ledger.jsonl"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_waves():
+    W.reset_waves()
+    yield
+    W.reset_waves()
+
+
+# ---------------------------------------------------------------------------
+# TIME list parsing
+# ---------------------------------------------------------------------------
+
+
+class TestParseTimes:
+    def test_unordered_duplicates_dedup_and_sort(self):
+        ts = parse_times(f"{DATES[2]},{DATES[0]},{DATES[1]},{DATES[0]}")
+        assert len(ts) == 3
+        assert ts == sorted(ts)
+        lone = parse_times(DATES[0])
+        assert ts[0] == lone[0]
+
+    def test_current_tokens_skipped(self):
+        assert parse_times(f"current,{DATES[1]},now") == \
+            parse_times(DATES[1])
+
+
+# ---------------------------------------------------------------------------
+# APNG container
+# ---------------------------------------------------------------------------
+
+
+def _png_chunks(buf):
+    out = []
+    off = 8
+    while off < len(buf):
+        (n,) = struct.unpack(">I", buf[off:off + 4])
+        typ = buf[off + 4:off + 8]
+        out.append((typ, buf[off + 8:off + 8 + n]))
+        off += 12 + n
+    return out
+
+
+class TestApngContainer:
+    def _frames(self, n=4, h=16, w=20):
+        rng = np.random.default_rng(5)
+        return [rng.integers(0, 255, (h, w), dtype=np.uint8)
+                for _ in range(n)]
+
+    def test_roundtrip_frames_and_delays(self):
+        from PIL import Image
+        import io as _io
+        frames = self._frames()
+        body = encode_apng([encode_png([f]) for f in frames],
+                           delay_ms=125)
+        img = Image.open(_io.BytesIO(body))
+        assert getattr(img, "n_frames", 1) == 4
+        assert img.info.get("duration") == 125.0
+        for i, f in enumerate(frames):
+            img.seek(i)
+            np.testing.assert_array_equal(
+                np.asarray(img.convert("L")), f)
+
+    def test_first_frame_idat_verbatim(self):
+        frames = self._frames(n=2)
+        png0 = encode_png([frames[0]])
+        body = encode_apng([png0, encode_png([frames[1]])])
+        idat_src = b"".join(p for t, p in _png_chunks(png0)
+                            if t == b"IDAT")
+        idat_out = b"".join(p for t, p in _png_chunks(body)
+                            if t == b"IDAT")
+        assert idat_src == idat_out
+
+    def test_sequence_numbers_and_count_enforced(self):
+        frames = self._frames(n=3)
+        asm = ApngAssembler(3, delay_ms=40)
+        parts = [asm.frame(encode_png([f])) for f in frames]
+        parts.append(asm.trailer())
+        chunks = _png_chunks(b"".join(parts))
+        seqs = [struct.unpack(">I", p[:4])[0] for t, p in chunks
+                if t in (b"fcTL", b"fdAT")]
+        assert seqs == list(range(len(seqs)))
+        short = ApngAssembler(3)
+        short.frame(encode_png([frames[0]]))
+        with pytest.raises(ValueError):
+            short.trailer()
+
+
+# ---------------------------------------------------------------------------
+# temporal superblock merge: parity + amortisation at the wave tier
+# ---------------------------------------------------------------------------
+
+
+class TestTemporalSuperblock:
+    """An animation-shaped lane set: F frames over T timesteps, frames
+    of the same timestep carrying IDENTICAL page tables (same serials)
+    and frames of different timesteps different ones.  The temporal
+    wave must dispatch once, gather each timestep's pages once, and
+    stay bit-exact against the per-frame dispatch loop."""
+
+    T, FRAMES = 2, 6
+
+    def _setup(self, method):
+        tiles = [test_paged._inputs(t, B=2, lo=1.0, hi=4000.0)
+                 for t in range(self.T)]
+        _, _, _, h, w, step, n_ns = tiles[0]
+        sp = np.array([10.0, 250.0, 0.0], np.float32)
+        statics = (method, n_ns, (h, w), step, True, 0)
+        return tiles, sp, statics
+
+    @staticmethod
+    def _await_pending(sched, n, timeout=30.0):
+        import time as _t
+        deadline = _t.monotonic() + timeout
+        while _t.monotonic() < deadline:
+            with sched._lock:
+                if len(sched._pending) >= n:
+                    return
+            _t.sleep(0.002)
+        raise AssertionError(f"pending never reached {n}")
+
+    def _run_leg(self, tiles, sp, statics, per_frame):
+        pool = test_paged._pool(cap=64)
+        frame_ts = [i * self.T // self.FRAMES
+                    for i in range(self.FRAMES)]
+        sched = W.WaveScheduler(
+            max_entries=1 if per_frame else 32, tick_ms=5000.0)
+        results = [None] * self.FRAMES
+        errors = [None] * self.FRAMES
+        paged.reset_gather_bytes()
+
+        def submit(i):
+            t = frame_ts[i]
+            stack, ctrl, params, *_ = tiles[t]
+            # every frame stages its own pins; the content-keyed pool
+            # dedups same-serial pages, so same-timestep frames carry
+            # identical tables (the autoplan merge precondition)
+            tables, p16 = test_paged._stage_full(
+                pool, stack, params, serial0=100 * (t + 1))
+            serials = tuple(100 * (t + 1) + k
+                            for k in range(np.asarray(stack).shape[0]))
+
+            def go():
+                try:
+                    results[i] = sched.render_byte(
+                        pool, tables, p16, np.asarray(ctrl), sp,
+                        statics, (stack, params, None, None), None,
+                        serials=serials)
+                except Exception as e:   # noqa: BLE001
+                    errors[i] = e
+            th = threading.Thread(target=go)
+            th.start()
+            return th
+
+        if per_frame:
+            for i in range(self.FRAMES):
+                th = submit(i)
+                self._await_pending(sched, 1)
+                while sched.run_wave():
+                    pass
+                th.join(timeout=60)
+        else:
+            ts = [submit(i) for i in range(self.FRAMES)]
+            self._await_pending(sched, self.FRAMES)
+            while sched.run_wave():
+                pass
+            for th in ts:
+                th.join(timeout=60)
+        st = sched.stats()
+        pinned = pool.stats()["pinned"]
+        sched.shutdown()
+        return results, errors, st, paged.gather_bytes_total(), pinned
+
+    @pytest.mark.parametrize("method", ["near", "bilinear", "cubic"])
+    def test_parity_and_amortisation(self, method, monkeypatch):
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        tiles, sp, statics = self._setup(method)
+        r_pf, e_pf, st_pf, bytes_pf, pin_pf = self._run_leg(
+            tiles, sp, statics, per_frame=True)
+        r_tw, e_tw, st_tw, bytes_tw, pin_tw = self._run_leg(
+            tiles, sp, statics, per_frame=False)
+        assert pin_pf == 0 and pin_tw == 0
+        assert e_pf == [None] * self.FRAMES
+        assert e_tw == [None] * self.FRAMES
+        # bit-exact frame parity between the legs, every resample mode
+        for a, b in zip(r_pf, r_tw):
+            np.testing.assert_array_equal(a, b)
+        # ... and vs the per-call bucketed reference (nearest is
+        # bit-exact by the paged-kernel parity contract)
+        if method == "near":
+            for i, a in enumerate(r_tw):
+                t = i * self.T // self.FRAMES
+                stack, ctrl, params, *_ = tiles[t]
+                ref = render_scenes_ctrl(stack, ctrl, params,
+                                         jnp.asarray(sp), *statics)
+                np.testing.assert_array_equal(np.asarray(ref), a)
+        # the whole sequence ran as ONE device program...
+        assert st_tw["dispatches"] == 1
+        assert st_pf["dispatches"] == self.FRAMES
+        # ...and same-timestep frames shared their page gathers: the
+        # sequence gathers per timestep, not per frame (>= 40%
+        # reduction, the acceptance floor)
+        assert bytes_tw <= bytes_pf * 0.6
+
+    def test_cancellation_mid_sequence_reclaims_pins(self, monkeypatch):
+        """A frame lane cancelled while the animation wave queues is
+        dropped at assembly: its pages unpin, the OTHER frames still
+        render, and nothing leaks pinned."""
+        monkeypatch.setenv("GSKY_PALLAS", "interpret")
+        tiles, sp, statics = self._setup("near")
+        pool = test_paged._pool(cap=64)
+        sched = W.WaveScheduler(max_entries=32, tick_ms=5000.0)
+        tok = CancelToken()
+        results = [None] * 4
+        errors = [None] * 4
+
+        def spawn(i, t, cancelled):
+            stack, ctrl, params, *_ = tiles[t]
+            tables, p16 = test_paged._stage_full(
+                pool, stack, params, serial0=100 * (t + 1))
+
+            def go():
+                def run():
+                    results[i] = sched.render_byte(
+                        pool, tables, p16, np.asarray(ctrl), sp,
+                        statics, (stack, params, None, None), None,
+                        serials=(100 * (t + 1), 100 * (t + 1) + 1))
+                try:
+                    if cancelled:
+                        with cancel_scope(tok):
+                            run()
+                    else:
+                        run()
+                except BaseException as e:   # noqa: BLE001
+                    errors[i] = e
+            th = threading.Thread(target=go)
+            th.start()
+            return th
+
+        ts = [spawn(i, i % 2, i == 1) for i in range(4)]
+        self._await_pending(sched, 4)
+        assert pool.stats()["pinned"] > 0
+        tok.cancel()
+        while sched.run_wave():
+            pass
+        for t in ts:
+            t.join(timeout=60)
+        assert isinstance(errors[1], RequestCancelled)
+        for i in (0, 2, 3):
+            assert errors[i] is None and results[i] is not None
+        assert pool.stats()["pinned"] == 0
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# streamed DAP4: spool + rechunker byte parity, bounded peak buffer
+# ---------------------------------------------------------------------------
+
+
+class TestDapStreamUnit:
+    def test_stream_matches_encode_byte_identical(self, tmp_path):
+        rng = np.random.default_rng(3)
+        names = ["veg#level=1", "veg#level=2", "soil#level=1"]
+        h, w = 37, 53
+        arrays = {n: rng.uniform(-1, 1, (h, w)).astype(np.float32)
+                  for n in names}
+        spool = dap4.CoverageSpool(str(tmp_path / "c.raw"),
+                                   len(names), h, w)
+        try:
+            # tiles land out of order and split mid-rows, like the
+            # export engine's encode stage
+            order = [(0, 0, 30, 20), (30, 0, w - 30, 20),
+                     (0, 20, w, h - 20)]
+            for ox, oy, tw, th in order:
+                block = np.stack([arrays[n][oy:oy + th, ox:ox + tw]
+                                  for n in names])
+                spool.write_region(ox, oy, block)
+            stats = {}
+            streamed = b"".join(dap4.stream_dap4(names, spool,
+                                                 stats=stats))
+        finally:
+            spool.close()
+        assert streamed == dap4.encode_dap4(names, arrays)
+        # bytes counts the band-data chunks (DMR/axis/last excluded)
+        assert 0 < stats["bytes"] < len(streamed)
+        assert stats["bytes"] >= len(names) * h * w * 4
+        # the rechunker never held more than a chunk + one row batch
+        assert 0 < stats["peak_buffer"] <= dap4.MAX_CHUNK + w * 4 * 128
+
+    def test_chunk_boundary_exact_split(self, tmp_path):
+        h = 1
+        w = dap4.MAX_CHUNK // 4 + 10
+        a = np.arange(w, dtype=np.float32).reshape(h, w)
+        spool = dap4.CoverageSpool(str(tmp_path / "b.raw"), 1, h, w)
+        try:
+            spool.write_region(0, 0, a[None])
+            streamed = b"".join(dap4.stream_dap4(["v"], spool))
+        finally:
+            spool.close()
+        assert streamed == dap4.encode_dap4(["v"], {"v": a})
+
+
+# ---------------------------------------------------------------------------
+# end to end over the OWS server
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    from gsky_tpu.index.client import MASClient
+    from gsky_tpu.server.config import ConfigWatcher
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    root = tmp_path_factory.mktemp("temporal")
+    arch = make_archive(str(root / "data"))
+    conf = root / "conf"
+    conf.mkdir()
+    (conf / "config.json").write_text(json.dumps({
+        "service_config": {"ows_hostname": "", "mas_address": "inproc"},
+        "layers": [{
+            "name": "fc", "title": "fractional cover",
+            "data_source": arch["root"],
+            "rgb_products": ["phot_veg"],
+            "time_generator": "mas",
+            "default_geo_bbox": [147.5, -36.5, 149.5, -34.5],
+            "default_geo_size": [64, 64],
+            "wcs_max_tile_width": 32, "wcs_max_tile_height": 32,
+            "palette": {"interpolate": True, "colours": [
+                {"R": 0, "G": 0, "B": 128, "A": 255},
+                {"R": 255, "G": 255, "B": 0, "A": 255}]},
+        }, {
+            "name": "fc_lazy", "title": "on-demand dates",
+            "data_source": arch["root"],
+            "rgb_products": ["phot_veg"],
+            "time_generator": "mas",
+            "timestamps_load_strategy": "on_demand",
+        }],
+    }))
+    mas_client = MASClient(arch["store"])
+    watcher = ConfigWatcher(str(conf), mas_factory=lambda a: mas_client,
+                            install_signal=False)
+    server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                       metrics=MetricsLogger())
+    return {"server": server}
+
+
+def _get(env, path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        client = TestClient(TestServer(env["server"].app()))
+        await client.start_server()
+        try:
+            resp = await client.get(path)
+            return resp.status, resp.content_type, \
+                dict(resp.headers), await resp.read()
+        finally:
+            await client.close()
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+def _getmap_query(fmt, time, size=64):
+    return (f"/ows?service=WMS&request=GetMap&version=1.3.0&layers=fc"
+            f"&crs=EPSG:4326&bbox=-36.4,147.6,-34.6,149.4"
+            f"&width={size}&height={size}&format={fmt}&time={time}")
+
+
+class TestAnimationEndpoint:
+    def test_apng_three_frames(self, env):
+        from PIL import Image
+        import io as _io
+        om.reset_temporal()
+        status, ctype, headers, body = _get(
+            env, _getmap_query("image/apng", ",".join(DATES)))
+        assert status == 200, body[:300]
+        assert ctype == "image/apng"
+        assert headers.get("X-Gsky-Anim-Frames") == "3"
+        img = Image.open(_io.BytesIO(body))
+        assert getattr(img, "n_frames", 1) == 3
+        # the three timesteps hold different data: frames must differ
+        img.seek(0)
+        f0 = np.asarray(img.convert("RGBA")).copy()
+        img.seek(1)
+        f1 = np.asarray(img.convert("RGBA"))
+        assert not np.array_equal(f0, f1)
+        st = om.temporal_stats()
+        assert st["sequences"] >= 1 and st["frames"] >= 3
+
+    def test_first_frame_byte_identical_to_single_getmap(self, env):
+        _, _, _, anim = _get(
+            env, _getmap_query("image/apng", ",".join(DATES)))
+        status, _, _, single = _get(
+            env, _getmap_query("image/png", DATES[0]))
+        assert status == 200
+        idat_single = b"".join(p for t, p in _png_chunks(single)
+                               if t == b"IDAT")
+        idat_anim0 = b"".join(p for t, p in _png_chunks(anim)
+                              if t == b"IDAT")
+        assert idat_anim0 == idat_single
+        # palette rides into the container verbatim too
+        plte = [p for t, p in _png_chunks(single) if t == b"PLTE"]
+        if plte:
+            assert plte == [p for t, p in _png_chunks(anim)
+                            if t == b"PLTE"]
+
+    def test_mp4_stub_labelled(self, env):
+        status, _, headers, body = _get(
+            env, _getmap_query("video/mp4", ",".join(DATES)))
+        assert status == 200
+        assert headers.get("X-Gsky-Anim-Container") == "apng-stub"
+        assert body[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def test_escape_hatch_byte_identity(self, env, monkeypatch):
+        """GSKY_ANIM=0: an animation-format TIME-range request falls
+        through the existing ladder and produces the exact bytes the
+        pre-temporal server did (= the same request with image/png)."""
+        monkeypatch.setenv("GSKY_ANIM", "0")
+        status, ctype, _, off = _get(
+            env, _getmap_query("image/apng", ",".join(DATES)))
+        assert status == 200 and ctype == "image/png"
+        _, _, _, plain = _get(
+            env, _getmap_query("image/png", ",".join(DATES)))
+        assert off == plain
+
+    def test_brownout_halves_frames(self, env, monkeypatch):
+        from PIL import Image
+        import io as _io
+        import gsky_tpu.server.ows as ows_mod
+        monkeypatch.setattr(ows_mod, "brownout_level", lambda: 1)
+        status, _, headers, body = _get(
+            env, _getmap_query("image/apng", ",".join(DATES)))
+        assert status == 200
+        img = Image.open(_io.BytesIO(body))
+        assert getattr(img, "n_frames", 1) == 2   # 3 -> [::2] -> 2
+        assert headers.get("X-Gsky-Anim-Frames") == "2"
+
+    def test_capabilities_time_dimension_on_demand(self, env):
+        status, _, _, body = _get(
+            env, "/ows?service=WMS&request=GetCapabilities")
+        assert status == 200
+        text = body.decode()
+        # the eager layer AND the on_demand layer advertise extents
+        assert text.count('<Dimension name="time"') >= 2
+        assert DATES[0] in text
+
+
+class TestDapStreamEndpoint:
+    CE = "fc{phot_veg}"
+
+    def test_streamed_byte_identical_and_bounded(self, env,
+                                                 monkeypatch):
+        om.reset_temporal()
+        status, ctype, headers, streamed = _get(
+            env, "/ows?dap4.ce=" + self.CE)
+        assert status == 200, streamed[:300]
+        assert ctype == dap4.CONTENT_TYPE
+        monkeypatch.setenv("GSKY_DAP_STREAM", "0")
+        status2, _, _, in_ram = _get(env, "/ows?dap4.ce=" + self.CE)
+        assert status2 == 200
+        assert streamed == in_ram
+        st = om.temporal_stats()
+        assert st["dap_streams"] >= 1
+        # counter carries the band-data chunks (1 band, 64x64 f32)
+        assert st["dap_streamed_bytes"] >= 64 * 64 * 4
+        # bounded peak RSS: the rechunker's largest resident buffer
+        # stays far below the in-RAM leg's float32+bool canvases +
+        # whole encoded body
+        h = w = 64
+        in_ram_estimate = 1 * h * w * 5 + len(in_ram)
+        assert 0 < st["dap_peak_buffer_bytes"] < in_ram_estimate
+
+    def test_streamed_response_chunked(self, env):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def go():
+            client = TestClient(TestServer(env["server"].app()))
+            await client.start_server()
+            try:
+                resp = await client.get("/ows?dap4.ce=" + self.CE)
+                assert resp.status == 200
+                # streamed leg: no Content-Length, chunked transfer
+                assert resp.headers.get("Transfer-Encoding") == "chunked"
+                await resp.read()
+            finally:
+                await client.close()
+        asyncio.new_event_loop().run_until_complete(go())

@@ -236,6 +236,37 @@ taking the unfused leg, every response is a clean 200 (zero bare
 exposes the ``gsky_expr_*`` families through the strict parser.
 
     JAX_PLATFORMS=cpu python tools/soak.py --scenario algebra --seconds 20
+
+``--scenario animation``: temporal wave serving (docs/PERF.md
+"Temporal waves").  ``GSKY_PALLAS=interpret`` engages the paged+wave
+pipeline on CPU; a TIME-range GetMap storm requests ``image/apng``
+animations (plus a ``video/mp4`` stub minority) whose N frames must
+render as lanes of shared wave dispatches — one index pass per
+sequence, frames amortised over waves — while a client-disconnect
+volley aborts sequences mid-container.  Pass criteria: every storm
+response is a clean 200 APNG with the full frame count (zero bare
+5xx), the serial warm sequence amortises its frames over at most half
+as many wave dispatches, at least one sequence records a
+cancellation, the page pool ends with ZERO pinned pages, and /metrics
+exposes the ``gsky_anim_*`` families through the strict parser.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario animation --seconds 20
+
+``--scenario dap4``: streamed DAP4 serving (docs/PERF.md "Temporal
+waves", DAP4 leg).  Concurrent ``dap4.ce`` constraint-expression
+subsets (rotating bands, x-clamps and time filters) against a tiled
+coverage frame must take the streamed-spool path: responses arrive
+chunked off the export spool with bounded peak buffering instead of
+materialising the coverage in RAM.  Pass criteria: every response is
+a clean 200 DAP4 body (zero bare 5xx), a ``GSKY_DAP_STREAM=0`` warm
+re-fetch is byte-identical (escape hatch), the ``temporal`` debug
+block shows streams with a peak rechunk buffer under 2x the DAP4
+chunk ceiling, steady-state RSS growth (after the first storm
+quarter, which pays compiles and cache fills) stays under
+``--max-rss-growth-mb``, and /metrics exposes
+``gsky_dap_streamed_bytes_total`` through the strict parser.
+
+    JAX_PLATFORMS=cpu python tools/soak.py --scenario dap4 --seconds 20
 """
 
 from __future__ import annotations
@@ -324,7 +355,7 @@ def _run(argv=None):
                              "fleet", "overload", "ingest",
                              "devicechaos", "wave", "mesh", "plan",
                              "fabric", "occupancy", "elastic",
-                             "algebra"),
+                             "algebra", "animation", "dap4"),
                     default="churn")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="hot scenario: Zipf exponent of tile popularity")
@@ -391,6 +422,14 @@ def _run(argv=None):
             ("clip", f"clip = min(max({p0}, 400), 2600)"),
             ("curve", f"curve = pow({p0} / 3000, 2) * 3000"),
         )]
+    # dap twin needs a coverage frame (default bbox + size): dap4.ce
+    # has no bbox/size params, so dap_to_wcs reads them off the layer,
+    # and a tile cap below the frame splits the export into >1 staged
+    # tile -- the precondition for the streamed-spool DAP4 leg
+    dap_span = B.SCENE_SIZE * 30.0
+    dap_core = BBox(590000.0, 6105000.0 - dap_span * 1.3,
+                    590000.0 + dap_span * 1.3, 6105000.0)
+    dap_ll = transform_bbox(dap_core, utm, EPSG4326)
     with open(os.path.join(conf_dir, "config.json"), "w") as fp:
         json.dump({
             "service_config": {"ows_hostname": "", "mas_address": ""},
@@ -428,6 +467,21 @@ def _run(argv=None):
                 "wcs_max_width": 4096, "wcs_max_height": 4096,
                 "wcs_max_tile_width": 256,
                 "wcs_max_tile_height": 256},
+                # dap twin: coverage frame for the dap4.ce endpoint,
+                # tiled 2x2 so the streamed export engine engages
+                # (stream_dap requires len(tiles) > 1)
+                {
+                "name": "landsat_dap", "title": "dap soak",
+                "data_source": root,
+                "rgb_products": [f"LC08_20200{110 + k}_T1"
+                                 for k in range(B.N_SCENES)],
+                "time_generator": "mas",
+                "default_geo_bbox": [dap_ll.xmin, dap_ll.ymin,
+                                     dap_ll.xmax, dap_ll.ymax],
+                "default_geo_size": [256, 256],
+                "wcs_max_width": 4096, "wcs_max_height": 4096,
+                "wcs_max_tile_width": 128,
+                "wcs_max_tile_height": 128},
                 {
                 "name": "landsat_algebra", "title": "algebra soak",
                 "data_source": root,
@@ -529,6 +583,10 @@ def _run(argv=None):
         return run_elastic(args, watcher, mas_client, merc, boot)
     if args.scenario == "algebra":
         return run_algebra(args, watcher, mas_client, merc, boot)
+    if args.scenario == "animation":
+        return run_animation(args, watcher, mas_client, merc, boot)
+    if args.scenario == "dap4":
+        return run_dap4(args, watcher, mas_client, merc, boot)
 
     # churn: gateway off — the RSS bound must measure the pipeline
     # tiers, not the response cache legitimately filling its budget
@@ -4077,6 +4135,407 @@ def run_algebra(args, watcher, mas_client, merc, boot) -> int:
                 os.environ[k] = v
         reset_expr_cache()
         paged.reset_expr_fused_stats()
+
+
+def run_animation(args, watcher, mas_client, merc, boot) -> int:
+    """Temporal wave serving: a TIME-range APNG storm whose N-frame
+    sequences must amortise their frame renders over shared wave
+    dispatches, plus a client-disconnect volley aborting sequences
+    mid-container (see module docstring for the pass criteria)."""
+    import socket
+    import threading
+
+    import numpy as np
+
+    import bench as B
+    from gsky_tpu.obs import metrics as om
+    from gsky_tpu.pipeline.waves import wave_stats
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    # interpret mode engages the paged+wave pipeline on CPU; a wide
+    # tick gives the frame lanes of each sequence a real coalescing
+    # window, and GSKY_ANIM=1 pins the temporal path on even if the
+    # ambient environment flipped the hatch
+    env_overrides = {
+        "GSKY_PALLAS": "interpret",
+        "GSKY_WAVES": "1",
+        "GSKY_WAVE_MAX": "8",
+        "GSKY_WAVE_TICK_MS": "100",
+        "GSKY_ANIM": "1",
+    }
+    saved_env = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        # gateway off: animations are never cached by design, but the
+        # warm amortisation lap below must measure the wave scheduler,
+        # not any response-cache short-circuit of its single frames
+        server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                           metrics=MetricsLogger(), gateway=None)
+        host = boot(server)
+
+        n_frames = B.N_SCENES
+        time_list = ",".join(f"2020-01-{10 + k:02d}T00:00:00.000Z"
+                             for k in range(n_frames))
+        grid = 5
+        frac = np.linspace(0.0, 0.6, grid)
+        frac_y = np.linspace(0.1, 0.6, grid)
+        tiles = [(float(fx), float(fy)) for fx in frac for fy in frac_y]
+        w = merc.width * 0.2
+
+        def anim_url(fx: float, fy: float,
+                     fmt: str = "image/apng") -> str:
+            bb = (f"{merc.xmin + fx * merc.width},"
+                  f"{merc.ymin + fy * merc.height},"
+                  f"{merc.xmin + fx * merc.width + w},"
+                  f"{merc.ymin + fy * merc.height + w}")
+            return (f"http://{host}/ows?service=WMS&request=GetMap"
+                    f"&version=1.3.0&layers=landsat"
+                    f"&crs=EPSG:3857&bbox={bb}"
+                    f"&width=256&height=256&format={fmt}"
+                    f"&time={time_list}")
+
+        lock = threading.Lock()
+        counter = itertools.count()
+        errors: list = []
+
+        def fetch(url: str, kind: str) -> bool:
+            # no faults are injected, so every response must be a flat
+            # 200 APNG (PNG signature + acTL animation-control chunk)
+            # carrying the full frame count; the mp4 stub must be
+            # honestly labelled as APNG bytes
+            try:
+                with urllib.request.urlopen(url, timeout=180) as r:
+                    body = r.read()
+                    if r.status != 200:
+                        return False
+                    if body[:8] != b"\x89PNG\r\n\x1a\n" \
+                            or b"acTL" not in body[:256]:
+                        return False
+                    if r.headers.get("X-Gsky-Anim-Frames") \
+                            != str(n_frames):
+                        return False
+                    if kind == "mp4":
+                        return r.headers.get("X-Gsky-Anim-Container") \
+                            == "apng-stub"
+                    return True
+            except Exception as exc:   # noqa: BLE001 - reported below
+                with lock:
+                    if len(errors) < 5:
+                        errors.append(f"{kind}: {exc!r:.200}")
+                return False
+
+        # serial warm lap: with no concurrent traffic the wave-
+        # dispatch delta each sequence records is ITS OWN, so this is
+        # where the amortisation claim is measured (the storm's deltas
+        # are inflated by overlapping requests — telemetry only there)
+        om.reset_temporal()
+        warm_ok = fetch(anim_url(*tiles[0]), "apng")
+        # the server records the sequence after the container's final
+        # write — a beat after the client finishes reading it
+        st_warm = om.temporal_stats()
+        t_w = time.time() + 10
+        while time.time() < t_w and st_warm.get("sequences", 0) < 1:
+            time.sleep(0.1)
+            st_warm = om.temporal_stats()
+        warm_frames = int(st_warm.get("frames", 0))
+        warm_waves = int(st_warm.get("waves", 0))
+        warm_amort_ok = (warm_frames == n_frames
+                         and warm_waves * 2 <= warm_frames)
+
+        bad = [0]
+        n_req = {"apng": 0, "mp4": 0}
+
+        def one(_):
+            i = next(counter)
+            if i % 10 == 0:
+                kind = "mp4"
+                url = anim_url(*tiles[i % len(tiles)], fmt="video/mp4")
+            else:
+                kind = "apng"
+                url = anim_url(*tiles[i % len(tiles)])
+            ok = fetch(url, kind)
+            with lock:
+                n_req[kind] += 1
+                if not ok:
+                    bad[0] += 1
+
+        conc = max(args.conc, 8)
+        t_end = time.time() + args.seconds
+
+        def storm_worker():
+            while time.time() < t_end:
+                one(None)
+
+        storm = [threading.Thread(target=storm_worker)
+                 for _ in range(conc)]
+        for t in storm:
+            t.start()
+        for t in storm:
+            t.join()
+
+        # client-disconnect volley: a sequence aborted mid-flight must
+        # be recorded cancelled — either in the APNG streaming loop
+        # (the sequence counter's cancelled outcome) or earlier, where
+        # the request scope's cancel token drops its frame lanes from
+        # the wave (the scheduler's cancelled counter).  Staggered
+        # holds cover prep, render and container-streaming windows
+        h, _, p = host.partition(":")
+
+        def disconnect_midflight(hold_s: float):
+            i = next(counter)
+            path = anim_url(*tiles[i % len(tiles)]).split(host, 1)[1]
+            try:
+                s = socket.create_connection((h, int(p)), timeout=10)
+                try:
+                    s.sendall((f"GET {path} HTTP/1.1\r\n"
+                               f"Host: {host}\r\n"
+                               "Connection: close\r\n\r\n").encode())
+                    time.sleep(hold_s)
+                finally:
+                    s.close()
+            except Exception:   # noqa: BLE001 - volley is best-effort
+                pass
+
+        anim_c0 = om.temporal_stats().get("cancelled", 0)
+        wave_c0 = wave_stats().get("cancelled", 0)
+        cancel_seen = 0
+        volleys = 0
+        deadline = time.time() + 30
+        while time.time() < deadline and cancel_seen < 1:
+            ths = [threading.Thread(target=disconnect_midflight,
+                                    args=(hold,))
+                   for hold in (0.05, 0.15, 0.35, 0.7, 1.2, 2.0)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            volleys += 1
+            time.sleep(1.5)
+            cancel_seen = int(
+                om.temporal_stats().get("cancelled", 0) - anim_c0
+                + wave_stats().get("cancelled", 0) - wave_c0)
+
+        # every page the storm pinned must be back: cancelled lanes
+        # release at wave assembly, dispatched waves after readback
+        from gsky_tpu.pipeline import pages
+        pinned = -1
+        t_end = time.time() + 15
+        while time.time() < t_end:
+            pool = pages._default
+            pinned = (pool.stats().get("pinned", -1)
+                      if pool is not None else 0)
+            if pinned == 0:
+                break
+            time.sleep(0.5)
+
+        st = om.temporal_stats()
+        n_done = sum(n_req.values())
+        metrics = check_metrics(host, require=(
+            "gsky_requests_total", "gsky_request_seconds",
+            "gsky_anim_sequences_total", "gsky_anim_frames_per_wave",
+            "gsky_wave_dispatches_total"))
+        trace_rep = slowest_trace_report(host)
+
+        out = {
+            "scenario": "animation",
+            "warm_ok": warm_ok,
+            "warm_amortisation": {"frames": warm_frames,
+                                  "waves": warm_waves,
+                                  "ok": warm_amort_ok},
+            "requests": n_req, "failed": bad[0],
+            "errors": errors,
+            "cancellation": {"seen": cancel_seen, "volleys": volleys},
+            "pool_pinned": pinned,
+            "temporal": st,
+            "metrics": metrics,
+            "slowest_trace": trace_rep,
+        }
+        print(json.dumps(out))
+        ok = (warm_ok and warm_amort_ok
+              and n_done > 0 and bad[0] == 0
+              and st.get("sequences", 0) >= 1
+              and cancel_seen >= 1
+              and pinned == 0
+              and not metrics["missing"])
+        print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+        return 0 if ok else 1
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        om.reset_temporal()
+
+
+def run_dap4(args, watcher, mas_client, merc, boot) -> int:
+    """Streamed DAP4 serving: concurrent constraint-expression
+    subsets against a tiled coverage frame must stream off the export
+    spool with bounded buffering and bounded process RSS (see module
+    docstring for the pass criteria)."""
+    import threading
+    import urllib.parse
+
+    import bench as B
+    from gsky_tpu.geo.crs import EPSG3857, EPSG4326
+    from gsky_tpu.geo.transform import transform_bbox
+    from gsky_tpu.obs import metrics as om
+    from gsky_tpu.server import dap4
+    from gsky_tpu.server.metrics import MetricsLogger
+    from gsky_tpu.server.ows import OWSServer
+
+    env_overrides = {
+        "GSKY_PALLAS": "interpret",
+        "GSKY_DAP_STREAM": "1",
+    }
+    saved_env = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        # gateway off: the RSS ceiling must measure the export path,
+        # not a response cache legitimately retaining coverage bodies
+        server = OWSServer(watcher, mas_factory=lambda a: mas_client,
+                           metrics=MetricsLogger(), gateway=None)
+        host = boot(server)
+
+        bands = [f"LC08_20200{110 + k}_T1" for k in range(B.N_SCENES)]
+        ll = transform_bbox(merc, EPSG3857, EPSG4326)
+        # x-clamp fractions stay well inside the coverage frame so the
+        # filter survives dap_to_wcs's in-bbox validity check
+        fracs = (0.0, 0.15, 0.3, 0.45)
+
+        def ce_url(i: int) -> str:
+            # rotate band AND x subset; the time filter names the
+            # band's own acquisition date so every subset has granules
+            k = i % len(bands)
+            x_lo = ll.xmin + fracs[i % len(fracs)] * (ll.xmax - ll.xmin)
+            ce = (f"landsat_dap{{{bands[k]}}} | x >= {x_lo:.6f}, "
+                  f"time >= 2020-01-{10 + k:02d}T00:00:00.000Z")
+            return (f"http://{host}/ows?dap4.ce="
+                    + urllib.parse.quote(ce))
+
+        lock = threading.Lock()
+        counter = itertools.count()
+        errors: list = []
+        peak_rss = [0.0]
+
+        def fetch(url: str, want_body: bool = False):
+            # every response must be a flat 200 DAP4 body: the typed
+            # content-type, a leading DMR chunk naming a Float32 var,
+            # and (streamed leg) chunked transfer off the spool
+            try:
+                req = urllib.request.Request(url)
+                with urllib.request.urlopen(req, timeout=180) as r:
+                    body = r.read()
+                    if r.status != 200:
+                        return None
+                    if r.headers.get_content_type() != dap4.CONTENT_TYPE:
+                        return None
+                    if b"Float32" not in body[:2048]:
+                        return None
+                    return body if want_body else True
+            except Exception as exc:   # noqa: BLE001 - reported below
+                with lock:
+                    if len(errors) < 5:
+                        errors.append(f"{exc!r:.200}")
+                return None
+
+        # warm lap + escape hatch: the same CE fetched streamed and
+        # with GSKY_DAP_STREAM=0 (in-RAM encode) must be byte-identical
+        # — the stream changes WHERE bytes buffer, never the bytes
+        om.reset_temporal()
+        warm_streamed = fetch(ce_url(0), want_body=True)
+        warm_ok = warm_streamed is not None
+        streams_warm = om.temporal_stats().get("dap_streams", 0)
+        os.environ["GSKY_DAP_STREAM"] = "0"
+        try:
+            warm_ram = fetch(ce_url(0), want_body=True)
+        finally:
+            os.environ["GSKY_DAP_STREAM"] = "1"
+        byte_identical = (warm_ok and warm_ram is not None
+                          and warm_streamed == warm_ram)
+
+        bad = [0]
+        n_done = [0]
+        # steady-state RSS bound (matches churn): the first quarter
+        # pays compiles + decode-cache fills; growth is measured from
+        # the quarter mark so it bounds the export path, not warmup
+        rss_base = [None]
+        quarter = time.time() + args.seconds / 4.0
+
+        def one(_):
+            i = next(counter)
+            ok = fetch(ce_url(i))
+            with lock:
+                n_done[0] += 1
+                if not ok:
+                    bad[0] += 1
+                if time.time() >= quarter:
+                    r = rss_mb()
+                    if rss_base[0] is None:
+                        rss_base[0] = r
+                    peak_rss[0] = max(peak_rss[0], r)
+
+        conc = max(args.conc, 8)
+        t_end = time.time() + args.seconds
+
+        def storm_worker():
+            while time.time() < t_end:
+                one(None)
+
+        storm = [threading.Thread(target=storm_worker)
+                 for _ in range(conc)]
+        for t in storm:
+            t.start()
+        for t in storm:
+            t.join()
+
+        st = om.temporal_stats()
+        rss0 = rss_base[0] if rss_base[0] is not None else rss_mb()
+        rss_growth = max(0.0, peak_rss[0] - rss0)
+        rss_ok = rss_growth <= args.max_rss_growth_mb
+        # the rechunker may hold one full chunk plus the row batch in
+        # flight; 2x the chunk ceiling bounds it with margin — an
+        # in-RAM materialisation of concurrent coverages would not fit
+        peak_buf = st.get("dap_peak_buffer_bytes", 0)
+        buffer_ok = 0 < peak_buf <= 2 * dap4.MAX_CHUNK
+        streamed_ok = (streams_warm >= 1
+                       and st.get("dap_streams", 0) > streams_warm
+                       and st.get("dap_streamed_bytes", 0) > 0)
+        metrics = check_metrics(host, require=(
+            "gsky_requests_total", "gsky_request_seconds",
+            "gsky_dap_streamed_bytes_total"))
+
+        out = {
+            "scenario": "dap4",
+            "warm_ok": warm_ok,
+            "escape_hatch_byte_identical": byte_identical,
+            "requests": n_done[0], "failed": bad[0],
+            "errors": errors,
+            "rss": {"baseline_mb": round(rss0, 1),
+                    "peak_mb": round(peak_rss[0], 1),
+                    "growth_mb": round(rss_growth, 1),
+                    "ok": rss_ok},
+            "temporal": st,
+            "buffer_ok": buffer_ok,
+            "metrics": metrics,
+        }
+        print(json.dumps(out))
+        ok = (warm_ok and byte_identical
+              and n_done[0] > 0 and bad[0] == 0
+              and streamed_ok
+              and buffer_ok
+              and rss_ok
+              and not metrics["missing"])
+        print("SOAK PASSED" if ok else "SOAK FAILED", flush=True)
+        return 0 if ok else 1
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        om.reset_temporal()
 
 
 if __name__ == "__main__":
